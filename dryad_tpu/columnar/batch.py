@@ -1,0 +1,214 @@
+"""ColumnBatch — the HBM-resident record container.
+
+The TPU-native replacement for the reference's streamed row records
+(``DryadLinqBinaryReader/Writer``, ``RChannelItem`` arrays): a fixed
+*capacity* struct-of-arrays with a boolean validity mask.  Static shapes
+keep every stage jit-compilable; deletion/filtering clears mask bits,
+and compaction happens on-device when a shuffle or sort needs dense rows.
+
+A ColumnBatch is a registered pytree, so it flows through ``jit``,
+``shard_map`` and collectives directly.  Device columns are *physical*
+columns: logical INT64/STRING columns are two uint32 word columns (see
+``columnar.schema.device_column_names``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dryad_tpu.columnar.schema import (
+    ColumnType,
+    Schema,
+    StringDictionary,
+    join64,
+    split64,
+)
+
+
+@jax.tree_util.register_pytree_node_class
+class ColumnBatch:
+    """Fixed-capacity columnar batch with a validity mask.
+
+    ``data`` maps physical column name -> array of shape ``(capacity,)``
+    (or ``(n_partitions * capacity,)`` for a global view of a sharded
+    batch — the container is shape-agnostic beyond requiring all columns
+    and the mask to share their leading dimension).
+    """
+
+    def __init__(self, data: Dict[str, jax.Array], valid: jax.Array):
+        self.data = dict(data)
+        self.valid = valid
+
+    # -- pytree protocol ---------------------------------------------------
+    def tree_flatten(self):
+        names = sorted(self.data.keys())
+        children = [self.data[n] for n in names] + [self.valid]
+        return children, tuple(names)
+
+    @classmethod
+    def tree_unflatten(cls, names, children):
+        data = dict(zip(names, children[:-1]))
+        return cls(data, children[-1])
+
+    # -- basic properties --------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        return int(self.valid.shape[0])
+
+    @property
+    def columns(self) -> List[str]:
+        return sorted(self.data.keys())
+
+    def count(self) -> jax.Array:
+        """Number of valid rows (traced value)."""
+        return jnp.sum(self.valid.astype(jnp.int32))
+
+    def __getitem__(self, name: str) -> jax.Array:
+        return self.data[name]
+
+    # -- jit-safe transforms ----------------------------------------------
+    def with_column(self, name: str, values: jax.Array) -> "ColumnBatch":
+        new = dict(self.data)
+        new[name] = values
+        return ColumnBatch(new, self.valid)
+
+    def select(self, names: Sequence[str]) -> "ColumnBatch":
+        return ColumnBatch({n: self.data[n] for n in names}, self.valid)
+
+    def drop(self, names: Sequence[str]) -> "ColumnBatch":
+        keep = {n: v for n, v in self.data.items() if n not in set(names)}
+        return ColumnBatch(keep, self.valid)
+
+    def rename(self, mapping: Dict[str, str]) -> "ColumnBatch":
+        new = {mapping.get(n, n): v for n, v in self.data.items()}
+        return ColumnBatch(new, self.valid)
+
+    def filter(self, keep_mask: jax.Array) -> "ColumnBatch":
+        """Row filter: AND a predicate into the validity mask (Where)."""
+        return ColumnBatch(self.data, jnp.logical_and(self.valid, keep_mask))
+
+    def compact(self) -> "ColumnBatch":
+        """Move valid rows to the front (stable).
+
+        Sort-based compaction: key = !valid, stable, so valid rows keep
+        their order at the front.  Invalid slots retain stale values but
+        their mask bits are off.
+        """
+        order = jnp.argsort(jnp.logical_not(self.valid), stable=True)
+        data = {n: v[order] for n, v in self.data.items()}
+        return ColumnBatch(data, self.valid[order])
+
+    def take(self, order: jax.Array) -> "ColumnBatch":
+        """Row gather by index array (caller manages mask semantics)."""
+        data = {n: v[order] for n, v in self.data.items()}
+        return ColumnBatch(data, self.valid[order])
+
+    def pad_to(self, capacity: int) -> "ColumnBatch":
+        cur = self.capacity
+        if capacity == cur:
+            return self
+        if capacity < cur:
+            raise ValueError(f"pad_to({capacity}) below current capacity {cur}")
+        extra = capacity - cur
+        data = {
+            n: jnp.concatenate([v, jnp.zeros((extra,) + v.shape[1:], v.dtype)])
+            for n, v in self.data.items()
+        }
+        valid = jnp.concatenate([self.valid, jnp.zeros((extra,), jnp.bool_)])
+        return ColumnBatch(data, valid)
+
+    @staticmethod
+    def concatenate(batches: Sequence["ColumnBatch"]) -> "ColumnBatch":
+        """Static concat along rows (the Concat operator's device step)."""
+        names = batches[0].columns
+        for b in batches[1:]:
+            if b.columns != names:
+                raise ValueError("concat of batches with differing columns")
+        data = {n: jnp.concatenate([b.data[n] for b in batches]) for n in names}
+        valid = jnp.concatenate([b.valid for b in batches])
+        return ColumnBatch(data, valid)
+
+    @staticmethod
+    def empty(col_dtypes: Dict[str, jnp.dtype], capacity: int) -> "ColumnBatch":
+        data = {n: jnp.zeros((capacity,), dt) for n, dt in col_dtypes.items()}
+        return ColumnBatch(data, jnp.zeros((capacity,), jnp.bool_))
+
+    # -- host conversion ---------------------------------------------------
+    @staticmethod
+    def from_numpy(
+        schema: Schema,
+        arrays: Dict[str, np.ndarray],
+        capacity: Optional[int] = None,
+        dictionary: Optional[StringDictionary] = None,
+    ) -> "ColumnBatch":
+        """Encode host arrays (logical columns) into a device batch.
+
+        STRING columns require ``dictionary`` and are hashed via the
+        framework Hash64 (``columnar.schema.hash64_str``); INT64 columns
+        are split into uint32 word pairs.  Rows are padded to
+        ``capacity`` with mask bits off.
+        """
+        n = None
+        for name in schema.names:
+            a = np.asarray(arrays[name])
+            if n is None:
+                n = len(a)
+            elif len(a) != n:
+                raise ValueError("ragged input columns")
+        n = n or 0
+        cap = capacity if capacity is not None else n
+        if cap < n:
+            raise ValueError(f"capacity {cap} < row count {n}")
+
+        data: Dict[str, jnp.ndarray] = {}
+        for f in schema.fields:
+            a = np.asarray(arrays[f.name])
+            if f.ctype == ColumnType.STRING:
+                if dictionary is None:
+                    raise ValueError(f"STRING column {f.name} needs a dictionary")
+                hashes = dictionary.add_all([str(s) for s in a])
+                lo, hi = split64(hashes)
+                phys = {f"{f.name}#h0": lo, f"{f.name}#h1": hi}
+            elif f.ctype == ColumnType.INT64:
+                lo, hi = split64(a.astype(np.int64))
+                phys = {f"{f.name}#h0": lo, f"{f.name}#h1": hi}
+            else:
+                phys = {f.name: a.astype(f.ctype.numpy_dtype)}
+            for pname, pvals in phys.items():
+                padded = np.zeros((cap,), pvals.dtype)
+                padded[:n] = pvals
+                data[pname] = jnp.asarray(padded)
+        valid = np.zeros((cap,), np.bool_)
+        valid[:n] = True
+        return ColumnBatch(data, jnp.asarray(valid))
+
+    def to_numpy(
+        self,
+        schema: Schema,
+        dictionary: Optional[StringDictionary] = None,
+    ) -> Dict[str, np.ndarray]:
+        """Decode valid rows back to host logical columns."""
+        valid = np.asarray(self.valid)
+        out: Dict[str, np.ndarray] = {}
+        for f in schema.fields:
+            if f.ctype == ColumnType.STRING:
+                lo = np.asarray(self.data[f"{f.name}#h0"])[valid]
+                hi = np.asarray(self.data[f"{f.name}#h1"])[valid]
+                hashes = join64(lo, hi)
+                if dictionary is None:
+                    out[f.name] = hashes  # fall back to raw hashes
+                else:
+                    out[f.name] = np.array(
+                        dictionary.lookup_all(hashes), dtype=object
+                    )
+            elif f.ctype == ColumnType.INT64:
+                lo = np.asarray(self.data[f"{f.name}#h0"])[valid]
+                hi = np.asarray(self.data[f"{f.name}#h1"])[valid]
+                out[f.name] = join64(lo, hi, signed=True)
+            else:
+                out[f.name] = np.asarray(self.data[f.name])[valid]
+        return out

@@ -1,0 +1,55 @@
+"""Device-side hashing for partitioning.
+
+The reference partitions records with a deterministic 64-bit hash so every
+machine buckets identically (``LinqToDryad/Hash64.cs``; hash-partition
+node ``DryadLinqQueryNode.cs:3581``).  On device we hash the *physical*
+uint32-word columns with a murmur3-style finalizer and combine columns
+hash-combine style; string columns already arrive as Hash64 word pairs
+from ingest, so device hashing never touches string payloads.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def _fmix32(h: jax.Array) -> jax.Array:
+    """murmur3 32-bit finalizer (public-domain constant mix)."""
+    h = h.astype(jnp.uint32)
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+def _to_u32(col: jax.Array) -> jax.Array:
+    if col.dtype == jnp.uint32:
+        return col
+    if col.dtype == jnp.int32:
+        return col.astype(jnp.uint32)
+    if col.dtype == jnp.bool_:
+        return col.astype(jnp.uint32)
+    if col.dtype == jnp.float32:
+        # Canonicalize -0.0 to +0.0 so equal floats hash equally.
+        col = jnp.where(col == 0.0, jnp.float32(0.0), col)
+        return jax.lax.bitcast_convert_type(col, jnp.uint32)
+    raise TypeError(f"unhashable device column dtype {col.dtype}")
+
+
+def hash_columns(cols: Sequence[jax.Array], seed: int = 0) -> jax.Array:
+    """Combine physical columns into one uint32 hash per row."""
+    h = jnp.full(cols[0].shape, jnp.uint32(0x9E3779B9 ^ seed), jnp.uint32)
+    for c in cols:
+        h = h ^ (_fmix32(_to_u32(c)) + jnp.uint32(0x9E3779B9) + (h << 6) + (h >> 2))
+    return _fmix32(h)
+
+
+def partition_ids(cols: Sequence[jax.Array], num_partitions: int) -> jax.Array:
+    """Hash-partition destination per row: hash(key) % P as int32."""
+    h = hash_columns(cols)
+    return (h % jnp.uint32(num_partitions)).astype(jnp.int32)

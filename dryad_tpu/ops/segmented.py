@@ -1,0 +1,178 @@
+"""On-device segmented (group-by) reduction.
+
+The TPU-native replacement for the reference's GroupBy combiner machinery:
+sort rows by key, detect segment boundaries, reduce per segment with XLA
+scatter-adds / segmented scans — instead of hash tables inside vertex
+processes (reference ``LinqToDryad/DryadLinqVertex.cs`` GroupBy operators)
+and GM-built aggregation trees (``DrDynamicAggregateManager.h:35-168``).
+The machine→pod→overall tree becomes: per-chip partial reduce (this
+module, pre-shuffle) + post-shuffle final reduce — the
+Seed/Accumulate/RecursiveAccumulate/FinalReduce decomposition of
+``LinqToDryad/IDecomposable.cs:35-71``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dryad_tpu.columnar.batch import ColumnBatch
+from dryad_tpu.ops.sortkeys import keys_equal_adjacent, sort_order
+
+
+@dataclasses.dataclass(frozen=True)
+class AggSpec:
+    """One built-in aggregation over a physical column.
+
+    op: sum | count | min | max | mean | any | all | first
+    col: input physical column (None for count)
+    out: output physical column name
+    """
+
+    op: str
+    col: Optional[str]
+    out: str
+
+
+def _segment_layout(
+    batch: ColumnBatch, key_cols: Sequence[str]
+) -> Tuple[ColumnBatch, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Sort+compact by keys; return (sorted batch, valid, start, seg, nseg).
+
+    ``seg`` maps each row to its segment id, with invalid rows mapped to
+    the sentinel segment ``capacity`` (dropped on slice).
+    """
+    cap = batch.capacity
+    order = sort_order([batch.data[k] for k in key_cols], batch.valid)
+    sb = batch.take(order)
+    v = sb.valid
+    eq = keys_equal_adjacent([sb.data[k] for k in key_cols])
+    start = v & ~eq
+    seg_id = jnp.cumsum(start.astype(jnp.int32)) - 1
+    seg = jnp.where(v, seg_id, cap)
+    nseg = jnp.sum(start.astype(jnp.int32))
+    return sb, v, start, seg, nseg
+
+
+def _first_scatter(
+    val: jax.Array, start: jax.Array, seg: jax.Array, cap: int
+) -> jax.Array:
+    """Per-segment value from the segment's first row."""
+    idx = jnp.where(start, seg, cap)
+    return jnp.zeros((cap + 1,) + val.shape[1:], val.dtype).at[idx].set(val)[:cap]
+
+
+def group_reduce(
+    batch: ColumnBatch,
+    key_cols: Sequence[str],
+    aggs: Sequence[AggSpec],
+) -> ColumnBatch:
+    """Group rows by key columns and reduce; output capacity == input.
+
+    Output batch holds one row per distinct key (rows 0..nseg-1 valid):
+    the key columns plus one column per AggSpec.
+    """
+    cap = batch.capacity
+    sb, v, start, seg, nseg = _segment_layout(batch, key_cols)
+    nsegments = cap + 1  # includes the invalid-row sentinel segment
+
+    out: Dict[str, jax.Array] = {}
+    for k in key_cols:
+        out[k] = _first_scatter(sb.data[k], start, seg, cap)
+
+    for a in aggs:
+        if a.op == "count":
+            data = jnp.ones((cap,), jnp.int32)
+            out[a.out] = jax.ops.segment_sum(data, seg, nsegments)[:cap]
+            continue
+        col = sb.data[a.col]
+        if a.op == "sum":
+            out[a.out] = jax.ops.segment_sum(col, seg, nsegments)[:cap]
+        elif a.op == "min":
+            out[a.out] = jax.ops.segment_min(col, seg, nsegments)[:cap]
+        elif a.op == "max":
+            out[a.out] = jax.ops.segment_max(col, seg, nsegments)[:cap]
+        elif a.op == "mean":
+            s = jax.ops.segment_sum(col.astype(jnp.float32), seg, nsegments)[:cap]
+            c = jax.ops.segment_sum(jnp.ones((cap,), jnp.float32), seg, nsegments)[:cap]
+            out[a.out] = s / jnp.maximum(c, 1.0)
+        elif a.op == "any":
+            m = jax.ops.segment_max(col.astype(jnp.int32), seg, nsegments)[:cap]
+            out[a.out] = m.astype(jnp.bool_)
+        elif a.op == "all":
+            m = jax.ops.segment_min(
+                jnp.where(v, col, True).astype(jnp.int32), seg, nsegments
+            )[:cap]
+            out[a.out] = m.astype(jnp.bool_)
+        elif a.op == "first":
+            out[a.out] = _first_scatter(col, start, seg, cap)
+        else:
+            raise ValueError(f"unknown agg op {a.op!r}")
+
+    valid = jnp.arange(cap, dtype=jnp.int32) < nseg
+    return ColumnBatch(out, valid)
+
+
+# -- generic user decompositions ------------------------------------------
+
+MergeFn = Callable[[Dict[str, jax.Array], Dict[str, jax.Array]], Dict[str, jax.Array]]
+
+
+def group_combine(
+    batch: ColumnBatch,
+    key_cols: Sequence[str],
+    state_cols: Sequence[str],
+    merge: MergeFn,
+) -> ColumnBatch:
+    """Segmented reduce with an arbitrary associative ``merge``.
+
+    ``state_cols`` name accumulator columns already produced by the
+    user's Seed/Accumulate step; ``merge`` is RecursiveAccumulate
+    (reference ``IDecomposable.cs:35-71``), applied pairwise and
+    vectorized over rows.  Implemented as a flagged segmented
+    ``associative_scan``: each segment's scan result at its last row is
+    the segment reduction.
+    """
+    cap = batch.capacity
+    sb, v, start, seg, nseg = _segment_layout(batch, key_cols)
+
+    flags = start
+    vals = {c: sb.data[c] for c in state_cols}
+
+    def combine(a, b):
+        fa, va = a
+        fb, vb = b
+        merged = merge(va, vb)
+        out = {
+            k: jnp.where(fb, vb[k], merged[k]) for k in vals.keys()
+        }
+        return (fa | fb, out)
+
+    _, scanned = jax.lax.associative_scan(combine, (flags, vals))
+
+    # Last row of each segment: next row starts a new segment / is invalid / EOF.
+    nxt_start = jnp.concatenate([start[1:], jnp.array([True])])
+    nxt_valid = jnp.concatenate([v[1:], jnp.array([False])])
+    last = v & (nxt_start | ~nxt_valid)
+
+    out: Dict[str, jax.Array] = {}
+    for k in key_cols:
+        out[k] = _first_scatter(sb.data[k], start, seg, cap)
+    idx = jnp.where(last, seg, cap)
+    for c in state_cols:
+        val = scanned[c]
+        out[c] = jnp.zeros((cap + 1,) + val.shape[1:], val.dtype).at[idx].set(val)[:cap]
+
+    valid = jnp.arange(cap, dtype=jnp.int32) < nseg
+    return ColumnBatch(out, valid)
+
+
+def distinct(batch: ColumnBatch, key_cols: Sequence[str]) -> ColumnBatch:
+    """Distinct rows over key columns (reference Distinct operator):
+    group with per-segment 'first' on every non-key column."""
+    others = [c for c in batch.columns if c not in set(key_cols)]
+    aggs = [AggSpec("first", c, c) for c in others]
+    return group_reduce(batch, key_cols, aggs)

@@ -1,0 +1,72 @@
+"""Host<->mesh data movement for ColumnBatches.
+
+The ingest/egress edge: the reference reads partitioned tables from
+partfile/HDFS/Azure into per-vertex channels (``LinqToDryad/
+DataProvider.cs``); here a global host table becomes one sharded
+ColumnBatch (leading axis = partitions * capacity) laid out over the
+mesh with ``NamedSharding``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from dryad_tpu.columnar.batch import ColumnBatch
+from dryad_tpu.columnar.schema import Schema, StringDictionary
+from dryad_tpu.parallel.mesh import num_partitions, partition_sharding
+
+
+def shard_batch(batch: ColumnBatch, mesh: Mesh) -> ColumnBatch:
+    """Place a (global-capacity) batch onto the mesh, row-sharded."""
+    sh = partition_sharding(mesh)
+    data = {n: jax.device_put(v, sh) for n, v in batch.data.items()}
+    return ColumnBatch(data, jax.device_put(batch.valid, sh))
+
+
+def from_host_table(
+    schema: Schema,
+    arrays: Dict[str, np.ndarray],
+    mesh: Mesh,
+    partition_capacity: Optional[int] = None,
+    dictionary: Optional[StringDictionary] = None,
+) -> ColumnBatch:
+    """Round-robin rows into P partitions of equal static capacity.
+
+    Mirrors FromEnumerable/FromStore ingestion
+    (``DryadLinqContext.cs:1176-1223``): rows land in partition
+    ``i % P`` so every shard is near-equal before the first shuffle.
+    """
+    P = num_partitions(mesh)
+    names = schema.names
+    n = len(np.asarray(arrays[names[0]])) if names else 0
+    per = -(-n // P) if n else 1  # ceil
+    cap = partition_capacity if partition_capacity is not None else per
+    if cap < per:
+        raise ValueError(f"partition_capacity {cap} < required {per}")
+
+    # Encode each partition separately so only real rows are hashed /
+    # dictionary-registered; from_numpy pads the per-partition tail.
+    idx_by_part = [np.arange(p, n, P) for p in range(P)]
+    parts = [
+        ColumnBatch.from_numpy(
+            schema,
+            {name: np.asarray(arrays[name])[idx] for name in names},
+            capacity=cap,
+            dictionary=dictionary,
+        )
+        for idx in idx_by_part
+    ]
+    return shard_batch(ColumnBatch.concatenate(parts), mesh)
+
+
+def to_host_table(
+    batch: ColumnBatch,
+    schema: Schema,
+    dictionary: Optional[StringDictionary] = None,
+) -> Dict[str, np.ndarray]:
+    """Gather a sharded batch back to host logical columns (egress)."""
+    return batch.to_numpy(schema, dictionary)

