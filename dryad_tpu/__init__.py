@@ -26,6 +26,10 @@ from dryad_tpu.utils.config import DryadConfig, StaticConfig
 from dryad_tpu.columnar.schema import Schema, ColumnType, StringDictionary
 from dryad_tpu.columnar.batch import ColumnBatch
 
+from dryad_tpu.api.decomposable import Decomposable
+from dryad_tpu.api.context import DryadContext, PlatformKind
+from dryad_tpu.api.query import JobHandle, Query
+
 __version__ = "0.1.0"
 
 __all__ = [
@@ -35,5 +39,10 @@ __all__ = [
     "ColumnType",
     "StringDictionary",
     "ColumnBatch",
+    "Decomposable",
+    "DryadContext",
+    "PlatformKind",
+    "JobHandle",
+    "Query",
     "__version__",
 ]

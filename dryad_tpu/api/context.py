@@ -1,0 +1,254 @@
+"""DryadContext — the entry point and job driver.
+
+The analog of ``DryadLinqContext`` (``LinqToDryad/DryadLinqContext.cs:566``):
+owns platform selection (reference LOCAL/YARN_NATIVE/YARN_AZURE,
+``DryadLinqContext.cs:55-71`` — here TPU mesh vs host-local CPU mesh),
+per-context config, dataset ingestion (FromStore/FromEnumerable,
+``:1176-1223``), the LocalDebug differential path
+(``DryadLinqContext.cs:966-983`` — LINQ-to-Objects there, a NumPy
+interpreter here), and job submission, which lowers the plan and runs
+the GraphExecutor (replacing the GraphManager process tree).
+"""
+
+from __future__ import annotations
+
+import enum
+import math
+import os
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from dryad_tpu.api.query import JobHandle, Query
+from dryad_tpu.columnar import io as CIO
+from dryad_tpu.columnar.batch import ColumnBatch
+from dryad_tpu.columnar.schema import ColumnType, Schema, StringDictionary
+from dryad_tpu.exec.events import EventLog
+from dryad_tpu.exec.executor import GraphExecutor
+from dryad_tpu.parallel import distribute as D
+from dryad_tpu.parallel.mesh import make_mesh, num_partitions
+from dryad_tpu.plan.lower import lower
+from dryad_tpu.plan.nodes import Node, PartitionInfo
+from dryad_tpu.utils.config import DryadConfig
+from dryad_tpu.utils.logging import get_logger
+
+log = get_logger("dryad_tpu.api")
+
+
+class PlatformKind(enum.Enum):
+    """Reference ClusterType LOCAL/YARN_*; here the device platform."""
+
+    AUTO = "auto"
+    TPU = "tpu"
+    CPU_LOCAL = "cpu_local"
+
+
+_NP_TYPE_MAP = {
+    np.dtype(np.int32): ColumnType.INT32,
+    np.dtype(np.int64): ColumnType.INT64,
+    np.dtype(np.float32): ColumnType.FLOAT32,
+    np.dtype(np.float64): ColumnType.FLOAT32,
+    np.dtype(np.bool_): ColumnType.BOOL,
+    np.dtype(np.uint32): ColumnType.UINT32,
+}
+
+
+def _infer_schema(arrays: Dict[str, np.ndarray]) -> Schema:
+    fields = []
+    for name, a in arrays.items():
+        a = np.asarray(a)
+        if a.dtype == object or a.dtype.kind in ("U", "S"):
+            fields.append((name, ColumnType.STRING))
+        elif a.dtype in _NP_TYPE_MAP:
+            fields.append((name, _NP_TYPE_MAP[a.dtype]))
+        else:
+            raise TypeError(f"column {name!r}: unsupported dtype {a.dtype}")
+    return Schema(fields)
+
+
+class DryadContext:
+    def __init__(
+        self,
+        num_partitions_: Optional[int] = None,
+        config: Optional[DryadConfig] = None,
+        local_debug: bool = False,
+        platform: PlatformKind = PlatformKind.AUTO,
+    ):
+        self.config = config or DryadConfig()
+        self.config.validate()
+        self.local_debug = local_debug
+        self.platform = platform
+        self.dictionary = StringDictionary()
+        self._bindings: Dict[int, tuple] = {}
+        if local_debug:
+            self.mesh = None
+            self.executor = None
+            self.events = EventLog(None)
+        else:
+            self.mesh = make_mesh(num_partitions_)
+            path = None
+            if self.config.event_log_dir:
+                path = os.path.join(
+                    self.config.event_log_dir, f"job-{int(time.time()*1000)}.jsonl"
+                )
+            self.events = EventLog(path)
+            self.executor = GraphExecutor(
+                self.mesh, self.config, self.events,
+                subquery_runner=self._run_subquery,
+            )
+
+    # -- ingestion ----------------------------------------------------------
+    def from_arrays(
+        self,
+        arrays: Dict[str, np.ndarray],
+        schema: Optional[Schema] = None,
+        partition_capacity: Optional[int] = None,
+    ) -> Query:
+        """Create a table from host arrays (reference FromEnumerable)."""
+        schema = schema or _infer_schema(arrays)
+        node = Node(
+            "input", [], schema, PartitionInfo.roundrobin(),
+            source="host",
+        )
+        self._bindings[node.id] = ("host", arrays, partition_capacity)
+        return Query(self, node)
+
+    def from_store(self, path: str) -> Query:
+        """Open a partitioned store (reference FromStore/GetTable)."""
+        schema, parts, dictionary = CIO.read_store(path)
+        self.dictionary = self.dictionary.merge(dictionary)
+        node = Node(
+            "input", [], schema, PartitionInfo.roundrobin(), source="store",
+        )
+        self._bindings[node.id] = ("store", parts, schema)
+        return Query(self, node)
+
+    def _from_device_batch(self, batch: ColumnBatch, schema: Schema) -> Query:
+        node = Node("input", [], schema, PartitionInfo(), source="device")
+        self._bindings[node.id] = ("device", batch)
+        return Query(self, node)
+
+    # -- execution ----------------------------------------------------------
+    def _bind_device(self, node: Node) -> ColumnBatch:
+        kind, *rest = self._bindings[node.id]
+        if kind == "device":
+            return rest[0]
+        if kind == "host":
+            arrays, cap = rest
+            return D.from_host_table(
+                node.schema, arrays, self.mesh,
+                partition_capacity=cap, dictionary=self.dictionary,
+            )
+        if kind == "store":
+            parts, schema = rest
+            P = num_partitions(self.mesh)
+            phys = schema.device_names()
+            import jax.numpy as jnp
+
+            # Fold store partitions onto mesh partitions (store partition
+            # i concatenates into mesh partition i % P) so a store written
+            # on a larger mesh loses nothing on a smaller one.
+            folded: list = [[] for _ in range(P)]
+            for i, cols in enumerate(parts):
+                folded[i % P].append(cols)
+            rows_per = [
+                sum(len(next(iter(c.values()))) if c else 0 for c in group)
+                for group in folded
+            ]
+            cap = math.ceil(max(max(rows_per, default=1), 1) / 8) * 8
+            batches = []
+            for group in folded:
+                data = {c: np.zeros(cap, _phys_dtype(c, schema)) for c in phys}
+                valid = np.zeros(cap, np.bool_)
+                at = 0
+                for cols in group:
+                    n = len(next(iter(cols.values()))) if cols else 0
+                    for c in phys:
+                        data[c][at : at + n] = cols[c]
+                    valid[at : at + n] = True
+                    at += n
+                batches.append(
+                    ColumnBatch(
+                        {c: jnp.asarray(v) for c, v in data.items()},
+                        jnp.asarray(valid),
+                    )
+                )
+            return D.shard_batch(ColumnBatch.concatenate(batches), self.mesh)
+        raise RuntimeError(f"unknown binding kind {kind}")
+
+    def _execute_device(self, query: Query) -> ColumnBatch:
+        graph = lower([query.node], self.config)
+        bindings = {
+            nid: self._bind_device(n) for nid, n in graph.inputs.items()
+        }
+        results = self.executor.execute(graph, bindings)
+        sid, oidx = graph.outputs[query.node.id]
+        return results[(sid, oidx)]
+
+    def run_to_host(self, query: Query) -> Dict[str, np.ndarray]:
+        if self.local_debug:
+            from dryad_tpu.exec.localdebug import LocalDebugInterpreter
+
+            interp = LocalDebugInterpreter(self)
+            return interp.run_to_logical(query.node)
+        batch = self._execute_device(query)
+        return batch.to_numpy(query.schema, self.dictionary)
+
+    def submit(self, query: Query) -> JobHandle:
+        return JobHandle(self.run_to_host(query))
+
+    def to_store(self, query: Query, path: str) -> JobHandle:
+        """Execute and persist (reference ToStore + SubmitAndWait)."""
+        if self.local_debug:
+            table = self.run_to_host(query)
+            b = ColumnBatch.from_numpy(
+                query.schema, table,
+                capacity=len(next(iter(table.values()), [])),
+                dictionary=self.dictionary,
+            )
+            parts = [
+                {c: np.asarray(v) for c, v in b.data.items()}
+            ]
+            CIO.write_store(
+                path, parts, query.schema, self.dictionary,
+                self.config.intermediate_compression,
+            )
+            return JobHandle(table, path)
+        batch = self._execute_device(query)
+        P = num_partitions(self.mesh)
+        cap = batch.capacity // P
+        parts = []
+        valid = np.asarray(batch.valid)
+        host_cols = {c: np.asarray(v) for c, v in batch.data.items()}
+        for i in range(P):
+            sl = slice(i * cap, (i + 1) * cap)
+            m = valid[sl]
+            parts.append({c: v[sl][m] for c, v in host_cols.items()})
+        CIO.write_store(
+            path, parts, query.schema, self.dictionary,
+            self.config.intermediate_compression,
+        )
+        return JobHandle(batch.to_numpy(query.schema, self.dictionary), path)
+
+    # -- do_while support ----------------------------------------------------
+    def _run_subquery(self, plan_fn, schema: Schema, current: ColumnBatch, scalar: bool = False):
+        q0 = self._from_device_batch(current, schema)
+        out_q = plan_fn(q0)
+        if scalar:
+            table = out_q.collect()
+            col = next(iter(table.values()))
+            return bool(col[0]) if len(col) else False
+        return self._execute_device(out_q)
+
+
+def _phys_dtype(col: str, schema: Schema) -> np.dtype:
+    if "#" in col:
+        return np.dtype(np.uint32)
+    f = schema.field(col)
+    return {
+        ColumnType.INT32: np.dtype(np.int32),
+        ColumnType.FLOAT32: np.dtype(np.float32),
+        ColumnType.BOOL: np.dtype(np.bool_),
+        ColumnType.UINT32: np.dtype(np.uint32),
+    }[f.ctype]

@@ -1,0 +1,35 @@
+"""User-defined decomposable aggregations.
+
+The analog of the reference's ``IDecomposable`` contract
+(``LinqToDryad/IDecomposable.cs:35-71``): an aggregation splits into
+Seed (per-row initial accumulator), Accumulate/RecursiveAccumulate
+(associative merge of accumulators — one fn here since accumulators are
+columns), and FinalReduce (finalize).  The optimizer uses this to build
+the partial-aggregation tree: local combine before the shuffle, final
+combine after (``DryadLinqDecomposition.cs:34``;
+``DrDynamicAggregateManager.h:117-168``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from dryad_tpu.columnar.schema import ColumnType
+
+
+@dataclasses.dataclass
+class Decomposable:
+    """seed: cols -> state cols (vectorized over rows).
+    merge: (state_a, state_b) -> state (associative, vectorized).
+    finalize: cols -> cols (optional; runs after the final combine).
+    state_cols: physical state column names produced by ``seed``.
+    out_fields: logical (name, ColumnType) list for the final output
+    columns (after ``finalize`` if present, else the state columns).
+    """
+
+    seed: Callable[[Dict], Dict]
+    merge: Callable[[Dict, Dict], Dict]
+    state_cols: Sequence[str]
+    out_fields: Sequence[Tuple[str, ColumnType]]
+    finalize: Optional[Callable[[Dict], Dict]] = None

@@ -1,0 +1,476 @@
+"""Query — the lazy table handle and operator surface.
+
+The analog of ``DryadLinqQuery<T>`` + the ``DryadLinqQueryable``
+extension-method surface (``LinqToDryad/DryadLinqQuery.cs:299``,
+``DryadLinqQueryable.cs:39``): a Query wraps a logical plan node;
+operators build new nodes; ``collect``/``submit`` trigger lowering and
+execution through the context.  Operator parity map (reference op ->
+here): Select->select, Where->where, SelectMany->select_many,
+GroupBy->group_by, Join/GroupJoin->join/group_join_count,
+OrderBy/ThenBy->order_by, Distinct->distinct, Concat->concat,
+Union/Intersect/Except->union/intersect/except_, HashPartition->
+hash_partition, RangePartition->range_partition, Apply/
+ApplyPerPartition->apply, ApplyWithPartitionIndex->apply(with_index),
+Fork->fork, DoWhile->do_while, Take->take, Count/Sum/Min/Max/Average->
+count/sum_/min_/max_/mean (+ *_as_query lazy forms), Zip->zip_,
+SlidingWindow->sliding_window, Assume{Hash,Range}Partition->
+assume_hash_partition/assume_range_partition, ToStore/Submit->
+to_store/submit/collect.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from dryad_tpu.api.decomposable import Decomposable
+from dryad_tpu.columnar.schema import ColumnType, Schema
+from dryad_tpu.plan import infer
+from dryad_tpu.plan.nodes import Node, PartitionInfo
+
+KeyArg = Union[str, Sequence[str]]
+OrderArg = Union[str, Tuple[str, bool]]
+
+_AGG_TYPE_RULES = {
+    "count": lambda ct: ColumnType.INT32,
+    "sum": lambda ct: ct,
+    "min": lambda ct: ct,
+    "max": lambda ct: ct,
+    "first": lambda ct: ct,
+    "mean": lambda ct: ColumnType.FLOAT32,
+    "any": lambda ct: ColumnType.BOOL,
+    "all": lambda ct: ColumnType.BOOL,
+}
+
+
+def _keys(k: KeyArg) -> List[str]:
+    return [k] if isinstance(k, str) else list(k)
+
+
+def _order_keys(keys: Sequence[OrderArg]) -> List[Tuple[str, bool]]:
+    out: List[Tuple[str, bool]] = []
+    for k in keys:
+        out.append((k, False) if isinstance(k, str) else (k[0], bool(k[1])))
+    return out
+
+
+class Query:
+    """Lazy distributed table: a logical plan node plus its context."""
+
+    def __init__(self, ctx, node: Node):
+        self.ctx = ctx
+        self.node = node
+
+    @property
+    def schema(self) -> Schema:
+        return self.node.schema
+
+    def _require_cols(self, names: Sequence[str], where: str = "") -> None:
+        missing = [n for n in names if n not in self.schema]
+        if missing:
+            raise ValueError(
+                f"unknown column(s) {missing} {where}; have {self.schema.names}"
+            )
+
+    # -- row-wise operators -----------------------------------------------
+    def select(self, fn: Callable[[Dict], Dict], schema: Optional[Schema] = None) -> "Query":
+        """Projection/map over physical columns (reference Select).
+
+        Partition metadata is dropped: ``fn`` may rewrite key *values*
+        even when the key *name* survives, which would make shuffle
+        elision silently wrong.  Use ``project`` (name-only projection)
+        or ``assume_*_partition`` to retain metadata.
+        """
+        out_schema = schema or infer.infer_select_schema(self.schema, fn)
+        node = Node("select", [self.node], out_schema, PartitionInfo(), fn=fn)
+        return Query(self.ctx, node)
+
+    def project(self, names: KeyArg) -> "Query":
+        """Column projection by name."""
+        names = _keys(names)
+        out_schema = self.schema.select(names)
+        phys = out_schema.device_names()
+
+        def fn(cols: Dict) -> Dict:
+            return {c: cols[c] for c in phys}
+
+        keep = self.node.partition
+        if keep.keys and not all(k in out_schema for k in keep.keys):
+            keep = PartitionInfo()
+        return Query(self.ctx, Node("select", [self.node], out_schema, keep, fn=fn))
+
+    def where(self, fn: Callable[[Dict], Any]) -> "Query":
+        node = Node("where", [self.node], self.schema, self.node.partition, fn=fn)
+        return Query(self.ctx, node)
+
+    def select_many(
+        self,
+        fn: Callable[[Dict], Tuple[Dict, Any]],
+        factor: int,
+        schema: Optional[Schema] = None,
+    ) -> "Query":
+        """Flat-map: fn maps each row to ``factor`` rows.
+
+        fn(cols) -> (out_cols each shaped (n, factor, ...), valid (n, factor)).
+        """
+        out_schema = schema or infer.infer_select_many_schema(self.schema, fn, factor)
+        node = Node(
+            "select_many", [self.node], out_schema, PartitionInfo(),
+            fn=fn, factor=int(factor),
+        )
+        return Query(self.ctx, node)
+
+    # -- grouping / aggregation -------------------------------------------
+    def group_by(
+        self,
+        keys: KeyArg,
+        aggs: Optional[Dict[str, Tuple[str, Optional[str]]]] = None,
+        decomposable: Optional[Decomposable] = None,
+    ) -> "Query":
+        """GroupBy with builtin aggregates or a Decomposable.
+
+        ``aggs``: out_name -> (op, col) with op in
+        sum|count|min|max|mean|first|any|all (col None for count).
+        """
+        keys = _keys(keys)
+        fields: List[Tuple[str, ColumnType]] = [
+            (k, self.schema.field(k).ctype) for k in keys
+        ]
+        if decomposable is not None:
+            fields += list(decomposable.out_fields)
+            node = Node(
+                "group_by", [self.node], Schema(fields),
+                PartitionInfo.hashed(keys), keys=keys, decomposable=decomposable,
+            )
+            return Query(self.ctx, node)
+        if not aggs:
+            raise ValueError("group_by needs aggs or a decomposable")
+        agg_list = []
+        for out_name, (op, col) in aggs.items():
+            if op not in _AGG_TYPE_RULES:
+                raise ValueError(f"unknown aggregate {op!r}")
+            ct = self.schema.field(col).ctype if col is not None else ColumnType.INT32
+            fields.append((out_name, _AGG_TYPE_RULES[op](ct)))
+            agg_list.append((op, col, out_name))
+        node = Node(
+            "group_by", [self.node], Schema(fields),
+            PartitionInfo.hashed(keys), keys=keys, aggs=agg_list,
+        )
+        return Query(self.ctx, node)
+
+    def distinct(self, keys: Optional[KeyArg] = None) -> "Query":
+        keys = _keys(keys) if keys is not None else self.schema.names
+        node = Node(
+            "distinct", [self.node], self.schema,
+            PartitionInfo.hashed(keys), keys=keys,
+        )
+        return Query(self.ctx, node)
+
+    # -- joins --------------------------------------------------------------
+    def join(
+        self,
+        other: "Query",
+        left_keys: KeyArg,
+        right_keys: Optional[KeyArg] = None,
+        expansion: float = 4.0,
+        suffix: str = "_r",
+    ) -> "Query":
+        """Inner equi-join (reference Join): co-hash-partition + local join."""
+        lk = _keys(left_keys)
+        rk = _keys(right_keys) if right_keys is not None else lk
+        self._require_cols(lk, "in join left keys")
+        other._require_cols(rk, "in join right keys")
+        fields = [(f.name, f.ctype) for f in self.schema.fields]
+        lnames = {f.name for f in self.schema.fields}
+        for f in other.schema.fields:
+            if f.name in rk:
+                continue
+            name = f.name if f.name not in lnames else f"{f.name}{suffix}"
+            fields.append((name, f.ctype))
+        node = Node(
+            "join", [self.node, other.node], Schema(fields),
+            PartitionInfo.hashed(lk),
+            left_keys=lk, right_keys=rk, join_kind="inner",
+            expansion=expansion, suffix=suffix,
+        )
+        return Query(self.ctx, node)
+
+    def semi_join(
+        self, other: "Query", left_keys: KeyArg,
+        right_keys: Optional[KeyArg] = None, expansion: float = 4.0,
+    ) -> "Query":
+        return self._semi(other, left_keys, right_keys, expansion, anti=False)
+
+    def anti_join(
+        self, other: "Query", left_keys: KeyArg,
+        right_keys: Optional[KeyArg] = None, expansion: float = 4.0,
+    ) -> "Query":
+        return self._semi(other, left_keys, right_keys, expansion, anti=True)
+
+    def _semi(self, other, left_keys, right_keys, expansion, anti) -> "Query":
+        lk = _keys(left_keys)
+        rk = _keys(right_keys) if right_keys is not None else lk
+        self._require_cols(lk, "in join left keys")
+        other._require_cols(rk, "in join right keys")
+        node = Node(
+            "join", [self.node, other.node], self.schema,
+            PartitionInfo.hashed(lk),
+            left_keys=lk, right_keys=rk,
+            join_kind="anti" if anti else "semi", expansion=expansion,
+        )
+        return Query(self.ctx, node)
+
+    # -- set operations (reference Union/Intersect/Except) -------------------
+    def concat(self, *others: "Query") -> "Query":
+        for o in others:
+            if o.schema.names != self.schema.names:
+                raise ValueError("concat requires identical schemas")
+        node = Node(
+            "concat", [self.node] + [o.node for o in others], self.schema,
+            PartitionInfo(),
+        )
+        return Query(self.ctx, node)
+
+    def union(self, other: "Query") -> "Query":
+        return self.concat(other).distinct()
+
+    def intersect(self, other: "Query") -> "Query":
+        return self.distinct().semi_join(other, self.schema.names)
+
+    def except_(self, other: "Query") -> "Query":
+        return self.distinct().anti_join(other, self.schema.names)
+
+    # -- partitioning -------------------------------------------------------
+    def hash_partition(self, keys: KeyArg) -> "Query":
+        keys = _keys(keys)
+        node = Node(
+            "hash_partition", [self.node], self.schema,
+            PartitionInfo.hashed(keys), keys=keys,
+        )
+        return Query(self.ctx, node)
+
+    def range_partition(self, keys: KeyArg) -> "Query":
+        ks = _order_keys(_keys(keys))
+        self._require_cols([n for n, _ in ks], "in range_partition")
+        node = Node(
+            "range_partition", [self.node], self.schema,
+            PartitionInfo.ranged(ks), keys=ks,
+        )
+        return Query(self.ctx, node)
+
+    def assume_hash_partition(self, keys: KeyArg) -> "Query":
+        node = Node(
+            "assume_partition", [self.node], self.schema,
+            PartitionInfo.hashed(_keys(keys)),
+        )
+        return Query(self.ctx, node)
+
+    def assume_range_partition(self, keys: KeyArg) -> "Query":
+        node = Node(
+            "assume_partition", [self.node], self.schema,
+            PartitionInfo.ranged(_order_keys(_keys(keys))),
+        )
+        return Query(self.ctx, node)
+
+    def assume_order_by(self, keys: Sequence[OrderArg]) -> "Query":
+        ks = _order_keys(keys)
+        node = Node(
+            "assume_partition", [self.node], self.schema,
+            PartitionInfo.ranged(ks, ks),
+        )
+        return Query(self.ctx, node)
+
+    # -- ordering -----------------------------------------------------------
+    def order_by(self, keys: Sequence[OrderArg]) -> "Query":
+        """Global sort: range partition + local sort (reference
+        OrderBy/ThenBy chain collapses into one keys list)."""
+        ks = _order_keys(keys)
+        self._require_cols([n for n, _ in ks], "in order_by")
+        node = Node(
+            "order_by", [self.node], self.schema,
+            PartitionInfo.ranged(ks, ks), keys=ks,
+        )
+        return Query(self.ctx, node)
+
+    def take(self, n: int) -> "Query":
+        node = Node("take", [self.node], self.schema, self.node.partition, n=int(n))
+        return Query(self.ctx, node)
+
+    def group_join_count(
+        self,
+        other: "Query",
+        left_keys: KeyArg,
+        right_keys: Optional[KeyArg] = None,
+        out: str = "match_count",
+        expansion: float = 4.0,
+    ) -> "Query":
+        """GroupJoin's aggregate shape (reference GroupJoin): per left
+        row, the count of matching right rows as a new INT32 column.
+        Richer group aggregations compose via join + group_by."""
+        lk = _keys(left_keys)
+        rk = _keys(right_keys) if right_keys is not None else lk
+        self._require_cols(lk, "in group_join left keys")
+        other._require_cols(rk, "in group_join right keys")
+        fields = [(f.name, f.ctype) for f in self.schema.fields]
+        fields.append((out, ColumnType.INT32))
+        node = Node(
+            "join", [self.node, other.node], Schema(fields),
+            PartitionInfo.hashed(lk),
+            left_keys=lk, right_keys=rk, join_kind="count",
+            expansion=expansion, out=out,
+        )
+        return Query(self.ctx, node)
+
+    def zip_(self, other: "Query", suffix: str = "_r") -> "Query":
+        """Pair rows by global position (reference Zip,
+        ``DryadLinqQueryGen.cs`` Zip dispatch): result length is the
+        shorter input's length (LINQ Zip semantics)."""
+        fields = [(f.name, f.ctype) for f in self.schema.fields]
+        lnames = {f.name for f in self.schema.fields}
+        for f in other.schema.fields:
+            name = f.name if f.name not in lnames else f"{f.name}{suffix}"
+            fields.append((name, f.ctype))
+        node = Node(
+            "zip", [self.node, other.node], Schema(fields), PartitionInfo(),
+            suffix=suffix,
+        )
+        return Query(self.ctx, node)
+
+    def sliding_window(self, size: int, cols: Optional[KeyArg] = None) -> "Query":
+        """Sliding windows over the global row sequence (reference
+        SlidingWindow, ``DryadLinqQueryable.cs:1318``): for each window
+        of ``size`` consecutive rows, emit columns ``{c}_w{j}`` (j-th
+        row of the window).  Restricted to non-split (numeric/bool)
+        columns; yields n-size+1 windows.
+        """
+        cols = _keys(cols) if cols is not None else self.schema.names
+        self._require_cols(cols, "in sliding_window")
+        fields: List[Tuple[str, ColumnType]] = []
+        for c in cols:
+            ct = self.schema.field(c).ctype
+            if ct.is_split:
+                raise ValueError(
+                    f"sliding_window unsupported on {ct.value} column {c!r}"
+                )
+            for j in range(size):
+                fields.append((f"{c}_w{j}", ct))
+        node = Node(
+            "sliding_window", [self.node], Schema(fields), PartitionInfo(),
+            size=int(size), cols=cols,
+        )
+        return Query(self.ctx, node)
+
+    # -- escape hatches ------------------------------------------------------
+    def apply(
+        self,
+        fn: Callable,
+        schema: Optional[Schema] = None,
+        cap_factor: float = 1.0,
+        with_index: bool = False,
+    ) -> "Query":
+        """Per-partition user function over a ColumnBatch (reference
+        Apply/ApplyPerPartition; with_index = ApplyWithPartitionIndex)."""
+        node = Node(
+            "apply", [self.node], schema or self.schema, PartitionInfo(),
+            fn=fn, cap_factor=cap_factor, with_index=with_index,
+        )
+        return Query(self.ctx, node)
+
+    def fork(self, fn: Callable, out_schemas: Sequence[Schema]) -> Tuple["Query", ...]:
+        """Multi-output per-partition function (reference Fork,
+        ``DryadLinqQueryable.cs:3717``): fn(batch) -> tuple of batches."""
+        fork_node = Node(
+            "fork", [self.node], self.schema, PartitionInfo(),
+            fn=fn, out_schemas=list(out_schemas),
+        )
+        outs = []
+        for i, s in enumerate(out_schemas):
+            branch = Node(
+                "fork_branch", [fork_node], s, PartitionInfo(), index=i
+            )
+            outs.append(Query(self.ctx, branch))
+        return tuple(outs)
+
+    def do_while(
+        self,
+        body: Callable[["Query"], "Query"],
+        cond: Callable[["Query"], "Query"],
+        max_iter: int = 100,
+    ) -> "Query":
+        """Iterate body until cond yields False (reference DoWhile,
+        ``DryadLinqQueryable.cs:1281``). ``cond`` maps the current
+        dataset to a 1-row bool query (e.g. via count_as_query + select)."""
+        node = Node(
+            "do_while", [self.node], self.schema, PartitionInfo(),
+            body=body, cond=cond, max_iter=max_iter,
+        )
+        return Query(self.ctx, node)
+
+    # -- scalar aggregates ---------------------------------------------------
+    def _aggregate_node(self, aggs: List[Tuple[str, Optional[str], str]]) -> Node:
+        fields = []
+        for op, col, out in aggs:
+            ct = self.schema.field(col).ctype if col else ColumnType.INT32
+            fields.append((out, _AGG_TYPE_RULES[op](ct)))
+        return Node(
+            "aggregate", [self.node], Schema(fields), PartitionInfo(), aggs=aggs
+        )
+
+    def aggregate_as_query(self, aggs: Dict[str, Tuple[str, Optional[str]]]) -> "Query":
+        lst = [(op, col, out) for out, (op, col) in aggs.items()]
+        return Query(self.ctx, self._aggregate_node(lst))
+
+    def count_as_query(self) -> "Query":
+        return self.aggregate_as_query({"count": ("count", None)})
+
+    def _scalar(self, op: str, col: Optional[str]):
+        q = self.aggregate_as_query({"v": (op, col)})
+        table = q.collect()
+        return table["v"][0].item() if len(table["v"]) else None
+
+    def count(self) -> int:
+        return int(self._scalar("count", None))
+
+    def sum_(self, col: str):
+        return self._scalar("sum", col)
+
+    def min_(self, col: str):
+        return self._scalar("min", col)
+
+    def max_(self, col: str):
+        return self._scalar("max", col)
+
+    def mean(self, col: str) -> float:
+        return float(self._scalar("mean", col))
+
+    def any_(self, col: str) -> bool:
+        return bool(self._scalar("any", col))
+
+    def all_(self, col: str) -> bool:
+        return bool(self._scalar("all", col))
+
+    # -- materialization -----------------------------------------------------
+    def collect(self) -> Dict[str, np.ndarray]:
+        """Execute and fetch host logical columns (reference
+        Submit+enumerate path, ``DryadLinqQuery.cs:608``)."""
+        return self.ctx.run_to_host(self)
+
+    def submit(self) -> "JobHandle":
+        return self.ctx.submit(self)
+
+    def to_store(self, path: str) -> "JobHandle":
+        """Execute and persist as a partitioned store (reference ToStore,
+        ``DryadLinqQueryable.cs:3909``)."""
+        return self.ctx.to_store(self, path)
+
+
+class JobHandle:
+    """Completed-job handle (reference SubmitAndWait returns job info)."""
+
+    def __init__(self, table: Dict[str, np.ndarray], path: Optional[str] = None):
+        self.table = table
+        self.path = path
+
+    def result(self) -> Dict[str, np.ndarray]:
+        return self.table

@@ -170,9 +170,16 @@ class ColumnBatch:
             if f.ctype == ColumnType.STRING:
                 if dictionary is None:
                     raise ValueError(f"STRING column {f.name} needs a dictionary")
-                hashes = dictionary.add_all([str(s) for s in a])
+                from dryad_tpu.columnar.schema import string_prefix_rank
+
+                strs = [str(s) for s in a]
+                hashes = dictionary.add_all(strs)
                 lo, hi = split64(hashes)
-                phys = {f"{f.name}#h0": lo, f"{f.name}#h1": hi}
+                phys = {
+                    f"{f.name}#h0": lo,
+                    f"{f.name}#h1": hi,
+                    f"{f.name}#r0": string_prefix_rank(np.array(strs, object)),
+                }
             elif f.ctype == ColumnType.INT64:
                 lo, hi = split64(a.astype(np.int64))
                 phys = {f"{f.name}#h0": lo, f"{f.name}#h1": hi}

@@ -1,0 +1,111 @@
+"""Partitioned columnar stores on the filesystem.
+
+The analog of the reference's partitioned-table data providers
+(``LinqToDryad/DataProvider.cs``, partfile scheme ``DataPath.cs``;
+metadata ``DryadLinqMetaData.cs``): a store is a directory with a JSON
+manifest (logical schema, partition count, compression), one ``.dpf``
+columnar partition file per partition, and the string dictionary.
+
+``.dpf`` format (implemented natively in ``runtime/native`` too):
+one JSON header line (column name, dtype, row count, compressed byte
+length per column) terminated by ``\\n``, then each column's payload —
+little-endian raw array bytes, zlib-compressed when ``comp='zlib'``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dryad_tpu.columnar.schema import ColumnType, Schema, StringDictionary
+
+MANIFEST = "manifest.json"
+DICTFILE = "dictionary.json"
+
+
+def _part_name(i: int) -> str:
+    return f"part-{i:05d}.dpf"
+
+
+def write_partition_file(
+    path: str, cols: Dict[str, np.ndarray], compression: Optional[str] = None
+) -> None:
+    names = list(cols.keys())
+    rows = len(cols[names[0]]) if names else 0
+    payloads: List[bytes] = []
+    header = {"rows": rows, "columns": []}
+    for n in names:
+        a = np.ascontiguousarray(cols[n])
+        raw = a.tobytes()
+        comp = compression or "none"
+        data = zlib.compress(raw) if comp == "zlib" else raw
+        header["columns"].append(
+            {"name": n, "dtype": str(a.dtype), "rows": rows,
+             "comp": comp, "nbytes": len(data)}
+        )
+        payloads.append(data)
+    with open(path, "wb") as fh:
+        fh.write((json.dumps(header) + "\n").encode("utf-8"))
+        for p in payloads:
+            fh.write(p)
+
+
+def read_partition_file(path: str) -> Dict[str, np.ndarray]:
+    # The native runtime provides a faster reader for the same format.
+    with open(path, "rb") as fh:
+        header = json.loads(fh.readline().decode("utf-8"))
+        out: Dict[str, np.ndarray] = {}
+        for c in header["columns"]:
+            data = fh.read(c["nbytes"])
+            if c["comp"] == "zlib":
+                data = zlib.decompress(data)
+            out[c["name"]] = np.frombuffer(data, dtype=np.dtype(c["dtype"])).copy()
+    return out
+
+
+def write_store(
+    path: str,
+    partitions: List[Dict[str, np.ndarray]],
+    schema: Schema,
+    dictionary: Optional[StringDictionary] = None,
+    compression: Optional[str] = None,
+) -> None:
+    os.makedirs(path, exist_ok=True)
+    manifest = {
+        "version": 1,
+        "partitions": len(partitions),
+        "compression": compression or "none",
+        "schema": [[f.name, f.ctype.value] for f in schema.fields],
+    }
+    with open(os.path.join(path, MANIFEST), "w") as fh:
+        json.dump(manifest, fh, indent=1)
+    if dictionary is not None:
+        with open(os.path.join(path, DICTFILE), "w") as fh:
+            json.dump({format(h, "016x"): s for h, s in dictionary.items()}, fh)
+    for i, cols in enumerate(partitions):
+        write_partition_file(
+            os.path.join(path, _part_name(i)), cols, compression
+        )
+
+
+def read_store(
+    path: str,
+) -> Tuple[Schema, List[Dict[str, np.ndarray]], StringDictionary]:
+    with open(os.path.join(path, MANIFEST)) as fh:
+        manifest = json.load(fh)
+    schema = Schema([(n, ColumnType(t)) for n, t in manifest["schema"]])
+    dictionary = StringDictionary()
+    dpath = os.path.join(path, DICTFILE)
+    if os.path.exists(dpath):
+        with open(dpath) as fh:
+            for h, s in json.load(fh).items():
+                dictionary._map[int(h, 16)] = s
+    parts = [
+        read_partition_file(os.path.join(path, _part_name(i)))
+        for i in range(manifest["partitions"])
+    ]
+    return schema, parts, dictionary

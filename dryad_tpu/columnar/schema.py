@@ -33,7 +33,7 @@ class ColumnType(enum.Enum):
 
     @property
     def is_split(self) -> bool:
-        """True when the logical column maps to two uint32 device columns."""
+        """True when the logical column maps to multiple uint32 device columns."""
         return self in (ColumnType.INT64, ColumnType.STRING)
 
     @property
@@ -49,10 +49,31 @@ class ColumnType(enum.Enum):
 
 
 def device_column_names(name: str, ctype: ColumnType) -> List[str]:
-    """Physical device-column names backing one logical column."""
-    if ctype.is_split:
+    """Physical device-column names backing one logical column.
+
+    INT64  -> ``#h0`` (low word), ``#h1`` (high word).
+    STRING -> ``#h0``/``#h1`` (Hash64 words, the identity) plus ``#r0``,
+    an order-preserving uint32 rank of the first 4 UTF-8 bytes
+    (big-endian), so range partitioning / OrderBy on strings is exact on
+    4-byte prefixes with hash-order tie-breaking beyond that.
+    """
+    if ctype == ColumnType.STRING:
+        return [f"{name}#h0", f"{name}#h1", f"{name}#r0"]
+    if ctype == ColumnType.INT64:
         return [f"{name}#h0", f"{name}#h1"]
     return [name]
+
+
+def string_prefix_rank(strings: "np.ndarray") -> "np.ndarray":
+    """uint32 big-endian rank of the first 4 UTF-8 bytes of each string."""
+    out = np.zeros(len(strings), np.uint32)
+    for i, s in enumerate(strings):
+        b = str(s).encode("utf-8")[:4]
+        r = 0
+        for j in range(4):
+            r = (r << 8) | (b[j] if j < len(b) else 0)
+        out[i] = r
+    return out
 
 
 FNV_OFFSET = 0xCBF29CE484222325

@@ -1,0 +1,57 @@
+"""Append-only job event log — the Calypso reporter analog.
+
+The reference GM appends timestamped job events (process/vertex state
+transitions, final topology) to ``calypso.log`` in the job's DFS
+directory (``GraphManager/reporting/DrCalypsoReporting.cpp``), consumed
+post-hoc by the JobBrowser.  Here: JSONL events per job, consumed by
+``dryad_tpu.tools.jobview``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+class EventLog:
+    """Thread-safe append-only JSONL event sink."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self._lock = threading.Lock()
+        self._mem: List[Dict[str, Any]] = []
+        if path:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            self._fh = open(path, "a", buffering=1)
+        else:
+            self._fh = None
+
+    def emit(self, kind: str, **fields: Any) -> None:
+        ev = {"ts": time.time(), "kind": kind, **fields}
+        with self._lock:
+            self._mem.append(ev)
+            if self._fh:
+                self._fh.write(json.dumps(ev, default=str) + "\n")
+
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._mem)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh:
+                self._fh.close()
+                self._fh = None
+
+    @staticmethod
+    def load(path: str) -> List[Dict[str, Any]]:
+        out = []
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if line:
+                    out.append(json.loads(line))
+        return out

@@ -1,0 +1,212 @@
+"""GraphExecutor — the job manager.
+
+The TPU-native GraphManager (reference ``GraphManager/vertex/DrGraph.h:75``,
+``DrGraphExecutor.cpp:15-65``): executes the stage DAG in dependency
+order.  Where the reference schedules per-vertex processes with cohorts,
+property mailboxes and channel files, this driver launches one compiled
+SPMD program per stage on the mesh and keeps intermediates in HBM.
+
+Fault tolerance keeps the reference *semantics* in TPU form:
+- versioned re-execution with a failure budget
+  (``DrVertexRecord.h:164-194`` version generator; ``DrGraph.h:42``
+  m_maxActiveFailureCount) — each stage attempt is a numbered version;
+  injected/real failures re-run it, and the budget aborts the job;
+- adaptive shapes: shuffle/join overflow is a *retryable* outcome that
+  re-compiles the stage with a boosted capacity from a bounded palette
+  (the dynamic fan-out sizing of ``DrDynamicRangeDistributor.cpp:54``
+  turned into a shape-palette choice);
+- per-stage duration statistics feed the straggler model
+  (``exec.stats``) and every transition lands in the event log
+  (``exec.events``, the Calypso reporter analog).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+
+from dryad_tpu.columnar.batch import ColumnBatch
+from dryad_tpu.exec import faults
+from dryad_tpu.exec.events import EventLog
+from dryad_tpu.exec.kernels import build_stage_fn
+from dryad_tpu.exec.stats import StageStatistics
+from dryad_tpu.parallel.mesh import num_partitions
+from dryad_tpu.parallel.stage import compile_stage
+from dryad_tpu.plan.lower import Stage, StageGraph
+from dryad_tpu.utils.config import DryadConfig
+from dryad_tpu.utils.logging import get_logger
+
+log = get_logger("dryad_tpu.exec")
+
+
+class StageFailedError(RuntimeError):
+    pass
+
+
+class GraphExecutor:
+    def __init__(
+        self,
+        mesh,
+        config: Optional[DryadConfig] = None,
+        events: Optional[EventLog] = None,
+        subquery_runner: Optional[Callable] = None,
+    ):
+        self.mesh = mesh
+        self.config = config or DryadConfig()
+        self.events = events or EventLog(None)
+        self.P = num_partitions(mesh)
+        self._compiled: Dict[Tuple, Any] = {}
+        self.stats: Dict[str, StageStatistics] = {}
+        # Callback used by do_while stages to run body/cond subplans.
+        self.subquery_runner = subquery_runner
+
+    # -- compilation cache -------------------------------------------------
+    def _get_compiled(self, stage: Stage, boost: int, shape_key: Tuple):
+        key = (stage.id, boost, shape_key)
+        hit = self._compiled.get(key)
+        if hit is None:
+            fn = build_stage_fn(stage, self.P, self.config.shuffle_slack, boost)
+            hit = compile_stage(self.mesh, fn)
+            self._compiled[key] = hit
+        return hit
+
+    @staticmethod
+    def _shape_key(inputs: Tuple[ColumnBatch, ...]) -> Tuple:
+        return tuple(
+            (tuple(sorted(b.data.keys())), b.capacity) for b in inputs
+        )
+
+    # -- execution ---------------------------------------------------------
+    def execute(
+        self,
+        graph: StageGraph,
+        bindings: Dict[int, ColumnBatch],
+    ) -> Dict[Tuple[int, int], ColumnBatch]:
+        """Run all stages; returns (stage_id, out_idx) -> output batch.
+
+        ``bindings``: plan-input node id -> mesh-sharded global batch.
+        """
+        self.events.emit("job_start", stages=len(graph.stages))
+        results: Dict[Tuple[int, int], ColumnBatch] = {}
+        for stage in graph.stages:
+            if stage.ops and stage.ops[0].kind == "do_while":
+                self._run_do_while(stage, graph, bindings, results)
+                continue
+            self._run_stage(stage, graph, bindings, results)
+        self.events.emit("job_complete")
+        return results
+
+    def _resolve_inputs(
+        self,
+        stage: Stage,
+        bindings: Dict[int, ColumnBatch],
+        results: Dict[Tuple[int, int], ColumnBatch],
+    ) -> Tuple[ColumnBatch, ...]:
+        ins: List[ColumnBatch] = []
+        for ref, idx in stage.input_refs:
+            if ref == "plan_input":
+                ins.append(bindings[idx])
+            else:
+                ins.append(results[(ref, idx)])
+        return tuple(ins)
+
+    def _run_stage(
+        self,
+        stage: Stage,
+        graph: StageGraph,
+        bindings: Dict[int, ColumnBatch],
+        results: Dict[Tuple[int, int], ColumnBatch],
+    ) -> None:
+        inputs = self._resolve_inputs(stage, bindings, results)
+        shape_key = self._shape_key(inputs)
+        st = self.stats.setdefault(stage.name, StageStatistics(self.config.outlier_sigmas))
+
+        boost = 1
+        failures = 0
+        version = 0
+        while True:
+            version += 1
+            self.events.emit(
+                "stage_start", stage=stage.id, name=stage.name, version=version, boost=boost
+            )
+            t0 = time.time()
+            try:
+                faults.registry.maybe_fail(stage.name)
+                fn = self._get_compiled(stage, boost, shape_key)
+                outs, (overflow,) = fn(inputs, ())
+                overflow = bool(overflow)
+            except faults.InjectedStageFailure as e:
+                failures += 1
+                self.events.emit(
+                    "stage_failed", stage=stage.id, name=stage.name,
+                    version=version, error=str(e), failures=failures,
+                )
+                if failures >= self.config.max_stage_failures:
+                    self.events.emit("job_failed", stage=stage.id, name=stage.name)
+                    raise StageFailedError(
+                        f"stage {stage.name!r} exceeded failure budget "
+                        f"({self.config.max_stage_failures}): {e}"
+                    ) from e
+                continue  # versioned re-execution
+
+            dt = time.time() - t0
+            st.record(dt)
+            if st.is_outlier(dt):
+                self.events.emit(
+                    "stage_straggler", stage=stage.id, name=stage.name,
+                    version=version, seconds=dt,
+                    threshold=st.outlier_threshold(),
+                )
+            if overflow:
+                self.events.emit(
+                    "stage_overflow", stage=stage.id, name=stage.name,
+                    version=version, boost=boost,
+                )
+                if boost >= 2 ** self.config.max_shuffle_retries:
+                    self.events.emit("job_failed", stage=stage.id, name=stage.name)
+                    raise StageFailedError(
+                        f"stage {stage.name!r} still overflowing at boost {boost}; "
+                        f"raise shuffle_slack or partition count"
+                    )
+                boost *= 2
+                continue  # adaptive re-shape
+
+            self.events.emit(
+                "stage_complete", stage=stage.id, name=stage.name,
+                version=version, seconds=dt,
+            )
+            for i, out_idx in enumerate(range(len(stage.out_slots))):
+                results[(stage.id, out_idx)] = outs[i]
+            return
+
+    def _run_do_while(
+        self,
+        stage: Stage,
+        graph: StageGraph,
+        bindings: Dict[int, ColumnBatch],
+        results: Dict[Tuple[int, int], ColumnBatch],
+    ) -> None:
+        """Driver-loop iteration (DoWhile, ``DryadLinqQueryNode.cs:4555``).
+
+        Each iteration re-lowers and runs the body subplan on the current
+        dataset; the cond subplan yields a host boolean to continue.
+        """
+        if self.subquery_runner is None:
+            raise RuntimeError("do_while requires a subquery_runner (use DryadContext)")
+        p = stage.ops[0].params
+        (current,) = self._resolve_inputs(stage, bindings, results)
+        max_iter = p["max_iter"]
+        it = 0
+        while True:
+            it += 1
+            if it > max_iter:
+                self.events.emit("do_while_max_iter", stage=stage.id, iters=it - 1)
+                break
+            self.events.emit("do_while_iter", stage=stage.id, iter=it)
+            current = self.subquery_runner(p["body"], p["schema"], current)
+            cont = self.subquery_runner(p["cond"], p["schema"], current, scalar=True)
+            if not bool(cont):
+                break
+        results[(stage.id, 0)] = current

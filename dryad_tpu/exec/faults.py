@@ -1,0 +1,50 @@
+"""Fault injection — the SetFakeVertexFailure analog.
+
+The reference exposes knobs to fake vertex / vertex-input failures for
+testing recovery paths (``DryadVertex/VertexHost/system/dprocess/
+include/dryadvertex.h:240,247``).  Here: a process-global registry the
+executor consults before running a stage attempt; an injected fault
+raises, exercising the versioned-retry path.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+
+class InjectedStageFailure(RuntimeError):
+    pass
+
+
+class _Registry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._by_stage: Dict[str, int] = {}
+
+    def set_fake_stage_failure(self, stage_name: str, count: int = 1) -> None:
+        """Fail the next ``count`` attempts of stages named ``stage_name``."""
+        with self._lock:
+            self._by_stage[stage_name] = count
+
+    def clear(self) -> None:
+        with self._lock:
+            self._by_stage.clear()
+
+    def maybe_fail(self, stage_name: str) -> None:
+        """Fail if any registered name matches the stage's fused-op name
+        (stage names are '+'-joined node kinds, e.g. 'input+group_by')."""
+        tokens = set(stage_name.split("+"))
+        with self._lock:
+            for key, n in self._by_stage.items():
+                if n > 0 and (key == stage_name or key in tokens):
+                    self._by_stage[key] = n - 1
+                    raise InjectedStageFailure(
+                        f"injected failure for stage {stage_name!r} "
+                        f"(key {key!r}, {n} remaining)"
+                    )
+
+
+registry = _Registry()
+set_fake_stage_failure = registry.set_fake_stage_failure
+clear_faults = registry.clear

@@ -1,0 +1,425 @@
+"""Per-partition kernels for each StageOp, composed into one stage fn.
+
+The analog of the generated vertex method body: where the reference
+CodeDOM-generates one C# method per stage chaining operator calls over
+channel readers/writers (``DryadLinqCodeGen.cs:1910`` AddVertexMethod),
+we compose jit-traceable kernels over ColumnBatch slots and let XLA fuse
+the chain.  All shapes are static: capacities derive from entry
+capacities, stage growth, and the executor's retry ``boost``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dryad_tpu.columnar.batch import ColumnBatch
+from dryad_tpu.ops import join as J
+from dryad_tpu.ops import segmented as SEG
+from dryad_tpu.ops import shuffle as SH
+from dryad_tpu.ops import sort as SORT
+from dryad_tpu.ops.hash import partition_ids
+from dryad_tpu.parallel.mesh import AXIS
+
+
+def _round8(n: float) -> int:
+    return max(8, int(math.ceil(n / 8.0)) * 8)
+
+
+class StageContext:
+    """Mutable trace-time state while composing one stage function."""
+
+    def __init__(self, P: int, slack: float, boost: int):
+        self.P = P
+        self.slack = slack
+        self.boost = boost
+        self.slots: Dict[int, ColumnBatch] = {}
+        self.entry_caps: Dict[int, int] = {}
+        self.overflow = jnp.zeros((), jnp.bool_)
+
+    def bind_inputs(self, batches: Tuple[ColumnBatch, ...]) -> None:
+        for i, b in enumerate(batches):
+            self.slots[i] = b
+            self.entry_caps[i] = b.capacity
+
+    def base_cap(self, slot: int) -> int:
+        return self.entry_caps.get(slot, max(self.entry_caps.values() or [64]))
+
+
+def apply_op(ctx: StageContext, kind: str, p: Dict[str, Any]) -> None:
+    fn = _KERNELS.get(kind)
+    if fn is None:
+        raise NotImplementedError(f"no kernel for stage op {kind!r}")
+    fn(ctx, p)
+
+
+# -- row-wise --------------------------------------------------------------
+
+def _k_select(ctx: StageContext, p) -> None:
+    b = ctx.slots[p["slot"]]
+    out_cols = p["fn"](dict(b.data))
+    ctx.slots[p["slot"]] = ColumnBatch(dict(out_cols), b.valid)
+
+
+def _k_where(ctx: StageContext, p) -> None:
+    b = ctx.slots[p["slot"]]
+    ctx.slots[p["slot"]] = b.filter(p["fn"](dict(b.data)))
+
+
+def _k_project(ctx: StageContext, p) -> None:
+    b = ctx.slots[p["slot"]]
+    ctx.slots[p["slot"]] = b.select(p["cols"])
+
+
+def _k_seed(ctx: StageContext, p) -> None:
+    b = ctx.slots[p["slot"]]
+    new_cols = p["fn"](dict(b.data))
+    data = dict(b.data)
+    data.update(new_cols)
+    ctx.slots[p["slot"]] = ColumnBatch(data, b.valid)
+
+
+def _k_select_many(ctx: StageContext, p) -> None:
+    b = ctx.slots[p["slot"]]
+    factor = int(p["factor"])
+    n = b.capacity
+    out_cols, out_valid = p["fn"](dict(b.data))
+    data = {}
+    for name, col in out_cols.items():
+        if col.shape[:2] != (n, factor):
+            raise ValueError(
+                f"select_many column {name!r} must be ({n},{factor},...), got {col.shape}"
+            )
+        data[name] = col.reshape((n * factor,) + col.shape[2:])
+    valid = (b.valid[:, None] & out_valid).reshape(n * factor)
+    ctx.slots[p["slot"]] = ColumnBatch(data, valid)
+
+
+def _k_apply(ctx: StageContext, p) -> None:
+    b = ctx.slots[p["slot"]]
+    if p.get("with_index"):
+        out = p["fn"](b, jax.lax.axis_index(AXIS))
+    else:
+        out = p["fn"](b)
+    if not isinstance(out, ColumnBatch):
+        raise TypeError("apply fn must return a ColumnBatch")
+    ctx.slots[p["slot"]] = out
+
+
+# -- exchanges -------------------------------------------------------------
+
+def _k_exchange_hash(ctx: StageContext, p) -> None:
+    b = ctx.slots[p["slot"]]
+    dest = partition_ids([b.data[k] for k in p["keys"]], ctx.P)
+    B = SH.bucket_capacity(b.capacity, ctx.P, ctx.slack * ctx.boost)
+    out, ovf = SH.exchange(b, dest, ctx.P, B, AXIS)
+    ctx.slots[p["slot"]] = out
+    ctx.overflow = ctx.overflow | ovf
+
+
+def _k_exchange_range(ctx: StageContext, p) -> None:
+    b = ctx.slots[p["slot"]]
+    operands = p["operands_fn"](b)
+    m = min(128, max(16, b.capacity // 8))
+    splitters = SORT.sample_splitters(operands[0], b.valid, ctx.P, m, AXIS)
+    dest = SORT.range_dest(operands[0], splitters)
+    B = SH.bucket_capacity(b.capacity, ctx.P, ctx.slack * ctx.boost)
+    out, ovf = SH.exchange(b, dest, ctx.P, B, AXIS)
+    ctx.slots[p["slot"]] = out
+    ctx.overflow = ctx.overflow | ovf
+
+
+def _k_resize(ctx: StageContext, p) -> None:
+    # Post-shuffle capacity: entry capacity x pipeline growth x retry
+    # boost x slack (hash placement has variance, so the uniform
+    # expectation alone overflows regularly).
+    b = ctx.slots[p["slot"]]
+    target = _round8(ctx.base_cap(p["slot"]) * p["factor"] * ctx.boost * ctx.slack)
+    out, ovf = SH.resize(b, target)
+    ctx.slots[p["slot"]] = out
+    ctx.overflow = ctx.overflow | ovf
+
+
+# -- grouping / sorting ----------------------------------------------------
+
+def _k_group_reduce(ctx: StageContext, p) -> None:
+    b = ctx.slots[p["slot"]]
+    ctx.slots[p["slot"]] = SEG.group_reduce(b, p["keys"], p["aggs"])
+
+
+def _k_group_combine(ctx: StageContext, p) -> None:
+    b = ctx.slots[p["slot"]]
+    ctx.slots[p["slot"]] = SEG.group_combine(
+        b, p["keys"], p["state_cols"], p["merge"]
+    )
+
+
+def _k_distinct(ctx: StageContext, p) -> None:
+    b = ctx.slots[p["slot"]]
+    ctx.slots[p["slot"]] = SEG.distinct(b, p["keys"])
+
+
+def _k_local_sort(ctx: StageContext, p) -> None:
+    b = ctx.slots[p["slot"]]
+    order = SORT.sort_order_by_operands(p["operands_fn"](b), b.valid)
+    ctx.slots[p["slot"]] = b.take(order)
+
+
+# -- multi-input -----------------------------------------------------------
+
+def _k_join(ctx: StageContext, p) -> None:
+    left = ctx.slots[p["left_slot"]]
+    right = ctx.slots[p["right_slot"]]
+    out_cap = _round8(
+        max(left.capacity, right.capacity) * p["expansion"] * ctx.boost
+    )
+    out, ovf = J.hash_join(
+        left, right, p["left_keys"], p["right_keys"], out_cap, p.get("suffix", "_r")
+    )
+    ctx.slots[p["left_slot"]] = out
+    ctx.overflow = ctx.overflow | ovf
+
+
+def _k_semi(ctx: StageContext, p) -> None:
+    left = ctx.slots[p["left_slot"]]
+    right = ctx.slots[p["right_slot"]]
+    cap = _round8(max(left.capacity, right.capacity) * p["expansion"] * ctx.boost)
+    mask, ovf = J.exists_mask(
+        left, right, p["left_keys"], p["right_keys"], cap
+    )
+    if p.get("negate"):
+        mask = ~mask
+    ctx.slots[p["left_slot"]] = left.filter(mask)
+    ctx.overflow = ctx.overflow | ovf
+
+
+def _k_concat(ctx: StageContext, p) -> None:
+    batches = [ctx.slots[s] for s in p["slots"]]
+    names = set(batches[0].columns)
+    aligned = [b.select(sorted(names)) for b in batches]
+    ctx.slots[p["out_slot"]] = ColumnBatch.concatenate(aligned)
+
+
+def _k_group_join_count(ctx: StageContext, p) -> None:
+    left = ctx.slots[p["left_slot"]]
+    right = ctx.slots[p["right_slot"]]
+    cap = _round8(max(left.capacity, right.capacity) * p["expansion"] * ctx.boost)
+    counts, ovf = J.group_join_counts(
+        left, right, p["left_keys"], p["right_keys"], cap
+    )
+    ctx.slots[p["left_slot"]] = left.with_column(p["out"], counts)
+    ctx.overflow = ctx.overflow | ovf
+
+
+def _rank_column(b: ColumnBatch, P: int) -> Tuple[ColumnBatch, jax.Array]:
+    """Compact and attach each valid row's global rank (partition-major)."""
+    c = b.compact()
+    local = jnp.sum(c.valid.astype(jnp.int32))
+    counts = jax.lax.all_gather(local, AXIS)
+    me = jax.lax.axis_index(AXIS)
+    offset = jnp.sum(jnp.where(jnp.arange(P) < me, counts, 0))
+    rank = (offset + jnp.arange(c.capacity, dtype=jnp.int32)).astype(jnp.uint32)
+    rank = jnp.where(c.valid, rank, jnp.uint32(0xFFFFFFFF))
+    total = jax.lax.psum(local, AXIS)
+    return ColumnBatch(dict(c.data, **{"#rank": rank}), c.valid), total
+
+
+def _exchange_by_rank(
+    ctx: StageContext, b: ColumnBatch, per: int
+) -> ColumnBatch:
+    """Repartition rows so global rank r lands at partition r // per,
+    locally sorted by rank (position i holds rank pid*per + i)."""
+    rank = b.data["#rank"].astype(jnp.int32)
+    dest = jnp.clip(rank // per, 0, ctx.P - 1)
+    B = SH.bucket_capacity(b.capacity, ctx.P, ctx.slack * ctx.boost)
+    out, ovf = SH.exchange(b, dest, ctx.P, B, AXIS)
+    ctx.overflow = ctx.overflow | ovf
+    out, ovf2 = SH.resize(out, per)
+    ctx.overflow = ctx.overflow | ovf2
+    order = SORT.sort_order_by_operands([out.data["#rank"]], out.valid)
+    return out.take(order)
+
+
+def _k_zip(ctx: StageContext, p) -> None:
+    """Pair rows by global position (LINQ Zip: truncate to shorter)."""
+    left = ctx.slots[p["left_slot"]]
+    right = ctx.slots[p["right_slot"]]
+    per = _round8(max(ctx.base_cap(p["left_slot"]), ctx.base_cap(p["right_slot"])) * ctx.boost)
+    lb, _lt = _rank_column(left, ctx.P)
+    rb, _rt = _rank_column(right, ctx.P)
+    la = _exchange_by_rank(ctx, lb, per)
+    ra = _exchange_by_rank(ctx, rb, per)
+    data: Dict[str, jax.Array] = {
+        n: c for n, c in la.data.items() if n != "#rank"
+    }
+    for n, c in ra.data.items():
+        if n == "#rank":
+            continue
+        data[J._suffixed(n, p["suffix"]) if n in data else n] = c
+    valid = la.valid & ra.valid
+    ctx.slots[p["left_slot"]] = ColumnBatch(data, valid)
+
+
+def _k_sliding_window(ctx: StageContext, p) -> None:
+    """Windows over the global row sequence with a cross-partition halo:
+    each partition receives the first (size-1) rows of its successor via
+    ppermute and places them right after its own dense prefix."""
+    b = ctx.slots[p["slot"]].compact()
+    w = int(p["size"])
+    cap = b.capacity
+    n_loc = jnp.sum(b.valid.astype(jnp.int32))
+    perm = [(i, i - 1) for i in range(1, ctx.P)]
+
+    ext_len = cap + w - 1
+    out_cols: Dict[str, jax.Array] = {}
+    # Halo of validity first (same construction as data columns).
+    halo_v = jax.lax.ppermute(b.valid[: w - 1], AXIS, perm) if w > 1 else None
+    ext_v = jnp.zeros((ext_len,), jnp.bool_)
+    ext_v = jax.lax.dynamic_update_slice(ext_v, b.valid, (0,))
+    if w > 1:
+        ext_v = jax.lax.dynamic_update_slice(ext_v, halo_v, (n_loc,))
+    # A window is valid when all its rows are; windows needing rows from
+    # beyond the immediate successor partition (successor holding fewer
+    # than size-1 rows) are dropped — documented engine limitation.
+    win_valid = jnp.ones((cap,), jnp.bool_)
+    for j in range(w):
+        win_valid = win_valid & ext_v[j : j + cap]
+
+    for c in p["cols"]:
+        col = b.data[c]
+        halo = jax.lax.ppermute(col[: w - 1], AXIS, perm) if w > 1 else None
+        ext = jnp.zeros((ext_len,), col.dtype)
+        ext = jax.lax.dynamic_update_slice(ext, col, (0,))
+        if w > 1:
+            ext = jax.lax.dynamic_update_slice(ext, halo, (n_loc,))
+        for j in range(w):
+            out_cols[f"{c}_w{j}"] = ext[j : j + cap]
+
+    ctx.slots[p["slot"]] = ColumnBatch(out_cols, win_valid)
+
+
+# -- global ops ------------------------------------------------------------
+
+def _k_take(ctx: StageContext, p) -> None:
+    b = ctx.slots[p["slot"]].compact()
+    local = jnp.sum(b.valid.astype(jnp.int32))
+    counts = jax.lax.all_gather(local, AXIS)
+    me = jax.lax.axis_index(AXIS)
+    offset = jnp.sum(jnp.where(jnp.arange(ctx.P) < me, counts, 0))
+    rank = offset + jnp.arange(b.capacity, dtype=jnp.int32)
+    keep = b.valid & (rank < p["n"])
+    ctx.slots[p["slot"]] = ColumnBatch(b.data, keep)
+
+
+def _k_scalar_agg(ctx: StageContext, p) -> None:
+    b = ctx.slots[p["slot"]]
+    v = b.valid
+    out: Dict[str, jax.Array] = {}
+    for a in p["aggs"]:
+        if a.op == "count":
+            loc = jnp.sum(v.astype(jnp.int32))
+            out[a.out] = jax.lax.psum(loc, AXIS)[None]
+        elif a.op == "sum":
+            col = b.data[a.col]
+            loc = jnp.sum(jnp.where(v, col, jnp.zeros((), col.dtype)))
+            out[a.out] = jax.lax.psum(loc, AXIS)[None]
+        elif a.op == "min":
+            col = b.data[a.col]
+            big = _dtype_max(col.dtype)
+            loc = jnp.min(jnp.where(v, col, big))
+            out[a.out] = jax.lax.pmin(loc, AXIS)[None]
+        elif a.op == "max":
+            col = b.data[a.col]
+            small = _dtype_min(col.dtype)
+            loc = jnp.max(jnp.where(v, col, small))
+            out[a.out] = jax.lax.pmax(loc, AXIS)[None]
+        elif a.op == "mean":
+            col = b.data[a.col].astype(jnp.float32)
+            s = jax.lax.psum(jnp.sum(jnp.where(v, col, 0.0)), AXIS)
+            c = jax.lax.psum(jnp.sum(v.astype(jnp.float32)), AXIS)
+            out[a.out] = (s / jnp.maximum(c, 1.0))[None]
+        elif a.op == "any":
+            col = b.data[a.col]
+            loc = jnp.any(v & col).astype(jnp.int32)
+            out[a.out] = (jax.lax.psum(loc, AXIS) > 0)[None]
+        elif a.op == "all":
+            col = b.data[a.col]
+            loc = jnp.all(jnp.where(v, col, True)).astype(jnp.int32)
+            out[a.out] = (jax.lax.psum(loc, AXIS) >= ctx.P)[None]
+        else:
+            raise ValueError(f"unknown scalar agg {a.op!r}")
+    me = jax.lax.axis_index(AXIS)
+    valid = (me == 0)[None]
+    ctx.slots[p["slot"]] = ColumnBatch(out, valid)
+
+
+def _k_fork(ctx: StageContext, p) -> None:
+    b = ctx.slots[p["slot"]]
+    outs = p["fn"](b)
+    if len(outs) != p["n_out"]:
+        raise ValueError(f"fork fn returned {len(outs)} outputs, expected {p['n_out']}")
+    for slot, ob in zip(p["out_slots"], outs):
+        if not isinstance(ob, ColumnBatch):
+            raise TypeError("fork fn must return ColumnBatches")
+        ctx.slots[slot] = ob
+
+
+def _dtype_max(dt):
+    if jnp.issubdtype(dt, jnp.floating):
+        return jnp.array(jnp.inf, dt)
+    return jnp.array(jnp.iinfo(dt).max, dt)
+
+
+def _dtype_min(dt):
+    if jnp.issubdtype(dt, jnp.floating):
+        return jnp.array(-jnp.inf, dt)
+    return jnp.array(jnp.iinfo(dt).min, dt)
+
+
+_KERNELS = {
+    "select": _k_select,
+    "where": _k_where,
+    "project": _k_project,
+    "seed": _k_seed,
+    "select_many": _k_select_many,
+    "apply": _k_apply,
+    "exchange_hash": _k_exchange_hash,
+    "exchange_range": _k_exchange_range,
+    "resize": _k_resize,
+    "group_reduce": _k_group_reduce,
+    "group_combine": _k_group_combine,
+    "distinct": _k_distinct,
+    "local_sort": _k_local_sort,
+    "join": _k_join,
+    "semi": _k_semi,
+    "concat": _k_concat,
+    "take": _k_take,
+    "scalar_agg": _k_scalar_agg,
+    "fork": _k_fork,
+    "group_join_count": _k_group_join_count,
+    "zip": _k_zip,
+    "sliding_window": _k_sliding_window,
+}
+
+
+def build_stage_fn(stage, P: int, slack: float, boost: int):
+    """Compose the stage's ops into one per-partition function."""
+
+    def fn(sharded_inputs, _replicated):
+        ctx = StageContext(P, slack, boost)
+        ctx.bind_inputs(tuple(sharded_inputs))
+        for op in stage.ops:
+            if op.kind == "do_while":
+                raise RuntimeError("do_while stages are driver-evaluated")
+            apply_op(ctx, op.kind, op.params)
+        outs = tuple(ctx.slots[s] for s in stage.out_slots)
+        # Overflow flags from resize/join are per-device; reduce across the
+        # mesh so the replicated output is truly uniform (a silently
+        # device-local flag loses rows without tripping the retry).
+        overflow = jax.lax.psum(ctx.overflow.astype(jnp.int32), AXIS) > 0
+        return outs, (overflow,)
+
+    return fn

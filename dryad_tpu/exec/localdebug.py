@@ -1,0 +1,386 @@
+"""LocalDebug — a NumPy interpreter over the logical plan.
+
+The analog of the reference's LocalDebug provider, which runs the same
+query through LINQ-to-Objects in-process for semantics debugging
+(``DryadLinqContext.cs:966-983``, ``DryadLinqQuery.cs:55-137``).  This
+interpreter executes logical nodes directly on dense host arrays with
+independent (non-XLA) implementations, so differential tests can compare
+the distributed engine against it.
+
+Tables here are dicts of *physical* dense numpy columns (no validity
+mask — rows are materialized).  User fns receive numpy-backed dicts and
+may use jnp ops; outputs are converted back with ``np.asarray``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Tuple
+
+import numpy as np
+
+from dryad_tpu.columnar.batch import ColumnBatch
+from dryad_tpu.columnar.schema import Schema
+from dryad_tpu.plan import keys as K
+from dryad_tpu.plan.nodes import Node, walk
+
+Table = Dict[str, np.ndarray]
+
+
+def _rows(t: Table) -> int:
+    for v in t.values():
+        return len(v)
+    return 0
+
+
+def _take_rows(t: Table, idx) -> Table:
+    return {k: np.asarray(v)[idx] for k, v in t.items()}
+
+
+def _call(fn: Callable, cols: Table) -> Dict[str, np.ndarray]:
+    out = fn({k: v for k, v in cols.items()})
+    return {k: np.asarray(v) for k, v in out.items()}
+
+
+def _key_tuples(t: Table, cols: List[str]) -> List[tuple]:
+    arrs = [np.asarray(t[c]) for c in cols]
+    return list(zip(*[a.tolist() for a in arrs])) if arrs else [()] * _rows(t)
+
+
+class LocalDebugInterpreter:
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self.cache: Dict[int, Any] = {}
+
+    # -- public -------------------------------------------------------------
+    def run_to_logical(self, root: Node) -> Dict[str, np.ndarray]:
+        table = self.run(root)
+        return self._decode(table, root.schema)
+
+    def run(self, root: Node) -> Table:
+        for node in walk([root]):
+            if node.id not in self.cache:
+                self.cache[node.id] = self._eval(node)
+        val = self.cache[root.id]
+        if isinstance(val, tuple):  # fork outputs
+            raise RuntimeError("cannot collect a fork node directly")
+        return val
+
+    def _decode(self, table: Table, schema: Schema) -> Dict[str, np.ndarray]:
+        import jax.numpy as jnp
+
+        n = _rows(table)
+        b = ColumnBatch(
+            {k: jnp.asarray(v) for k, v in table.items()},
+            jnp.ones((n,), jnp.bool_),
+        )
+        return b.to_numpy(schema, self.ctx.dictionary)
+
+    # -- node dispatch ------------------------------------------------------
+    def _eval(self, node: Node) -> Any:
+        m = getattr(self, f"_n_{node.kind}", None)
+        if m is None:
+            raise NotImplementedError(f"localdebug: node kind {node.kind!r}")
+        return m(node)
+
+    def _in(self, node: Node, i: int = 0) -> Table:
+        return self.cache[node.inputs[i].id]
+
+    # -- inputs -------------------------------------------------------------
+    def _n_input(self, node: Node) -> Table:
+        kind, *rest = self.ctx._bindings[node.id]
+        if kind == "host":
+            arrays, _cap = rest
+            n = _rows({k: np.asarray(v) for k, v in arrays.items()})
+            b = ColumnBatch.from_numpy(
+                node.schema, arrays, capacity=max(n, 1),
+                dictionary=self.ctx.dictionary,
+            )
+            valid = np.asarray(b.valid)
+            return {k: np.asarray(v)[valid] for k, v in b.data.items()}
+        if kind == "store":
+            parts, _schema = rest
+            out: Table = {}
+            for c in parts[0].keys():
+                out[c] = np.concatenate([p[c] for p in parts])
+            return out
+        if kind == "table":  # bound by do_while recursion
+            return rest[0]
+        raise RuntimeError(f"localdebug: unsupported input binding {kind}")
+
+    # -- row-wise -----------------------------------------------------------
+    def _n_select(self, node: Node) -> Table:
+        return _call(node.params["fn"], self._in(node))
+
+    def _n_where(self, node: Node) -> Table:
+        t = self._in(node)
+        mask = np.asarray(node.params["fn"](dict(t))).astype(bool)
+        return _take_rows(t, mask)
+
+    def _n_select_many(self, node: Node) -> Table:
+        t = self._in(node)
+        out_cols, valid = node.params["fn"](dict(t))
+        valid = np.asarray(valid).astype(bool).reshape(-1)
+        flat = {}
+        for k, v in out_cols.items():
+            v = np.asarray(v)
+            flat[k] = v.reshape((v.shape[0] * v.shape[1],) + tuple(v.shape[2:]))
+        return {k: v[valid] for k, v in flat.items()}
+
+    def _n_assume_partition(self, node: Node) -> Table:
+        return self._in(node)
+
+    def _n_hash_partition(self, node: Node) -> Table:
+        return self._in(node)
+
+    def _n_range_partition(self, node: Node) -> Table:
+        return self._in(node)
+
+    def _n_tee(self, node: Node) -> Table:
+        return self._in(node)
+
+    # -- grouping -----------------------------------------------------------
+    def _n_group_by(self, node: Node) -> Table:
+        t = self._in(node)
+        in_schema = node.inputs[0].schema
+        keys = node.params["keys"]
+        eq = K.equality_cols(in_schema, keys)
+        carry = K.group_carry_cols(in_schema, keys)
+        tuples = _key_tuples(t, eq)
+        groups: Dict[tuple, List[int]] = {}
+        for i, k in enumerate(tuples):
+            groups.setdefault(k, []).append(i)
+        order = list(groups.values())
+
+        out: Table = {c: np.array([np.asarray(t[c])[idx[0]] for idx in order],
+                                  dtype=np.asarray(t[c]).dtype)
+                      for c in carry}
+
+        dec = node.params.get("decomposable")
+        if dec is not None:
+            state = _call(dec.seed, t)
+            full = dict(t)
+            full.update(state)
+            for c in dec.state_cols:
+                vals = []
+                for idx in order:
+                    acc = {k: np.asarray(full[k])[idx[:1]] for k in dec.state_cols}
+                    for j in idx[1:]:
+                        nxt = {k: np.asarray(full[k])[j : j + 1] for k in dec.state_cols}
+                        acc = {k: np.asarray(v) for k, v in dec.merge(acc, nxt).items()}
+                    vals.append(acc[c][0])
+                out[c] = np.array(vals)
+            if dec.finalize is not None:
+                out = _call(dec.finalize, out)
+            want = K.group_carry_cols(node.schema, node.schema.names)
+            return {c: out[c] for c in want}
+
+        for op, col, name in node.params["aggs"]:
+            vals = []
+            for idx in order:
+                a = np.asarray(t[col])[idx] if col is not None else None
+                if op == "count":
+                    vals.append(np.int32(len(idx)))
+                elif op == "sum":
+                    vals.append(a.sum(dtype=a.dtype))
+                elif op == "min":
+                    vals.append(a.min())
+                elif op == "max":
+                    vals.append(a.max())
+                elif op == "mean":
+                    vals.append(np.float32(a.astype(np.float64).mean()))
+                elif op == "first":
+                    vals.append(a[0])
+                elif op == "any":
+                    vals.append(bool(a.any()))
+                elif op == "all":
+                    vals.append(bool(a.all()))
+                else:
+                    raise ValueError(op)
+            out[name] = np.array(vals)
+        return out
+
+    def _n_distinct(self, node: Node) -> Table:
+        t = self._in(node)
+        eq = K.equality_cols(node.inputs[0].schema, node.params["keys"])
+        tuples = _key_tuples(t, eq)
+        seen = set()
+        idx = []
+        for i, k in enumerate(tuples):
+            if k not in seen:
+                seen.add(k)
+                idx.append(i)
+        return _take_rows(t, idx)
+
+    # -- join ----------------------------------------------------------------
+    def _n_join(self, node: Node) -> Table:
+        left, right = node.inputs
+        lt, rt = self._in(node, 0), self._in(node, 1)
+        lk = K.equality_cols(left.schema, node.params["left_keys"])
+        rk = K.equality_cols(right.schema, node.params["right_keys"])
+        ltup = _key_tuples(lt, lk)
+        rtup = _key_tuples(rt, rk)
+        kind = node.params.get("join_kind", "inner")
+        if kind in ("semi", "anti"):
+            rset = set(rtup)
+            mask = np.array([k in rset for k in ltup], bool)
+            if kind == "anti":
+                mask = ~mask
+            return _take_rows(lt, mask)
+        index: Dict[tuple, List[int]] = {}
+        for j, k in enumerate(rtup):
+            index.setdefault(k, []).append(j)
+        if kind == "count":
+            counts = np.array([len(index.get(k, ())) for k in ltup], np.int32)
+            out = {c: np.asarray(v) for c, v in lt.items()}
+            out[node.params["out"]] = counts
+            return out
+        li, ri = [], []
+        for i, k in enumerate(ltup):
+            for j in index.get(k, ()):
+                li.append(i)
+                ri.append(j)
+        suffix = node.params.get("suffix", "_r")
+        out: Table = {c: np.asarray(lt[c])[li] for c in lt}
+        rkset = set(rk)
+        for c in rt:
+            if c in rkset:
+                continue
+            if c in out:
+                base, _, word = c.partition("#")
+                name = f"{base}{suffix}#{word}" if word else f"{c}{suffix}"
+            else:
+                name = c
+            out[name] = np.asarray(rt[c])[ri]
+        return out
+
+    def _n_zip(self, node: Node) -> Table:
+        lt, rt = self._in(node, 0), self._in(node, 1)
+        n = min(_rows(lt), _rows(rt))
+        suffix = node.params.get("suffix", "_r")
+        out: Table = {c: np.asarray(lt[c])[:n] for c in lt}
+        for c in rt:
+            if c in out:
+                base, _, word = c.partition("#")
+                name = f"{base}{suffix}#{word}" if word else f"{c}{suffix}"
+            else:
+                name = c
+            out[name] = np.asarray(rt[c])[:n]
+        return out
+
+    def _n_sliding_window(self, node: Node) -> Table:
+        t = self._in(node)
+        w = node.params["size"]
+        n = _rows(t)
+        m = max(n - w + 1, 0)
+        out: Table = {}
+        for c in node.params["cols"]:
+            a = np.asarray(t[c])
+            for j in range(w):
+                out[f"{c}_w{j}"] = a[j : j + m]
+        return out
+
+    # -- ordering ------------------------------------------------------------
+    def _n_order_by(self, node: Node) -> Table:
+        t = self._in(node)
+        import jax.numpy as jnp
+
+        operands_fn = K.ordering_operands(
+            node.inputs[0].schema, [(k, d) for k, d in node.params["keys"]]
+        )
+        n = _rows(t)
+        b = ColumnBatch(
+            {k: jnp.asarray(v) for k, v in t.items()}, np.ones(n, bool)
+        )
+        ops = [np.asarray(o) for o in operands_fn(b)]
+        order = np.lexsort(list(reversed(ops)))
+        return _take_rows(t, order)
+
+    def _n_take(self, node: Node) -> Table:
+        t = self._in(node)
+        return _take_rows(t, slice(0, node.params["n"]))
+
+    def _n_concat(self, node: Node) -> Table:
+        ts = [self.cache[i.id] for i in node.inputs]
+        cols = sorted(ts[0].keys())
+        return {c: np.concatenate([np.asarray(t[c]) for t in ts]) for c in cols}
+
+    # -- aggregates ----------------------------------------------------------
+    def _n_aggregate(self, node: Node) -> Table:
+        t = self._in(node)
+        n = _rows(t)
+        out: Table = {}
+        for op, col, name in node.params["aggs"]:
+            a = np.asarray(t[col]) if col is not None else None
+            if op == "count":
+                out[name] = np.array([n], np.int32)
+            elif op == "sum":
+                out[name] = np.array([a.sum(dtype=a.dtype)])
+            elif op == "min":
+                out[name] = np.array([a.min()])
+            elif op == "max":
+                out[name] = np.array([a.max()])
+            elif op == "mean":
+                out[name] = np.array([a.astype(np.float64).mean()], np.float32)
+            elif op == "any":
+                out[name] = np.array([bool(a.any())])
+            elif op == "all":
+                out[name] = np.array([bool(a.all())])
+            else:
+                raise ValueError(op)
+        return out
+
+    # -- escape hatches -------------------------------------------------------
+    def _batch(self, t: Table) -> ColumnBatch:
+        import jax.numpy as jnp
+
+        n = _rows(t)
+        return ColumnBatch(
+            {k: jnp.asarray(v) for k, v in t.items()},
+            jnp.ones((n,), jnp.bool_),
+        )
+
+    def _unbatch(self, b: ColumnBatch) -> Table:
+        valid = np.asarray(b.valid)
+        return {k: np.asarray(v)[valid] for k, v in b.data.items()}
+
+    def _n_apply(self, node: Node) -> Table:
+        b = self._batch(self._in(node))
+        if node.params.get("with_index"):
+            out = node.params["fn"](b, 0)
+        else:
+            out = node.params["fn"](b)
+        return self._unbatch(out)
+
+    def _n_fork(self, node: Node) -> Tuple[Table, ...]:
+        b = self._batch(self._in(node))
+        outs = node.params["fn"](b)
+        return tuple(self._unbatch(o) for o in outs)
+
+    def _n_fork_branch(self, node: Node) -> Table:
+        forked = self.cache[node.inputs[0].id]
+        return forked[node.params["index"]]
+
+    # -- iteration -------------------------------------------------------------
+    def _n_do_while(self, node: Node) -> Table:
+        from dryad_tpu.api.query import Query
+        from dryad_tpu.plan.nodes import Node as N, PartitionInfo
+
+        current = self._in(node)
+        body = node.params["body"]
+        cond = node.params["cond"]
+        for _ in range(node.params.get("max_iter", 100)):
+            inp = N("input", [], node.schema, PartitionInfo(), source="table")
+            self.ctx._bindings[inp.id] = ("table", current)
+            sub = LocalDebugInterpreter(self.ctx)
+            out_q = body(Query(self.ctx, inp))
+            current = sub.run(out_q.node)
+
+            inp2 = N("input", [], node.schema, PartitionInfo(), source="table")
+            self.ctx._bindings[inp2.id] = ("table", current)
+            sub2 = LocalDebugInterpreter(self.ctx)
+            cond_q = cond(Query(self.ctx, inp2))
+            cond_t = sub2.run(cond_q.node)
+            col = next(iter(cond_t.values()))
+            if not (len(col) and bool(col[0])):
+                break
+        return current

@@ -1,0 +1,56 @@
+"""Stage duration statistics and outlier detection.
+
+Port of the *logic* (not code) of the reference's speculative-duplication
+model (``GraphManager/stagemanager/DrStageStatistics.cpp``): a robust
+Gaussian fit over completed task durations — trimming the top 20% as
+suspected outliers — with an outlier threshold at mean + 3 sigma
+(``DrStageStatistics.cpp:24-25,93,490-558``).  Intra-pod SPMD steps are
+lockstep so speculation is moot there; the driver uses this for
+multi-slice / DCN stage retries and for surfacing stragglers in the
+event log.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional
+
+TRIM_FRACTION = 0.2  # reference: top 20% trimmed before fitting
+DEFAULT_SIGMAS = 3.0  # reference: 3-sigma outlier threshold
+MIN_SAMPLES = 3
+
+
+class StageStatistics:
+    """Robust duration model for one stage's attempts."""
+
+    def __init__(self, outlier_sigmas: float = DEFAULT_SIGMAS):
+        self.durations: List[float] = []
+        self.outlier_sigmas = outlier_sigmas
+
+    def record(self, seconds: float) -> None:
+        self.durations.append(float(seconds))
+
+    def _trimmed(self) -> List[float]:
+        d = sorted(self.durations)
+        k = int(len(d) * (1.0 - TRIM_FRACTION))
+        return d[: max(k, 1)]
+
+    def mean_std(self) -> Optional[tuple]:
+        if len(self.durations) < MIN_SAMPLES:
+            return None
+        t = self._trimmed()
+        m = sum(t) / len(t)
+        var = sum((x - m) ** 2 for x in t) / max(len(t) - 1, 1)
+        return m, math.sqrt(var)
+
+    def outlier_threshold(self) -> Optional[float]:
+        """Duration beyond which an attempt counts as a straggler."""
+        ms = self.mean_std()
+        if ms is None:
+            return None
+        m, s = ms
+        return m + self.outlier_sigmas * s
+
+    def is_outlier(self, seconds: float) -> bool:
+        thr = self.outlier_threshold()
+        return thr is not None and seconds > thr

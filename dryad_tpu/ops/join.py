@@ -1,0 +1,159 @@
+"""On-device hash join (equi-join) with static output shapes.
+
+The reference executes Join/GroupJoin inside vertices after co-hash-
+partitioning both inputs (``DryadLinqQueryNode.cs`` DLinqJoinNode;
+vertex-side implementations in ``LinqToDryad/DryadLinqVertex.cs``).
+The TPU-native version: both sides arrive co-partitioned by key hash;
+locally we sort the right side by a 32-bit key hash, probe with
+``searchsorted`` to get candidate ranges, expand candidate pairs into a
+fixed-capacity output via prefix sums, and mask to exact key equality
+(hash collisions only ever add masked-off candidates).  Output overflow
+is reported for executor retry, like the shuffle's padded buckets.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dryad_tpu.columnar.batch import ColumnBatch
+from dryad_tpu.ops.hash import hash_columns
+from dryad_tpu.ops.sortkeys import sort_order
+
+
+def _suffixed(phys_name: str, suffix: str) -> str:
+    """Apply a clash suffix to the *logical* base of a physical name:
+    'v#h0' -> 'v{suffix}#h0' so split columns stay consistent with the
+    suffixed logical field in the output schema."""
+    if "#" in phys_name:
+        base, word = phys_name.split("#", 1)
+        return f"{base}{suffix}#{word}"
+    return f"{phys_name}{suffix}"
+
+
+def _probe_ranges(
+    left: ColumnBatch,
+    right: ColumnBatch,
+    left_keys: Sequence[str],
+    right_keys: Sequence[str],
+) -> Tuple[ColumnBatch, jax.Array, jax.Array, jax.Array]:
+    """Sort right by key hash; per valid left row the candidate range.
+
+    Returns (right_sorted, lhash, start, end). Invalid right rows sort
+    to the end with a sentinel hash that can never match a valid probe
+    (probe hashes have their top bit cleared; the sentinel is 2^32-1).
+    """
+    rhash = hash_columns([right.data[k] for k in right_keys]) >> 1
+    rhash = jnp.where(right.valid, rhash, jnp.uint32(0xFFFFFFFF))
+    order = jnp.argsort(rhash)  # sentinel rows last
+    rs = right.take(order)
+    rhash_sorted = rhash[order]
+
+    lhash = hash_columns([left.data[k] for k in left_keys]) >> 1
+    start = jnp.searchsorted(rhash_sorted, lhash, side="left")
+    end = jnp.searchsorted(rhash_sorted, lhash, side="right")
+    counts = jnp.where(left.valid, end - start, 0)
+    return rs, lhash, start, counts
+
+
+def _expand_pairs(
+    start: jax.Array, counts: jax.Array, out_capacity: int
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Enumerate candidate (left_row, right_row) pairs into fixed slots.
+
+    Returns (left_idx, right_idx, pair_valid, overflow).
+    """
+    n = counts.shape[0]
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), counts.dtype), jnp.cumsum(counts)[:-1]]
+    )
+    total = jnp.sum(counts)
+    overflow = total > out_capacity
+
+    slots = jnp.arange(out_capacity, dtype=jnp.int32)
+    # Which left row does slot j belong to?  offsets is non-decreasing.
+    li = jnp.searchsorted(offsets, slots, side="right").astype(jnp.int32) - 1
+    li = jnp.clip(li, 0, n - 1)
+    within = slots - offsets[li].astype(jnp.int32)
+    pair_valid = slots < total
+    ri = start[li].astype(jnp.int32) + within
+    return li, ri, pair_valid, overflow
+
+
+def hash_join(
+    left: ColumnBatch,
+    right: ColumnBatch,
+    left_keys: Sequence[str],
+    right_keys: Sequence[str],
+    out_capacity: int,
+    suffix: str = "_r",
+) -> Tuple[ColumnBatch, jax.Array]:
+    """Local inner equi-join; inputs must already be co-partitioned.
+
+    Output columns: all left columns plus right columns (right key
+    columns dropped — they equal the left's; other right names clashing
+    with left names get ``suffix``).  Returns (batch, overflow).
+    """
+    rs, lhash, start, counts = _probe_ranges(left, right, left_keys, right_keys)
+    li, ri, pair_valid, overflow = _expand_pairs(start, counts, out_capacity)
+
+    data: Dict[str, jax.Array] = {}
+    for name, col in left.data.items():
+        data[name] = col[li]
+    rk = set(right_keys)
+    for name, col in rs.data.items():
+        if name in rk:
+            continue
+        data[_suffixed(name, suffix) if name in data else name] = col[ri]
+
+    # Exact-equality verification kills hash collisions.
+    exact = jnp.ones((out_capacity,), jnp.bool_)
+    for lk, rkey in zip(left_keys, right_keys):
+        exact = exact & (left.data[lk][li] == rs.data[rkey][ri])
+    valid = pair_valid & left.valid[li] & rs.valid[ri] & exact
+    return ColumnBatch(data, valid), overflow
+
+
+def exists_mask(
+    left: ColumnBatch,
+    right: ColumnBatch,
+    left_keys: Sequence[str],
+    right_keys: Sequence[str],
+    out_capacity: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-left-row 'has an exactly-matching right row' (semi/anti join).
+
+    Enumerates hash-candidate pairs (bounded by ``out_capacity``) and
+    reduces exact matches back onto left rows.  Returns (mask, overflow).
+    """
+    rs, lhash, start, counts = _probe_ranges(left, right, left_keys, right_keys)
+    li, ri, pair_valid, overflow = _expand_pairs(start, counts, out_capacity)
+
+    exact = pair_valid & left.valid[li] & rs.valid[ri]
+    for lk, rkey in zip(left_keys, right_keys):
+        exact = exact & (left.data[lk][li] == rs.data[rkey][ri])
+
+    n = left.capacity
+    hits = jnp.zeros((n,), jnp.int32).at[li].add(exact.astype(jnp.int32), mode="drop")
+    return hits > 0, overflow
+
+
+def group_join_counts(
+    left: ColumnBatch,
+    right: ColumnBatch,
+    left_keys: Sequence[str],
+    right_keys: Sequence[str],
+    out_capacity: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-left-row count of exactly-matching right rows (GroupJoin's
+    shape; aggregations over the group compose on the joined output)."""
+    rs, lhash, start, counts = _probe_ranges(left, right, left_keys, right_keys)
+    li, ri, pair_valid, overflow = _expand_pairs(start, counts, out_capacity)
+    exact = pair_valid & left.valid[li] & rs.valid[ri]
+    for lk, rkey in zip(left_keys, right_keys):
+        exact = exact & (left.data[lk][li] == rs.data[rkey][ri])
+    n = left.capacity
+    cnt = jnp.zeros((n,), jnp.int32).at[li].add(exact.astype(jnp.int32), mode="drop")
+    return cnt, overflow

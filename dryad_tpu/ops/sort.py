@@ -1,0 +1,83 @@
+"""Global sort / range partition: sampling, splitters, bucketing.
+
+The reference's TeraSort pattern: a sampler stage reads ~0.1% of rows
+(``DryadLinqSampler.cs:38-42``), the GM computes range splitters and
+dynamically sizes the consumer stage (``DrDynamicRangeDistributor.cpp:
+23-110``), and a range-exchange plus per-partition merge-sort yields a
+globally sorted dataset.  TPU-native: sampling, splitter election and
+bucketing all happen on device inside the same compiled program —
+``sample_splitters`` uses an ``all_gather`` over ICI instead of a
+sampler stage + host round-trip.  Equal keys always land in the same
+partition (searchsorted semantics), so secondary sort keys stay local.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dryad_tpu.columnar.batch import ColumnBatch
+from dryad_tpu.ops.sortkeys import to_sortable_u32
+
+
+def sort_order_by_operands(
+    operands: Sequence[jax.Array], valid: jax.Array
+) -> jax.Array:
+    """Stable permutation: valid rows first, lexicographic by uint32 operands."""
+    n = valid.shape[0]
+    ops: List[jax.Array] = [jnp.logical_not(valid).astype(jnp.uint32)]
+    ops.extend(o.astype(jnp.uint32) for o in operands)
+    ops.append(jnp.arange(n, dtype=jnp.int32))
+    res = jax.lax.sort(tuple(ops), num_keys=len(ops) - 1, is_stable=True)
+    return res[-1]
+
+
+def sample_splitters(
+    key_u32: jax.Array,
+    valid: jax.Array,
+    num_partitions: int,
+    samples_per_partition: int,
+    axis_name: str = "p",
+) -> jax.Array:
+    """Elect P-1 range splitters from per-device samples (replicated).
+
+    Each device contributes ``samples_per_partition`` evenly spaced
+    values from its sorted valid keys; an ``all_gather`` pools them; the
+    pooled sorted sample is cut at P-1 evenly spaced ranks.  The analog
+    of sampler stage + ``DrDynamicRangeDistributionManager`` splitter
+    election, minus the host round-trip.
+    """
+    P, m = num_partitions, samples_per_partition
+    n = valid.shape[0]
+    order = sort_order_by_operands([key_u32], valid)
+    ks = key_u32[order]
+    count = jnp.sum(valid.astype(jnp.int32))
+
+    # Evenly spaced sample positions in the valid prefix.
+    pos = (jnp.arange(m, dtype=jnp.float32) + 0.5) * count.astype(jnp.float32) / m
+    idx = jnp.clip(pos.astype(jnp.int32), 0, jnp.maximum(count - 1, 0))
+    sample = ks[idx]
+    sample_valid = jnp.full((m,), count > 0)
+
+    all_samples = jax.lax.all_gather(sample, axis_name, tiled=True)
+    all_valid = jax.lax.all_gather(sample_valid, axis_name, tiled=True)
+
+    total = jnp.sum(all_valid.astype(jnp.int32))
+    sorted_ops = jax.lax.sort(
+        (jnp.where(all_valid, all_samples, jnp.uint32(0xFFFFFFFF)),),
+        num_keys=1,
+    )[0]
+    ranks = (jnp.arange(1, P, dtype=jnp.float32) * total.astype(jnp.float32) / P)
+    sidx = jnp.clip(ranks.astype(jnp.int32), 0, jnp.maximum(total - 1, 0))
+    return sorted_ops[sidx]
+
+
+def range_dest(key_u32: jax.Array, splitters: jax.Array) -> jax.Array:
+    """Destination partition per row: searchsorted into the splitters.
+
+    ``side='right'`` so rows equal to a splitter go right — equal keys
+    always share a partition, keeping secondary ordering purely local.
+    """
+    return jnp.searchsorted(splitters, key_u32, side="right").astype(jnp.int32)

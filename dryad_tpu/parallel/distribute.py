@@ -34,11 +34,11 @@ def from_host_table(
     partition_capacity: Optional[int] = None,
     dictionary: Optional[StringDictionary] = None,
 ) -> ColumnBatch:
-    """Round-robin rows into P partitions of equal static capacity.
+    """Block-partition rows into P partitions of equal static capacity.
 
     Mirrors FromEnumerable/FromStore ingestion
-    (``DryadLinqContext.cs:1176-1223``): rows land in partition
-    ``i % P`` so every shard is near-equal before the first shuffle.
+    (``DryadLinqContext.cs:1176-1223``); every shard is near-equal
+    before the first shuffle.
     """
     P = num_partitions(mesh)
     names = schema.names
@@ -48,9 +48,12 @@ def from_host_table(
     if cap < per:
         raise ValueError(f"partition_capacity {cap} < required {per}")
 
-    # Encode each partition separately so only real rows are hashed /
+    # Block layout: partition p holds contiguous rows [p*per, (p+1)*per),
+    # so the engine's partition-major global order equals the original
+    # row order (zip/take semantics match the host table).  Encode each
+    # partition separately so only real rows are hashed /
     # dictionary-registered; from_numpy pads the per-partition tail.
-    idx_by_part = [np.arange(p, n, P) for p in range(P)]
+    idx_by_part = [np.arange(p * per, min((p + 1) * per, n)) for p in range(P)]
     parts = [
         ColumnBatch.from_numpy(
             schema,

@@ -1,0 +1,88 @@
+"""Schema inference for user projection functions.
+
+``select``/``select_many`` take a function over *physical* columns; when
+the caller doesn't declare the output schema we trace it with
+``jax.eval_shape`` on dummy columns and reconstruct logical fields from
+the physical names: ``x#h0``/``x#h1``/``x#r0`` triples are STRING,
+``x#h0``/``x#h1`` pairs are INT64, everything else maps by dtype.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dryad_tpu.columnar.schema import ColumnType, Schema
+
+_DEVICE_DTYPES = {
+    ColumnType.INT32: jnp.int32,
+    ColumnType.FLOAT32: jnp.float32,
+    ColumnType.BOOL: jnp.bool_,
+    ColumnType.UINT32: jnp.uint32,
+}
+
+
+def dummy_cols(schema: Schema, n: int = 4) -> Dict[str, jax.ShapeDtypeStruct]:
+    out: Dict[str, jax.ShapeDtypeStruct] = {}
+    for f in schema.fields:
+        if f.ctype.is_split:
+            for d in f.device_names:
+                out[d] = jax.ShapeDtypeStruct((n,), jnp.uint32)
+        else:
+            out[f.name] = jax.ShapeDtypeStruct((n,), _DEVICE_DTYPES[f.ctype])
+    return out
+
+
+_DTYPE_TO_TYPE = {
+    jnp.dtype(jnp.int32): ColumnType.INT32,
+    jnp.dtype(jnp.float32): ColumnType.FLOAT32,
+    jnp.dtype(jnp.bool_): ColumnType.BOOL,
+    jnp.dtype(jnp.uint32): ColumnType.UINT32,
+}
+
+
+def schema_from_physical(cols: Dict[str, jax.ShapeDtypeStruct]) -> Schema:
+    names = set(cols.keys())
+    fields: List[Tuple[str, ColumnType]] = []
+    seen = set()
+    for name in cols:
+        if "#" in name:
+            base = name.split("#")[0]
+            if base in seen:
+                continue
+            seen.add(base)
+            has = {f"{base}#{s}" for s in ("h0", "h1", "r0")} & names
+            if has == {f"{base}#h0", f"{base}#h1", f"{base}#r0"}:
+                fields.append((base, ColumnType.STRING))
+            elif has == {f"{base}#h0", f"{base}#h1"}:
+                fields.append((base, ColumnType.INT64))
+            else:
+                raise ValueError(
+                    f"incomplete split column set for {base!r}: {sorted(has)}"
+                )
+        else:
+            dt = jnp.dtype(cols[name].dtype)
+            if dt not in _DTYPE_TO_TYPE:
+                raise TypeError(f"column {name!r} has unsupported dtype {dt}")
+            fields.append((name, _DTYPE_TO_TYPE[dt]))
+    return Schema(fields)
+
+
+def infer_select_schema(schema: Schema, fn) -> Schema:
+    shapes = dummy_cols(schema)
+    out = jax.eval_shape(lambda c: fn(c), shapes)
+    if not isinstance(out, dict):
+        raise TypeError("select fn must return a dict of physical columns")
+    return schema_from_physical(out)
+
+
+def infer_select_many_schema(schema: Schema, fn, factor: int) -> Schema:
+    shapes = dummy_cols(schema)
+    out_cols, _valid = jax.eval_shape(lambda c: fn(c), shapes)
+    flat = {
+        n: jax.ShapeDtypeStruct((s.shape[0] * factor,), s.dtype)
+        for n, s in out_cols.items()
+    }
+    return schema_from_physical(flat)

@@ -1,0 +1,75 @@
+"""Logical key -> physical device-column lowering.
+
+Grouping/joining and ordering need different physical views of a
+logical column: equality keys are the identity columns (hash words for
+strings), while ordering keys are uint32 operand lists whose
+lexicographic order equals the logical order (reference analog: the
+comparer/key-selector machinery of OrderBy/GroupBy nodes,
+``DryadLinqQueryNode.cs``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dryad_tpu.columnar.batch import ColumnBatch
+from dryad_tpu.columnar.schema import ColumnType, Schema
+from dryad_tpu.ops.sortkeys import to_sortable_u32
+
+
+def equality_cols(schema: Schema, names: Sequence[str]) -> List[str]:
+    """Physical columns whose tuple-equality == logical key equality."""
+    out: List[str] = []
+    for n in names:
+        f = schema.field(n)
+        if f.ctype == ColumnType.STRING:
+            out += [f"{n}#h0", f"{n}#h1"]
+        elif f.ctype == ColumnType.INT64:
+            out += [f"{n}#h0", f"{n}#h1"]
+        else:
+            out.append(n)
+    return out
+
+
+def group_carry_cols(schema: Schema, names: Sequence[str]) -> List[str]:
+    """Physical columns to carry as group keys (includes string ranks so
+    ordering info survives a group-by)."""
+    out: List[str] = []
+    for n in names:
+        out.extend(schema.field(n).device_names)
+    return out
+
+
+def ordering_operands(
+    schema: Schema, keys: Sequence[Tuple[str, bool]]
+) -> Callable[[ColumnBatch], List[jax.Array]]:
+    """Build a fn: batch -> uint32 operand list, lexicographic order ==
+    logical (column, descending) chain order.
+
+    INT64: (sign-flipped high word, low word).  STRING: (4-byte prefix
+    rank, hash words) — exact for 4-byte prefixes, hash-order beyond
+    (documented engine semantic for string ordering).
+    """
+    fields = [(schema.field(n), bool(d)) for n, d in keys]
+
+    def build(batch: ColumnBatch) -> List[jax.Array]:
+        ops: List[jax.Array] = []
+        for f, desc in fields:
+            if f.ctype == ColumnType.STRING:
+                r0 = batch.data[f"{f.name}#r0"]
+                h0 = batch.data[f"{f.name}#h0"]
+                h1 = batch.data[f"{f.name}#h1"]
+                triple = [r0, h1, h0]
+                ops.extend(~t if desc else t for t in triple)
+            elif f.ctype == ColumnType.INT64:
+                hi = batch.data[f"{f.name}#h1"] ^ jnp.uint32(0x80000000)
+                lo = batch.data[f"{f.name}#h0"]
+                ops.extend([~hi, ~lo] if desc else [hi, lo])
+            else:
+                ops.append(to_sortable_u32(batch.data[f.name], desc))
+        return ops
+
+    return build

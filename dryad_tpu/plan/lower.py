@@ -1,0 +1,532 @@
+"""Lowering: logical node DAG -> fused stage graph.
+
+The analog of the reference's three-phase physical planning
+(``DryadLinqQueryGen.cs``): Phase-1 operator translation happens as the
+API builds logical nodes; this module is Phase-2 *pipelining* — fusing
+maximal operator chains into one stage, the SuperNode of
+``DryadLinqQueryGen.cs:406-456`` — and Phase-3 cleanup: Tee boundaries
+at multi-consumer nodes, combiner (partial-aggregation) insertion before
+shuffles (the ``DrDynamicAggregateManager`` tree analog), and shuffle
+elision when partition metadata already matches (AssumePartition logic).
+
+A Stage executes as ONE ``shard_map``-ped XLA program; exchanges are
+``all_to_all`` *ops inside the stage*, not channel edges between
+processes — the central TPU-first inversion of the reference design.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from dryad_tpu.columnar.schema import Schema
+from dryad_tpu.plan import keys as K
+from dryad_tpu.plan.nodes import Node, PartitionInfo, consumers, walk
+
+_stage_ids = itertools.count()
+
+
+@dataclasses.dataclass
+class StageOp:
+    kind: str
+    params: Dict[str, Any]
+
+    def __repr__(self) -> str:
+        return f"{self.kind}({', '.join(sorted(self.params))})"
+
+
+@dataclasses.dataclass
+class Stage:
+    """A fused per-partition pipeline compiled as one SPMD program.
+
+    ``input_refs``: (producer_stage_id, out_index) pairs, or
+    ("plan_input", input_node_id) for plan inputs bound at execution.
+    Ops manipulate numbered slots; slot i starts as input i; outputs are
+    the slots named in ``out_slots``.
+    """
+
+    id: int
+    name: str
+    input_refs: List[Tuple[Any, int]]
+    ops: List[StageOp] = dataclasses.field(default_factory=list)
+    out_slots: List[int] = dataclasses.field(default_factory=lambda: [0])
+    # growth: output capacity multiplier relative to base input capacity
+    growth: float = 1.0
+
+
+@dataclasses.dataclass
+class StageGraph:
+    stages: List[Stage]
+    # node id -> (stage id, out_index) for roots the caller asked for
+    outputs: Dict[int, Tuple[int, int]]
+    # plan-input node id -> Node (for binding host data)
+    inputs: Dict[int, Node]
+
+
+class _Builder:
+    def __init__(self, config) -> None:
+        self.config = config
+        self.stages: List[Stage] = []
+        self.open: Dict[int, Stage] = {}  # stage id -> stage (not yet closed)
+        # node id -> ("open", stage, slot) | ("closed", stage_id, out_idx)
+        self.cursor: Dict[int, Tuple] = {}
+        self.plan_inputs: Dict[int, Node] = {}
+
+    # -- stage bookkeeping -------------------------------------------------
+    def _new_stage(self, name: str, input_refs: List[Tuple[Any, int]]) -> Stage:
+        s = Stage(next(_stage_ids), name, input_refs)
+        self.stages.append(s)
+        self.open[s.id] = s
+        return s
+
+    def _close(self, stage: Stage, out_slots: Optional[List[int]] = None) -> None:
+        if out_slots is not None:
+            stage.out_slots = out_slots
+        self.open.pop(stage.id, None)
+
+    def _materialize(self, node: Node) -> Tuple[int, int]:
+        """Ensure node's value is a closed stage output; return ref."""
+        kind, *rest = self.cursor[node.id]
+        if kind == "closed":
+            return rest[0], rest[1]
+        stage, slot = rest
+        self._close(stage, [slot])
+        self.cursor[node.id] = ("closed", stage.id, 0)
+        return stage.id, 0
+
+    def _continue_or_start(
+        self, node: Node, n_consumers: int
+    ) -> Tuple[Stage, int]:
+        """Get an open stage positioned at node's single input value."""
+        (src,) = node.inputs
+        kind, *rest = self.cursor[src.id]
+        if kind == "open" and n_consumers == 1:
+            stage, slot = rest
+            self._tag(stage, node.kind)
+            return stage, slot
+        ref = self._materialize(src)
+        stage = self._new_stage(node.kind, [ref])
+        return stage, 0
+
+    @staticmethod
+    def _tag(stage: Stage, kind: str) -> None:
+        """Record a fused node kind in the stage name ('input+group_by')."""
+        if kind not in stage.name.split("+"):
+            stage.name = f"{stage.name}+{kind}"
+
+    # -- node lowering -----------------------------------------------------
+    def lower_node(self, node: Node, fanout: Dict[int, int]) -> None:
+        n_cons = fanout.get(node.id, 1)
+        k = node.kind
+
+        if k == "input":
+            self.plan_inputs[node.id] = node
+            stage = self._new_stage("input", [("plan_input", node.id)])
+            self.cursor[node.id] = ("open", stage, 0)
+
+        elif k in ("select", "where", "select_many", "apply", "take"):
+            src_cons = 1  # this node is src's consumer; fusion decided by src fanout
+            stage, slot = self._continue_or_start(node, fanout.get(node.inputs[0].id, 1))
+            if k == "select":
+                stage.ops.append(StageOp("select", dict(slot=slot, fn=node.params["fn"])))
+            elif k == "where":
+                stage.ops.append(StageOp("where", dict(slot=slot, fn=node.params["fn"])))
+            elif k == "select_many":
+                stage.ops.append(
+                    StageOp(
+                        "select_many",
+                        dict(slot=slot, fn=node.params["fn"], factor=node.params["factor"]),
+                    )
+                )
+                stage.growth *= node.params["factor"]
+            elif k == "apply":
+                stage.ops.append(
+                    StageOp(
+                        "apply",
+                        dict(
+                            slot=slot,
+                            fn=node.params["fn"],
+                            with_index=node.params.get("with_index", False),
+                            cap_factor=node.params.get("cap_factor", 1.0),
+                        ),
+                    )
+                )
+                stage.growth *= node.params.get("cap_factor", 1.0)
+            elif k == "take":
+                ordered = bool(node.inputs[0].partition.ordered_by)
+                stage.ops.append(
+                    StageOp("take", dict(slot=slot, n=node.params["n"], ordered=ordered))
+                )
+            self.cursor[node.id] = ("open", stage, slot)
+
+        elif k == "assume_partition":
+            # Metadata-only: value identical to input.
+            self.cursor[node.id] = self.cursor[node.inputs[0].id]
+
+        elif k in ("hash_partition", "group_by", "distinct"):
+            self._lower_keyed(node, fanout)
+
+        elif k in ("order_by", "range_partition"):
+            self._lower_ranged(node, fanout)
+
+        elif k == "join":
+            self._lower_join(node)
+
+        elif k == "zip":
+            lref = self._materialize(node.inputs[0])
+            rref = self._materialize(node.inputs[1])
+            stage = self._new_stage("zip", [lref, rref])
+            stage.ops.append(
+                StageOp(
+                    "zip",
+                    dict(left_slot=0, right_slot=1, suffix=node.params["suffix"]),
+                )
+            )
+            self.cursor[node.id] = ("open", stage, 0)
+
+        elif k == "sliding_window":
+            stage, slot = self._continue_or_start(node, fanout.get(node.inputs[0].id, 1))
+            stage.ops.append(
+                StageOp(
+                    "sliding_window",
+                    dict(slot=slot, size=node.params["size"], cols=node.params["cols"]),
+                )
+            )
+            self.cursor[node.id] = ("open", stage, slot)
+
+        elif k == "concat":
+            refs = [self._materialize(i) for i in node.inputs]
+            stage = self._new_stage("concat", refs)
+            stage.ops.append(
+                StageOp("concat", dict(slots=list(range(len(refs))), out_slot=0))
+            )
+            stage.growth = float(len(refs))
+            self.cursor[node.id] = ("open", stage, 0)
+
+        elif k == "aggregate":
+            stage, slot = self._continue_or_start(node, fanout.get(node.inputs[0].id, 1))
+            aggs = self._phys_aggs(node.inputs[0].schema, node.params["aggs"])
+            stage.ops.append(StageOp("scalar_agg", dict(slot=slot, aggs=aggs)))
+            self.cursor[node.id] = ("open", stage, slot)
+
+        elif k == "fork":
+            stage, slot = self._continue_or_start(node, fanout.get(node.inputs[0].id, 1))
+            n_out = len(node.params["out_schemas"])
+            stage.ops.append(
+                StageOp("fork", dict(slot=slot, fn=node.params["fn"], n_out=n_out))
+            )
+            # fork outputs occupy fresh slots after existing inputs
+            base = len(stage.input_refs)
+            out_slots = [base + 100 + i for i in range(n_out)]
+            stage.ops[-1].params["out_slots"] = out_slots
+            self._close(stage, out_slots)
+            self.cursor[node.id] = ("closed", stage.id, -1)  # branches index it
+
+        elif k == "fork_branch":
+            fork_node = node.inputs[0]
+            _, stage_id, _ = self.cursor[fork_node.id]
+            self.cursor[node.id] = ("closed", stage_id, node.params["index"])
+
+        elif k == "tee":
+            ref = self._materialize(node.inputs[0])
+            self.cursor[node.id] = ("closed", ref[0], ref[1])
+
+        elif k == "do_while":
+            # Driver-loop node: body/cond are plan-producing callables the
+            # executor re-lowers per iteration (reference GM evaluates
+            # DoWhile subplans per iteration, DryadLinqQueryNode.cs:4555).
+            ref = self._materialize(node.inputs[0])
+            stage = self._new_stage("do_while", [ref])
+            stage.ops.append(
+                StageOp(
+                    "do_while",
+                    dict(
+                        body=node.params["body"],
+                        cond=node.params["cond"],
+                        max_iter=node.params.get("max_iter", 100),
+                        schema=node.schema,
+                    ),
+                )
+            )
+            self._close(stage, [0])
+            self.cursor[node.id] = ("closed", stage.id, 0)
+
+        else:
+            raise NotImplementedError(f"lowering for node kind {k!r}")
+
+        # Multi-consumer (Tee analog): close so consumers share one value.
+        if n_cons > 1 and self.cursor[node.id][0] == "open":
+            self._materialize(node)
+
+    # -- keyed (hash) ops --------------------------------------------------
+    def _phys_aggs(self, schema: Schema, aggs) -> List:
+        from dryad_tpu.ops.segmented import AggSpec
+
+        out = []
+        for op, col, name in aggs:
+            if col is not None:
+                f = schema.field(col)
+                if f.ctype.is_split:
+                    if op != "first":
+                        raise ValueError(
+                            f"aggregate {op!r} unsupported on {f.ctype.value} "
+                            f"column {col!r}"
+                        )
+                    # 'first' on a split column: one AggSpec per device
+                    # word, producing the output field's word columns.
+                    for dev in f.device_names:
+                        word = dev.split("#", 1)[1]
+                        out.append(AggSpec("first", dev, f"{name}#{word}"))
+                    continue
+            out.append(AggSpec(op, col, name))
+        return out
+
+    def _needs_hash_exchange(self, node: Node, keys: Sequence[str]) -> bool:
+        src = node.inputs[0]
+        p = src.partition
+        return not (p.scheme == "hash" and tuple(p.keys) == tuple(keys))
+
+    def _lower_keyed(self, node: Node, fanout: Dict[int, int]) -> None:
+        stage, slot = self._continue_or_start(node, fanout.get(node.inputs[0].id, 1))
+        in_schema = node.inputs[0].schema
+        keys = node.params["keys"]
+        eq_cols = K.equality_cols(in_schema, keys)
+        carry_cols = K.group_carry_cols(in_schema, keys)
+        need_exchange = self._needs_hash_exchange(node, keys)
+
+        if node.kind == "hash_partition":
+            if need_exchange:
+                stage.ops.append(StageOp("exchange_hash", dict(slot=slot, keys=eq_cols)))
+                stage.ops.append(StageOp("resize", dict(slot=slot, factor=stage.growth)))
+            self.cursor[node.id] = ("open", stage, slot)
+            return
+
+        if node.kind == "distinct":
+            if need_exchange:
+                stage.ops.append(StageOp("distinct", dict(slot=slot, keys=eq_cols)))
+                stage.ops.append(StageOp("exchange_hash", dict(slot=slot, keys=eq_cols)))
+                stage.ops.append(StageOp("resize", dict(slot=slot, factor=stage.growth)))
+            stage.ops.append(StageOp("distinct", dict(slot=slot, keys=eq_cols)))
+            self.cursor[node.id] = ("open", stage, slot)
+            return
+
+        # group_by with builtin aggs or a Decomposable
+        decomposable = node.params.get("decomposable")
+        if decomposable is not None:
+            stage.ops.append(
+                StageOp(
+                    "seed",
+                    dict(slot=slot, fn=decomposable.seed, state_cols=decomposable.state_cols),
+                )
+            )
+            keep = carry_cols + list(decomposable.state_cols)
+            stage.ops.append(StageOp("project", dict(slot=slot, cols=keep)))
+            stage.ops.append(
+                StageOp(
+                    "group_combine",
+                    dict(
+                        slot=slot,
+                        keys=carry_cols,
+                        state_cols=decomposable.state_cols,
+                        merge=decomposable.merge,
+                    ),
+                )
+            )
+            if need_exchange:
+                stage.ops.append(StageOp("exchange_hash", dict(slot=slot, keys=eq_cols)))
+                stage.ops.append(StageOp("resize", dict(slot=slot, factor=stage.growth)))
+                stage.ops.append(
+                    StageOp(
+                        "group_combine",
+                        dict(
+                            slot=slot,
+                            keys=carry_cols,
+                            state_cols=decomposable.state_cols,
+                            merge=decomposable.merge,
+                        ),
+                    )
+                )
+            if decomposable.finalize is not None:
+                stage.ops.append(
+                    StageOp("select", dict(slot=slot, fn=decomposable.finalize))
+                )
+                want = K.group_carry_cols(node.schema, node.schema.names)
+                stage.ops.append(StageOp("project", dict(slot=slot, cols=want)))
+        else:
+            aggs = self._phys_aggs(in_schema, node.params["aggs"])
+            partial, final = _decompose_aggs(aggs)
+            from dryad_tpu.ops.segmented import AggSpec
+
+            stage.ops.append(
+                StageOp("group_reduce", dict(slot=slot, keys=carry_cols, aggs=partial))
+            )
+            if need_exchange:
+                stage.ops.append(StageOp("exchange_hash", dict(slot=slot, keys=eq_cols)))
+                stage.ops.append(StageOp("resize", dict(slot=slot, factor=stage.growth)))
+                stage.ops.append(
+                    StageOp("group_reduce", dict(slot=slot, keys=carry_cols, aggs=final))
+                )
+            fin = _finalize_fn(aggs)
+            if fin is not None:
+                stage.ops.append(StageOp("select", dict(slot=slot, fn=fin)))
+            want = K.group_carry_cols(node.schema, node.schema.names)
+            stage.ops.append(StageOp("project", dict(slot=slot, cols=want)))
+        self.cursor[node.id] = ("open", stage, slot)
+
+    # -- range ops ---------------------------------------------------------
+    def _lower_ranged(self, node: Node, fanout: Dict[int, int]) -> None:
+        stage, slot = self._continue_or_start(node, fanout.get(node.inputs[0].id, 1))
+        in_schema = node.inputs[0].schema
+        keys: List[Tuple[str, bool]] = [
+            (kk, bool(d)) for kk, d in node.params["keys"]
+        ]
+        operands_fn = K.ordering_operands(in_schema, keys)
+        src_p = node.inputs[0].partition
+        # Exchange elision requires matching *direction* too: ascending
+        # and descending ranges are different partitionings.  Bucketing
+        # uses the primary operand only and equal primaries colocate, so
+        # a matching primary (name, desc) suffices.
+        already_ranged = (
+            src_p.scheme == "range"
+            and len(src_p.range_by) > 0
+            and src_p.range_by[0] == keys[0]
+        )
+        if not already_ranged:
+            stage.ops.append(
+                StageOp(
+                    "exchange_range",
+                    dict(slot=slot, operands_fn=operands_fn),
+                )
+            )
+            stage.ops.append(StageOp("resize", dict(slot=slot, factor=stage.growth)))
+        if node.kind == "order_by":
+            stage.ops.append(
+                StageOp("local_sort", dict(slot=slot, operands_fn=operands_fn))
+            )
+        self.cursor[node.id] = ("open", stage, slot)
+
+    # -- join ---------------------------------------------------------------
+    def _lower_join(self, node: Node) -> None:
+        left, right = node.inputs
+        lref = self._materialize(left)
+        rref = self._materialize(right)
+        stage = self._new_stage("join", [lref, rref])
+        lkeys = K.equality_cols(left.schema, node.params["left_keys"])
+        rkeys = K.equality_cols(right.schema, node.params["right_keys"])
+        if self._needs_hash_exchange_for(left, node.params["left_keys"]):
+            stage.ops.append(StageOp("exchange_hash", dict(slot=0, keys=lkeys)))
+            stage.ops.append(StageOp("resize", dict(slot=0, factor=1.0)))
+        if self._needs_hash_exchange_for(right, node.params["right_keys"]):
+            stage.ops.append(StageOp("exchange_hash", dict(slot=1, keys=rkeys)))
+            stage.ops.append(StageOp("resize", dict(slot=1, factor=1.0)))
+        jk = node.params.get("join_kind", "inner")
+        if jk == "count":
+            stage.ops.append(
+                StageOp(
+                    "group_join_count",
+                    dict(
+                        left_slot=0,
+                        right_slot=1,
+                        left_keys=lkeys,
+                        right_keys=rkeys,
+                        out=node.params["out"],
+                        expansion=node.params.get("expansion", 1.0),
+                    ),
+                )
+            )
+        elif jk == "inner":
+            stage.ops.append(
+                StageOp(
+                    "join",
+                    dict(
+                        left_slot=0,
+                        right_slot=1,
+                        left_keys=lkeys,
+                        right_keys=rkeys,
+                        expansion=node.params.get("expansion", 1.0),
+                        suffix=node.params.get("suffix", "_r"),
+                    ),
+                )
+            )
+            stage.growth = max(1.0, node.params.get("expansion", 1.0))
+        else:
+            stage.ops.append(
+                StageOp(
+                    "semi",
+                    dict(
+                        left_slot=0,
+                        right_slot=1,
+                        left_keys=lkeys,
+                        right_keys=rkeys,
+                        negate=(jk == "anti"),
+                        expansion=node.params.get("expansion", 1.0),
+                    ),
+                )
+            )
+        self.cursor[node.id] = ("open", stage, 0)
+
+    def _needs_hash_exchange_for(self, src: Node, keys: Sequence[str]) -> bool:
+        p = src.partition
+        return not (p.scheme == "hash" and tuple(p.keys) == tuple(keys))
+
+
+def _decompose_aggs(aggs):
+    """Builtin combiner decomposition: local partial + post-shuffle final.
+
+    The Seed/Accumulate/RecursiveAccumulate split for builtin aggregates
+    (reference ``DryadLinqDecomposition.cs:34``): count becomes local
+    count + final sum; mean becomes (sum, count) partials + final divide.
+    """
+    from dryad_tpu.ops.segmented import AggSpec
+
+    partial, final = [], []
+    for a in aggs:
+        if a.op == "sum":
+            partial.append(AggSpec("sum", a.col, a.out))
+            final.append(AggSpec("sum", a.out, a.out))
+        elif a.op == "count":
+            partial.append(AggSpec("count", None, a.out))
+            final.append(AggSpec("sum", a.out, a.out))
+        elif a.op in ("min", "max", "first", "any", "all"):
+            partial.append(AggSpec(a.op, a.col, a.out))
+            final.append(AggSpec(a.op, a.out, a.out))
+        elif a.op == "mean":
+            partial.append(AggSpec("sum", a.col, f"{a.out}#s"))
+            partial.append(AggSpec("count", None, f"{a.out}#c"))
+            final.append(AggSpec("sum", f"{a.out}#s", f"{a.out}#s"))
+            final.append(AggSpec("sum", f"{a.out}#c", f"{a.out}#c"))
+        else:
+            raise ValueError(f"unknown agg op {a.op!r}")
+    return partial, final
+
+
+def _finalize_fn(aggs):
+    """Post-shuffle finalize for aggs whose partials differ (mean)."""
+    means = [a for a in aggs if a.op == "mean"]
+    if not means:
+        return None
+
+    def fin(cols):
+        import jax.numpy as jnp
+
+        out = dict(cols)
+        for a in means:
+            s = out.pop(f"{a.out}#s").astype(jnp.float32)
+            c = out.pop(f"{a.out}#c").astype(jnp.float32)
+            out[a.out] = s / jnp.maximum(c, 1.0)
+        return out
+
+    return fin
+
+
+def lower(roots: Sequence[Node], config) -> StageGraph:
+    """Lower a logical DAG to a stage graph (Phase 2+3)."""
+    b = _Builder(config)
+    fanout = consumers(roots)
+    for node in walk(roots):
+        b.lower_node(node, fanout)
+    outputs: Dict[int, Tuple[int, int]] = {}
+    for r in roots:
+        outputs[r.id] = b._materialize(r)
+    return StageGraph(b.stages, outputs, b.plan_inputs)

@@ -1,0 +1,88 @@
+"""Executor tests: versioned retry, failure budget, adaptive overflow
+retry (regression for per-device overflow flags), stats, event log."""
+
+import numpy as np
+import pytest
+
+from dryad_tpu import DryadConfig, DryadContext
+from dryad_tpu.exec.executor import StageFailedError
+from dryad_tpu.exec.faults import clear_faults, set_fake_stage_failure
+from dryad_tpu.exec.stats import StageStatistics
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    clear_faults()
+    yield
+    clear_faults()
+
+
+def test_injected_failure_retries_and_succeeds(mesh8):
+    ctx = DryadContext(num_partitions_=8)
+    set_fake_stage_failure("group_by", 1)
+    out = ctx.from_arrays({"k": np.arange(100, dtype=np.int32)}).group_by(
+        "k", {"n": ("count", None)}
+    ).collect()
+    assert out["n"].sum() == 100
+    kinds = [e["kind"] for e in ctx.events.events()]
+    assert "stage_failed" in kinds
+    assert kinds.count("stage_complete") >= 1
+
+
+def test_failure_budget_exceeded(mesh8):
+    ctx = DryadContext(num_partitions_=8, config=DryadConfig(max_stage_failures=2))
+    set_fake_stage_failure("group_by", 99)
+    with pytest.raises(StageFailedError, match="failure budget"):
+        ctx.from_arrays({"k": np.arange(10, dtype=np.int32)}).group_by(
+            "k", {"n": ("count", None)}
+        ).collect()
+    assert [e for e in ctx.events.events() if e["kind"] == "job_failed"]
+
+
+def test_no_silent_row_loss_on_uneven_receive(mesh8):
+    """Regression: resize overflow on ONE device must trip the global
+    retry — previously the per-device flag was read as 'replicated' and
+    rows silently vanished (98/100 keys)."""
+    ctx = DryadContext(num_partitions_=8)
+    for n in (100, 257, 1000):
+        out = ctx.from_arrays({"k": np.arange(n, dtype=np.int32)}).group_by(
+            "k", {"c": ("count", None)}
+        ).collect()
+        assert len(out["k"]) == n, f"lost keys at n={n}"
+        assert set(out["k"].tolist()) == set(range(n))
+
+
+def test_overflow_boost_event_emitted(mesh8):
+    # Distinct keys with tiny slack: no combiner help, forces boost retry.
+    ctx = DryadContext(
+        num_partitions_=8, config=DryadConfig(shuffle_slack=1.0)
+    )
+    n = 4096
+    out = ctx.from_arrays({"k": np.arange(n, dtype=np.int32)}).group_by(
+        "k", {"c": ("count", None)}
+    ).collect()
+    assert len(out["k"]) == n
+
+
+def test_stage_statistics_outlier_model():
+    st = StageStatistics(outlier_sigmas=3.0)
+    assert st.outlier_threshold() is None  # too few samples
+    for d in [1.0, 1.1, 0.9, 1.05, 0.95, 1.0, 1.02, 0.98, 1.0, 1.0]:
+        st.record(d)
+    thr = st.outlier_threshold()
+    assert thr is not None and thr < 2.0
+    assert st.is_outlier(5.0)
+    assert not st.is_outlier(1.0)
+
+
+def test_event_log_jsonl_roundtrip(tmp_path):
+    from dryad_tpu.exec.events import EventLog
+
+    path = str(tmp_path / "ev.jsonl")
+    log = EventLog(path)
+    log.emit("job_start", stages=3)
+    log.emit("stage_complete", stage=1, seconds=0.5)
+    log.close()
+    back = EventLog.load(path)
+    assert [e["kind"] for e in back] == ["job_start", "stage_complete"]
+    assert back[0]["stages"] == 3
