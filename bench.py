@@ -1,0 +1,98 @@
+"""Benchmark: flagship group-reduce (WordCount core) throughput.
+
+Runs the fused per-chip pipeline of BASELINE config #1 — hashed-key
+segmented group-reduce (sort + segment boundaries + scatter-add), the
+device kernel behind GroupBy/WordCount — on the available accelerator,
+and compares against a single-core NumPy implementation of the same
+aggregation as the host baseline (the reference publishes no numbers;
+see BASELINE.md).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "rows/s", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def device_rows_per_sec(n: int = 1 << 22, keys: int = 1 << 12, iters: int = 8) -> float:
+    """Pure device throughput: the iteration loop runs ON device
+    (lax.fori_loop) with a checksum carry, so host<->device round-trip
+    latency (large through the remote-chip tunnel) is amortized away
+    and dead-code elimination can't skip iterations."""
+    import jax
+    import jax.numpy as jnp
+
+    from dryad_tpu.columnar.batch import ColumnBatch
+    from dryad_tpu.ops.segmented import AggSpec, group_reduce
+
+    rng = np.random.default_rng(0)
+    k = rng.integers(0, keys, n).astype(np.int32)
+    v = rng.standard_normal(n).astype(np.float32)
+
+    def run(data, valid, iters_arr):
+        def body(i, acc):
+            b = ColumnBatch(
+                {"k": data["k"] ^ i, "v": data["v"]}, valid
+            )  # vary keys per iter to defeat CSE
+            out = group_reduce(
+                b, ["k"], [AggSpec("sum", "v", "s"), AggSpec("count", None, "c")]
+            )
+            return acc + jnp.sum(jnp.where(out.valid, out.data["s"], 0.0))
+
+        return jax.lax.fori_loop(0, iters_arr, body, jnp.float32(0.0))
+
+    fn = jax.jit(run, static_argnums=2)
+    data = {"k": jnp.asarray(k), "v": jnp.asarray(v)}
+    valid = jnp.ones((n,), jnp.bool_)
+    float(fn(data, valid, 1))  # compile + warm
+
+    float(fn(data, valid, iters + 1))  # compile the long variant too
+
+    t0 = time.perf_counter()
+    float(fn(data, valid, 1))
+    dt_one = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    float(fn(data, valid, iters + 1))
+    dt_many = time.perf_counter() - t0
+    # Marginal per-iteration time removes the fixed launch+fetch cost.
+    dt = max((dt_many - dt_one) / iters, 1e-9)
+    return n / dt
+
+
+def host_baseline_rows_per_sec(n: int = 1 << 20, keys: int = 1 << 12) -> float:
+    rng = np.random.default_rng(0)
+    k = rng.integers(0, keys, n).astype(np.int32)
+    v = rng.standard_normal(n).astype(np.float32)
+    t0 = time.perf_counter()
+    s = np.bincount(k, weights=v, minlength=keys)
+    c = np.bincount(k, minlength=keys)
+    # include the sort a comparable engine pays for grouped output
+    order = np.argsort(k, kind="stable")
+    _ = k[order]
+    dt = time.perf_counter() - t0
+    assert s.shape == c.shape
+    return n / dt
+
+
+def main() -> None:
+    value = device_rows_per_sec()
+    baseline = host_baseline_rows_per_sec()
+    print(
+        json.dumps(
+            {
+                "metric": "group_reduce_rows_per_sec",
+                "value": round(value, 1),
+                "unit": "rows/s",
+                "vs_baseline": round(value / baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

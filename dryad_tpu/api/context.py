@@ -233,10 +233,21 @@ class DryadContext:
 
     # -- do_while support ----------------------------------------------------
     def _run_subquery(self, plan_fn, schema: Schema, current: ColumnBatch, scalar: bool = False):
-        q0 = self._from_device_batch(current, schema)
-        out_q = plan_fn(q0)
+        # Build each body/cond plan ONCE per do_while and rebind the input
+        # batch on later iterations — re-building would create fresh
+        # closures every iteration and defeat the executor's structural
+        # compile cache (one XLA compile per iteration).
+        cache_key = (id(plan_fn), tuple(schema.names))
+        cached = getattr(self, "_subplans", None)
+        if cached is None:
+            cached = self._subplans = {}
+        if cache_key not in cached:
+            q0 = self._from_device_batch(current, schema)
+            cached[cache_key] = (q0.node.id, plan_fn(q0))
+        input_node_id, out_q = cached[cache_key]
+        self._bindings[input_node_id] = ("device", current)
         if scalar:
-            table = out_q.collect()
+            table = self.run_to_host(out_q)
             col = next(iter(table.values()))
             return bool(col[0]) if len(col) else False
         return self._execute_device(out_q)

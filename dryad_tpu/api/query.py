@@ -425,9 +425,13 @@ class Query:
         return self.aggregate_as_query({"count": ("count", None)})
 
     def _scalar(self, op: str, col: Optional[str]):
-        q = self.aggregate_as_query({"v": (op, col)})
+        # min/max/mean/any/all on an empty table would otherwise surface
+        # the reduction's dtype sentinel; count alongside guards it.
+        q = self.aggregate_as_query({"v": (op, col), "n": ("count", None)})
         table = q.collect()
-        return table["v"][0].item() if len(table["v"]) else None
+        if op not in ("count", "sum") and int(table["n"][0]) == 0:
+            return None
+        return table["v"][0].item()
 
     def count(self) -> int:
         return int(self._scalar("count", None))

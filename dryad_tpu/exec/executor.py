@@ -63,8 +63,25 @@ class GraphExecutor:
         self.subquery_runner = subquery_runner
 
     # -- compilation cache -------------------------------------------------
+    @staticmethod
+    def _stage_key(stage: Stage) -> Tuple:
+        """Structural stage identity: op kinds + static params + fn object
+        ids.  Re-lowering the same logical plan yields new stage ids but
+        identical structure (fn objects live on the plan nodes), so
+        repeated collect()/do_while iterations hit the cache."""
+        parts = []
+        for op in stage.ops:
+            items = []
+            for k, v in sorted(op.params.items()):
+                if callable(v) or not isinstance(v, (int, float, str, bool, tuple, list, type(None))):
+                    items.append((k, id(v)))
+                else:
+                    items.append((k, tuple(v) if isinstance(v, list) else v))
+            parts.append((op.kind, tuple(items)))
+        return (tuple(parts), tuple(stage.out_slots))
+
     def _get_compiled(self, stage: Stage, boost: int, shape_key: Tuple):
-        key = (stage.id, boost, shape_key)
+        key = (self._stage_key(stage), boost, shape_key)
         hit = self._compiled.get(key)
         if hit is None:
             fn = build_stage_fn(stage, self.P, self.config.shuffle_slack, boost)

@@ -304,14 +304,12 @@ def _k_sliding_window(ctx: StageContext, p) -> None:
 # -- global ops ------------------------------------------------------------
 
 def _k_take(ctx: StageContext, p) -> None:
-    b = ctx.slots[p["slot"]].compact()
-    local = jnp.sum(b.valid.astype(jnp.int32))
-    counts = jax.lax.all_gather(local, AXIS)
-    me = jax.lax.axis_index(AXIS)
-    offset = jnp.sum(jnp.where(jnp.arange(ctx.P) < me, counts, 0))
-    rank = offset + jnp.arange(b.capacity, dtype=jnp.int32)
-    keep = b.valid & (rank < p["n"])
-    ctx.slots[p["slot"]] = ColumnBatch(b.data, keep)
+    b, _total = _rank_column(ctx.slots[p["slot"]], ctx.P)
+    rank = b.data["#rank"]
+    keep = b.valid & (rank < jnp.uint32(p["n"]))
+    ctx.slots[p["slot"]] = ColumnBatch(
+        {n: c for n, c in b.data.items() if n != "#rank"}, keep
+    )
 
 
 def _k_scalar_agg(ctx: StageContext, p) -> None:

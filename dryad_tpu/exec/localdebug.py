@@ -315,6 +315,15 @@ class LocalDebugInterpreter:
                 out[name] = np.array([n], np.int32)
             elif op == "sum":
                 out[name] = np.array([a.sum(dtype=a.dtype)])
+            elif n == 0 and op in ("min", "max", "mean", "any", "all"):
+                # Sentinel row; Query._scalar returns None via the count
+                # guard, matching the device engine.
+                if op == "mean":
+                    out[name] = np.zeros(1, np.float32)
+                elif op in ("any", "all"):
+                    out[name] = np.array([op == "all"])
+                else:
+                    out[name] = np.zeros(1, a.dtype)
             elif op == "min":
                 out[name] = np.array([a.min()])
             elif op == "max":

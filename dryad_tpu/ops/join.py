@@ -108,36 +108,24 @@ def hash_join(
             continue
         data[_suffixed(name, suffix) if name in data else name] = col[ri]
 
-    # Exact-equality verification kills hash collisions.
-    exact = jnp.ones((out_capacity,), jnp.bool_)
-    for lk, rkey in zip(left_keys, right_keys):
-        exact = exact & (left.data[lk][li] == rs.data[rkey][ri])
-    valid = pair_valid & left.valid[li] & rs.valid[ri] & exact
+    valid = _exact_pair_match(left, rs, left_keys, right_keys, li, ri, pair_valid)
     return ColumnBatch(data, valid), overflow
 
 
-def exists_mask(
+def _exact_pair_match(
     left: ColumnBatch,
-    right: ColumnBatch,
+    rs: ColumnBatch,
     left_keys: Sequence[str],
     right_keys: Sequence[str],
-    out_capacity: int,
-) -> Tuple[jax.Array, jax.Array]:
-    """Per-left-row 'has an exactly-matching right row' (semi/anti join).
-
-    Enumerates hash-candidate pairs (bounded by ``out_capacity``) and
-    reduces exact matches back onto left rows.  Returns (mask, overflow).
-    """
-    rs, lhash, start, counts = _probe_ranges(left, right, left_keys, right_keys)
-    li, ri, pair_valid, overflow = _expand_pairs(start, counts, out_capacity)
-
+    li: jax.Array,
+    ri: jax.Array,
+    pair_valid: jax.Array,
+) -> jax.Array:
+    """Candidate pairs that match on ALL key columns (kills collisions)."""
     exact = pair_valid & left.valid[li] & rs.valid[ri]
     for lk, rkey in zip(left_keys, right_keys):
         exact = exact & (left.data[lk][li] == rs.data[rkey][ri])
-
-    n = left.capacity
-    hits = jnp.zeros((n,), jnp.int32).at[li].add(exact.astype(jnp.int32), mode="drop")
-    return hits > 0, overflow
+    return exact
 
 
 def group_join_counts(
@@ -149,11 +137,23 @@ def group_join_counts(
 ) -> Tuple[jax.Array, jax.Array]:
     """Per-left-row count of exactly-matching right rows (GroupJoin's
     shape; aggregations over the group compose on the joined output)."""
-    rs, lhash, start, counts = _probe_ranges(left, right, left_keys, right_keys)
+    rs, _lhash, start, counts = _probe_ranges(left, right, left_keys, right_keys)
     li, ri, pair_valid, overflow = _expand_pairs(start, counts, out_capacity)
-    exact = pair_valid & left.valid[li] & rs.valid[ri]
-    for lk, rkey in zip(left_keys, right_keys):
-        exact = exact & (left.data[lk][li] == rs.data[rkey][ri])
+    exact = _exact_pair_match(left, rs, left_keys, right_keys, li, ri, pair_valid)
     n = left.capacity
     cnt = jnp.zeros((n,), jnp.int32).at[li].add(exact.astype(jnp.int32), mode="drop")
     return cnt, overflow
+
+
+def exists_mask(
+    left: ColumnBatch,
+    right: ColumnBatch,
+    left_keys: Sequence[str],
+    right_keys: Sequence[str],
+    out_capacity: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-left-row 'has an exactly-matching right row' (semi/anti join)."""
+    counts, overflow = group_join_counts(
+        left, right, left_keys, right_keys, out_capacity
+    )
+    return counts > 0, overflow

@@ -126,7 +126,6 @@ class _Builder:
             self.cursor[node.id] = ("open", stage, 0)
 
         elif k in ("select", "where", "select_many", "apply", "take"):
-            src_cons = 1  # this node is src's consumer; fusion decided by src fanout
             stage, slot = self._continue_or_start(node, fanout.get(node.inputs[0].id, 1))
             if k == "select":
                 stage.ops.append(StageOp("select", dict(slot=slot, fn=node.params["fn"])))
@@ -154,9 +153,11 @@ class _Builder:
                 )
                 stage.growth *= node.params.get("cap_factor", 1.0)
             elif k == "take":
-                ordered = bool(node.inputs[0].partition.ordered_by)
+                # Global rank is partition-major, so take() after order_by
+                # yields the first n in sort order; on unordered input it
+                # is the first n in engine (== ingestion) order.
                 stage.ops.append(
-                    StageOp("take", dict(slot=slot, n=node.params["n"], ordered=ordered))
+                    StageOp("take", dict(slot=slot, n=node.params["n"]))
                 )
             self.cursor[node.id] = ("open", stage, slot)
 

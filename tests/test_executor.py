@@ -86,3 +86,51 @@ def test_event_log_jsonl_roundtrip(tmp_path):
     back = EventLog.load(path)
     assert [e["kind"] for e in back] == ["job_start", "stage_complete"]
     assert back[0]["stages"] == 3
+
+
+def test_scalar_min_max_on_empty_table(mesh8):
+    from dryad_tpu import DryadContext
+
+    for ctx in (DryadContext(num_partitions_=8), DryadContext(local_debug=True)):
+        q = ctx.from_arrays({"v": np.arange(5, dtype=np.int32)}).where(
+            lambda c: c["v"] > 100
+        )
+        assert q.min_("v") is None
+        assert q.max_("v") is None
+        assert q.count() == 0
+        assert q.sum_("v") == 0
+
+
+def test_compile_cache_hits_across_collects(mesh8):
+    from dryad_tpu import DryadContext
+
+    ctx = DryadContext(num_partitions_=8)
+    q = ctx.from_arrays({"k": np.arange(64, dtype=np.int32)}).group_by(
+        "k", {"c": ("count", None)}
+    )
+    q.collect()
+    n1 = len(ctx.executor._compiled)
+    q.collect()
+    n2 = len(ctx.executor._compiled)
+    assert n2 == n1, f"recompiled on identical re-collect: {n1} -> {n2}"
+
+
+def test_do_while_compiles_body_once(mesh8):
+    from dryad_tpu import DryadContext
+
+    ctx = DryadContext(num_partitions_=8)
+    tbl = {"x": np.array([1.0], np.float32)}
+
+    def body(q):
+        return q.select(lambda cols: {"x": cols["x"] * 2})
+
+    def cond(q):
+        return q.aggregate_as_query({"m": ("max", "x")}).select(
+            lambda cols: {"go": cols["m"] < 1000.0}
+        )
+
+    out = ctx.from_arrays(tbl).do_while(body, cond, max_iter=30).collect()
+    assert out["x"][0] >= 1000.0
+    n_after = len(ctx.executor._compiled)
+    # body+cond compile once each (plus ingestion/egress stages), not per-iteration
+    assert n_after <= 6, f"do_while recompiled per iteration: {n_after} programs"

@@ -1,0 +1,22 @@
+"""Driver entry points must stay importable and runnable."""
+
+import sys
+
+import jax
+import pytest
+
+
+def test_entry_compiles():
+    sys.path.insert(0, "/root/repo")
+    from __graft_entry__ import entry
+
+    fn, args = entry()
+    out = jax.jit(fn)(*args)
+    jax.block_until_ready(out)
+
+
+def test_dryrun_multichip_8():
+    sys.path.insert(0, "/root/repo")
+    from __graft_entry__ import dryrun_multichip
+
+    dryrun_multichip(8)
