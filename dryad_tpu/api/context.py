@@ -114,6 +114,40 @@ class DryadContext:
         self._bindings[node.id] = ("host", arrays, partition_capacity)
         return Query(self, node)
 
+    def from_text(self, data, column: str = "word") -> Query:
+        """Tokenize raw text into a one-STRING-column table using the
+        native tokenizer (reference WordCount ingest; tokenization
+        happens in generated vertex code there, at the ingest edge
+        here).  ``data`` is a filesystem path, str, or bytes."""
+        from dryad_tpu.runtime import bindings as RB
+
+        if isinstance(data, str) and os.path.exists(data):
+            with open(data, "rb") as fh:
+                buf = fh.read()
+        elif isinstance(data, str):
+            buf = data.encode("utf-8")
+        else:
+            buf = bytes(data)
+        h0, h1, r0, starts, lens = RB.tokenize(buf)
+        hashes = (h1.astype(np.uint64) << np.uint64(32)) | h0.astype(np.uint64)
+        uniq, first_idx = np.unique(hashes, return_index=True)
+        for h, i in zip(uniq, first_idx):
+            s = int(starts[i])
+            tok = buf[s : s + int(lens[i])].decode("utf-8", "replace")
+            existing = self.dictionary._map.get(int(h))
+            if existing is not None and existing != tok:
+                raise ValueError(f"hash64 collision: {existing!r} vs {tok!r}")
+            self.dictionary._map[int(h)] = tok
+        schema = Schema([(column, ColumnType.STRING)])
+        node = Node(
+            "input", [], schema, PartitionInfo.roundrobin(), source="host_physical",
+        )
+        self._bindings[node.id] = (
+            "host_physical",
+            {f"{column}#h0": h0, f"{column}#h1": h1, f"{column}#r0": r0},
+        )
+        return Query(self, node)
+
     def from_store(self, path: str) -> Query:
         """Open a partitioned store (reference FromStore/GetTable)."""
         schema, parts, dictionary = CIO.read_store(path)
@@ -140,6 +174,9 @@ class DryadContext:
                 node.schema, arrays, self.mesh,
                 partition_capacity=cap, dictionary=self.dictionary,
             )
+        if kind == "host_physical":
+            (phys,) = rest
+            return D.from_physical_table(phys, self.mesh)
         if kind == "store":
             parts, schema = rest
             P = num_partitions(self.mesh)
@@ -236,8 +273,10 @@ class DryadContext:
         # Build each body/cond plan ONCE per do_while and rebind the input
         # batch on later iterations — re-building would create fresh
         # closures every iteration and defeat the executor's structural
-        # compile cache (one XLA compile per iteration).
-        cache_key = (id(plan_fn), tuple(schema.names))
+        # compile cache (one XLA compile per iteration).  Keyed by the
+        # function OBJECT (strong ref), not id(): a freed function's id
+        # can be reused and would serve the previous do_while's plan.
+        cache_key = (plan_fn, tuple(schema.names))
         cached = getattr(self, "_subplans", None)
         if cached is None:
             cached = self._subplans = {}

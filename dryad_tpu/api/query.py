@@ -294,7 +294,12 @@ class Query:
         return Query(self.ctx, node)
 
     def take(self, n: int) -> "Query":
-        node = Node("take", [self.node], self.schema, self.node.partition, n=int(n))
+        # LINQ Take clamps negative counts to an empty sequence; the
+        # kernel compares uint32 ranks, so a raw negative would wrap.
+        node = Node(
+            "take", [self.node], self.schema, self.node.partition,
+            n=max(0, int(n)),
+        )
         return Query(self.ctx, node)
 
     def group_join_count(

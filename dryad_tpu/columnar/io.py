@@ -54,17 +54,23 @@ def write_partition_file(
             fh.write(p)
 
 
-def read_partition_file(path: str) -> Dict[str, np.ndarray]:
-    # The native runtime provides a faster reader for the same format.
-    with open(path, "rb") as fh:
-        header = json.loads(fh.readline().decode("utf-8"))
-        out: Dict[str, np.ndarray] = {}
-        for c in header["columns"]:
-            data = fh.read(c["nbytes"])
-            if c["comp"] == "zlib":
-                data = zlib.decompress(data)
-            out[c["name"]] = np.frombuffer(data, dtype=np.dtype(c["dtype"])).copy()
+def parse_partition_bytes(buf: bytes) -> Dict[str, np.ndarray]:
+    nl = buf.index(b"\n")
+    header = json.loads(buf[:nl].decode("utf-8"))
+    out: Dict[str, np.ndarray] = {}
+    at = nl + 1
+    for c in header["columns"]:
+        data = buf[at : at + c["nbytes"]]
+        at += c["nbytes"]
+        if c["comp"] == "zlib":
+            data = zlib.decompress(data)
+        out[c["name"]] = np.frombuffer(data, dtype=np.dtype(c["dtype"])).copy()
     return out
+
+
+def read_partition_file(path: str) -> Dict[str, np.ndarray]:
+    with open(path, "rb") as fh:
+        return parse_partition_bytes(fh.read())
 
 
 def write_store(
@@ -104,8 +110,13 @@ def read_store(
         with open(dpath) as fh:
             for h, s in json.load(fh).items():
                 dictionary._map[int(h, 16)] = s
-    parts = [
-        read_partition_file(os.path.join(path, _part_name(i)))
-        for i in range(manifest["partitions"])
+    # Background-prefetched ordered reads via the native channel reader
+    # (Python fallback inside PrefetchChannel when the lib is absent).
+    from dryad_tpu.runtime.bindings import PrefetchChannel
+
+    paths = [
+        os.path.join(path, _part_name(i)) for i in range(manifest["partitions"])
     ]
+    with PrefetchChannel(paths, depth=4, threads=2) as ch:
+        parts = [parse_partition_bytes(buf) for buf in ch]
     return schema, parts, dictionary

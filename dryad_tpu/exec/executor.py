@@ -73,10 +73,17 @@ class GraphExecutor:
         for op in stage.ops:
             items = []
             for k, v in sorted(op.params.items()):
-                if callable(v) or not isinstance(v, (int, float, str, bool, tuple, list, type(None))):
-                    items.append((k, id(v)))
-                else:
-                    items.append((k, tuple(v) if isinstance(v, list) else v))
+                if isinstance(v, list):
+                    v = tuple(v)
+                try:
+                    hash(v)
+                except TypeError:
+                    v = repr(v)  # unhashable static param: structural repr
+                # Hashable objects (incl. functions, AggSpecs) go into the
+                # key BY REFERENCE — the key holds them alive, so a freed
+                # object's id can never alias a new one (id()-keyed caches
+                # silently serve stale compiled programs after GC reuse).
+                items.append((k, v))
             parts.append((op.kind, tuple(items)))
         return (tuple(parts), tuple(stage.out_slots))
 

@@ -103,6 +103,9 @@ class LocalDebugInterpreter:
             for c in parts[0].keys():
                 out[c] = np.concatenate([p[c] for p in parts])
             return out
+        if kind == "host_physical":
+            (phys,) = rest
+            return {k: np.asarray(v) for k, v in phys.items()}
         if kind == "table":  # bound by do_while recursion
             return rest[0]
         raise RuntimeError(f"localdebug: unsupported input binding {kind}")

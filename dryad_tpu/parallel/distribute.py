@@ -40,30 +40,51 @@ def from_host_table(
     (``DryadLinqContext.cs:1176-1223``); every shard is near-equal
     before the first shuffle.
     """
-    P = num_partitions(mesh)
     names = schema.names
     n = len(np.asarray(arrays[names[0]])) if names else 0
-    per = -(-n // P) if n else 1  # ceil
+    # Encode once at exactly n rows (only real rows are hashed /
+    # dictionary-registered), then block-partition the physical columns
+    # through the shared path.
+    encoded = ColumnBatch.from_numpy(schema, arrays, capacity=n, dictionary=dictionary)
+    phys = {c: np.asarray(v) for c, v in encoded.data.items()}
+    return from_physical_table(phys, mesh, partition_capacity)
+
+
+def from_physical_table(
+    phys: Dict[str, np.ndarray],
+    mesh: Mesh,
+    partition_capacity: Optional[int] = None,
+) -> ColumnBatch:
+    """Block-partition already-encoded physical columns (no hashing).
+
+    Partition p holds contiguous rows [p*per, (p+1)*per), so the
+    engine's partition-major global order equals the original row order
+    (zip/take semantics match the host table).
+    """
+    P = num_partitions(mesh)
+    names = list(phys.keys())
+    n = len(np.asarray(phys[names[0]])) if names else 0
+    per = -(-n // P) if n else 1
     cap = partition_capacity if partition_capacity is not None else per
     if cap < per:
         raise ValueError(f"partition_capacity {cap} < required {per}")
+    import jax.numpy as jnp
 
-    # Block layout: partition p holds contiguous rows [p*per, (p+1)*per),
-    # so the engine's partition-major global order equals the original
-    # row order (zip/take semantics match the host table).  Encode each
-    # partition separately so only real rows are hashed /
-    # dictionary-registered; from_numpy pads the per-partition tail.
-    idx_by_part = [np.arange(p * per, min((p + 1) * per, n)) for p in range(P)]
-    parts = [
-        ColumnBatch.from_numpy(
-            schema,
-            {name: np.asarray(arrays[name])[idx] for name in names},
-            capacity=cap,
-            dictionary=dictionary,
-        )
-        for idx in idx_by_part
-    ]
-    return shard_batch(ColumnBatch.concatenate(parts), mesh)
+    batches = []
+    for p in range(P):
+        lo = min(p * per, n)
+        hi = min((p + 1) * per, n)
+        m = hi - lo
+        data = {}
+        for c in names:
+            a = np.asarray(phys[c])
+            pad = np.zeros((cap,) + a.shape[1:], a.dtype)
+            pad[:m] = a[lo:hi]
+            data[c] = jnp.asarray(pad)
+        valid = np.zeros(cap, np.bool_)
+        valid[:m] = True
+        batches.append(ColumnBatch(data, jnp.asarray(valid)))
+    return shard_batch(ColumnBatch.concatenate(batches), mesh)
 
 
 def to_host_table(

@@ -1,0 +1,189 @@
+"""ctypes bindings for the native runtime, with pure-Python fallbacks.
+
+The shared library is built from ``runtime/native`` with the checked-in
+Makefile; if it is missing we attempt one build, then fall back to
+Python implementations (correct, slower).  Every native function has an
+identical-semantics Python twin so the engine never *requires* the
+native library.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+import zlib
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from dryad_tpu.columnar.schema import hash64_bytes
+from dryad_tpu.utils.logging import get_logger
+
+log = get_logger("dryad_tpu.runtime")
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libdryadnative.so")
+_lib = None
+_lib_tried = False
+_lock = threading.Lock()
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_tried
+    with _lock:
+        if _lib is not None or _lib_tried:
+            return _lib
+        _lib_tried = True
+        if not os.path.exists(_LIB_PATH):
+            try:
+                subprocess.run(
+                    ["make", "-C", _NATIVE_DIR],
+                    check=True, capture_output=True, timeout=120,
+                )
+            except Exception as e:  # no toolchain: fall back
+                log.warning("native build failed (%s); using Python fallbacks", e)
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError as e:
+            log.warning("native load failed (%s); using Python fallbacks", e)
+            return None
+        lib.dn_hash64.restype = ctypes.c_uint64
+        lib.dn_hash64.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        lib.dn_token_count.restype = ctypes.c_size_t
+        lib.dn_token_count.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        lib.dn_tokenize.restype = ctypes.c_size_t
+        lib.dn_tokenize.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint32),
+        ]
+        lib.dn_channel_open.restype = ctypes.c_void_p
+        lib.dn_channel_open.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_size_t,
+            ctypes.c_size_t, ctypes.c_size_t,
+        ]
+        lib.dn_channel_next.restype = ctypes.c_int64
+        lib.dn_channel_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ]
+        lib.dn_channel_close.restype = None
+        lib.dn_channel_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        log.info("native runtime loaded from %s", _LIB_PATH)
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def hash64(data: bytes) -> int:
+    lib = _load()
+    if lib is not None:
+        return int(lib.dn_hash64(data, len(data)))
+    return hash64_bytes(data)
+
+
+def tokenize(
+    text: bytes,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Whitespace-tokenize a byte buffer into columnar token arrays.
+
+    Returns (h0, h1, r0, starts, lens): Hash64 word pairs, 4-byte prefix
+    ranks, and byte offsets/lengths for dictionary construction.
+    """
+    lib = _load()
+    if lib is not None:
+        n = lib.dn_token_count(text, len(text))
+        h0 = np.empty(n, np.uint32)
+        h1 = np.empty(n, np.uint32)
+        r0 = np.empty(n, np.uint32)
+        starts = np.empty(n, np.uint64)
+        lens = np.empty(n, np.uint32)
+        got = lib.dn_tokenize(
+            text, len(text), n,
+            h0.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            h1.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            r0.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            starts.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        )
+        assert got == n
+        return h0, h1, r0, starts, lens
+
+    # Python fallback
+    from dryad_tpu.columnar.schema import string_prefix_rank
+
+    tokens = []
+    starts_l = []
+    i = 0
+    while i < len(text):
+        while i < len(text) and text[i : i + 1].isspace():
+            i += 1
+        if i >= len(text):
+            break
+        s = i
+        while i < len(text) and not text[i : i + 1].isspace():
+            i += 1
+        tokens.append(text[s:i])
+        starts_l.append(s)
+    hashes = np.array([hash64_bytes(t) for t in tokens], np.uint64)
+    h0 = (hashes & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    h1 = (hashes >> np.uint64(32)).astype(np.uint32)
+    r0 = string_prefix_rank(np.array([t.decode("utf-8", "replace") for t in tokens], object))
+    return (
+        h0, h1, r0,
+        np.array(starts_l, np.uint64),
+        np.array([len(t) for t in tokens], np.uint32),
+    )
+
+
+class PrefetchChannel:
+    """Ordered multi-file reader with background prefetch.
+
+    The analog of the reference's async channel buffer readers; iterate
+    to get each file's bytes in order.
+    """
+
+    def __init__(self, paths: List[str], depth: int = 4, threads: int = 2):
+        self.paths = list(paths)
+        self._lib = _load()
+        self._handle = None
+        self._fallback_iter = None
+        if self._lib is not None:
+            arr = (ctypes.c_char_p * len(self.paths))(
+                *[p.encode() for p in self.paths]
+            )
+            self._handle = self._lib.dn_channel_open(
+                arr, len(self.paths), depth, threads
+            )
+
+    def __iter__(self):
+        if self._handle is not None:
+            ptr = ctypes.POINTER(ctypes.c_uint8)()
+            while True:
+                n = self._lib.dn_channel_next(self._handle, ctypes.byref(ptr))
+                if n == -1:
+                    break
+                if n == -2:
+                    raise IOError("native channel read error")
+                yield ctypes.string_at(ptr, n)
+        else:
+            for p in self.paths:
+                with open(p, "rb") as fh:
+                    yield fh.read()
+
+    def close(self) -> None:
+        if self._handle is not None:
+            self._lib.dn_channel_close(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

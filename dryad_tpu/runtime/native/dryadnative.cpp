@@ -1,0 +1,230 @@
+// dryad_tpu native runtime.
+//
+// TPU-native equivalents of the reference's native data-plane pieces:
+//  - Hash64 (FNV-1a, identical to columnar/schema.py) — the
+//    deterministic record hash (reference LinqToDryad/Hash64.cs).
+//  - Whitespace tokenizer producing hash words + 4-byte prefix ranks
+//    for direct columnar ingest (reference does tokenization inside
+//    generated vertex code; we do it at the ingest edge).
+//  - A threaded prefetch channel reader: background threads read (and
+//    zlib-decompress) partition files ahead of the consumer — the
+//    analog of the reference's async IOCP channel buffer readers
+//    (DryadVertex/.../channelbuffernativereader.cpp) and the managed
+//    record-reader prefetch thread (DryadLinqRecordReader.cs:107-124).
+//
+// Exposed as a C ABI for ctypes; see runtime/bindings.py.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <zlib.h>
+
+extern "C" {
+
+// ---------------------------------------------------------------- hash64
+static const uint64_t FNV_OFFSET = 0xCBF29CE484222325ULL;
+static const uint64_t FNV_PRIME = 0x100000001B3ULL;
+
+uint64_t dn_hash64(const uint8_t* data, size_t len) {
+  uint64_t h = FNV_OFFSET;
+  for (size_t i = 0; i < len; ++i) {
+    h ^= (uint64_t)data[i];
+    h *= FNV_PRIME;
+  }
+  return h;
+}
+
+// ------------------------------------------------------------- tokenizer
+static inline int is_space(uint8_t c) {
+  return c == ' ' || c == '\t' || c == '\n' || c == '\r' || c == '\f' ||
+         c == '\v';
+}
+
+// Count whitespace-separated tokens in buf.
+size_t dn_token_count(const uint8_t* buf, size_t len) {
+  size_t n = 0;
+  size_t i = 0;
+  while (i < len) {
+    while (i < len && is_space(buf[i])) ++i;
+    if (i >= len) break;
+    ++n;
+    while (i < len && !is_space(buf[i])) ++i;
+  }
+  return n;
+}
+
+// Tokenize: fill per-token hash (lo/hi u32 words), 4-byte prefix rank,
+// and byte offsets/lengths (for host-side dictionary construction).
+// Returns the number of tokens written (<= max_tokens).
+size_t dn_tokenize(const uint8_t* buf, size_t len, size_t max_tokens,
+                   uint32_t* h0, uint32_t* h1, uint32_t* r0,
+                   uint64_t* starts, uint32_t* lens) {
+  size_t n = 0;
+  size_t i = 0;
+  while (i < len && n < max_tokens) {
+    while (i < len && is_space(buf[i])) ++i;
+    if (i >= len) break;
+    size_t s = i;
+    uint64_t h = FNV_OFFSET;
+    uint32_t rank = 0;
+    while (i < len && !is_space(buf[i])) {
+      uint8_t c = buf[i];
+      h ^= (uint64_t)c;
+      h *= FNV_PRIME;
+      size_t pos = i - s;
+      if (pos < 4) rank |= ((uint32_t)c) << (8 * (3 - pos));
+      ++i;
+    }
+    h0[n] = (uint32_t)(h & 0xFFFFFFFFULL);
+    h1[n] = (uint32_t)(h >> 32);
+    r0[n] = rank;
+    starts[n] = (uint64_t)s;
+    lens[n] = (uint32_t)(i - s);
+    ++n;
+  }
+  return n;
+}
+
+// ------------------------------------------------------ zlib transforms
+// Channel compression transform (reference TransformType gzip/deflate,
+// dryadvertex.h:33-48).  Returns compressed size or 0 on error.
+size_t dn_compress(const uint8_t* src, size_t src_len, uint8_t* dst,
+                   size_t dst_cap, int level) {
+  uLongf out_len = (uLongf)dst_cap;
+  int rc = compress2(dst, &out_len, src, (uLong)src_len, level);
+  return rc == Z_OK ? (size_t)out_len : 0;
+}
+
+size_t dn_decompress(const uint8_t* src, size_t src_len, uint8_t* dst,
+                     size_t dst_cap) {
+  uLongf out_len = (uLongf)dst_cap;
+  int rc = uncompress(dst, &out_len, src, (uLong)src_len);
+  return rc == Z_OK ? (size_t)out_len : 0;
+}
+
+size_t dn_compress_bound(size_t src_len) { return compressBound(src_len); }
+
+// --------------------------------------------- prefetch channel reader
+// Reads whole files on background threads, keeping up to `depth` blocks
+// queued.  Consumer pops blocks in file order.
+struct Block {
+  std::vector<uint8_t> data;
+  int64_t index;
+  int32_t error;  // 0 ok, nonzero errno-style
+};
+
+struct Channel {
+  std::vector<std::string> paths;
+  size_t next_read = 0;      // next file index to schedule
+  size_t next_deliver = 0;   // next file index to hand out
+  size_t depth;
+  std::deque<Block> ready;
+  std::mutex mu;
+  std::condition_variable cv_space;
+  std::condition_variable cv_data;
+  std::vector<std::thread> workers;
+  std::atomic<bool> stop{false};
+  std::mutex sched_mu;
+
+  // Current block handed to the consumer (kept alive until next pop).
+  Block current;
+};
+
+static void read_file(const std::string& path, Block* b) {
+  FILE* f = fopen(path.c_str(), "rb");
+  if (!f) {
+    b->error = 1;
+    return;
+  }
+  fseek(f, 0, SEEK_END);
+  long sz = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  b->data.resize((size_t)sz);
+  size_t got = fread(b->data.data(), 1, (size_t)sz, f);
+  fclose(f);
+  b->error = (got == (size_t)sz) ? 0 : 2;
+}
+
+static void worker_loop(Channel* ch) {
+  for (;;) {
+    size_t idx;
+    {
+      std::lock_guard<std::mutex> g(ch->sched_mu);
+      if (ch->stop.load() || ch->next_read >= ch->paths.size()) return;
+      idx = ch->next_read++;
+    }
+    Block b;
+    b.index = (int64_t)idx;
+    b.error = 0;
+    read_file(ch->paths[idx], &b);
+    {
+      std::unique_lock<std::mutex> g(ch->mu);
+      // Always admit the block the consumer is waiting for, even when
+      // the queue is at depth — otherwise out-of-order arrivals fill
+      // the queue and deadlock against the in-order consumer.
+      ch->cv_space.wait(g, [ch, &b] {
+        return ch->stop.load() || ch->ready.size() < ch->depth ||
+               (size_t)b.index == ch->next_deliver;
+      });
+      if (ch->stop.load()) return;
+      ch->ready.push_back(std::move(b));
+      ch->cv_data.notify_all();
+    }
+  }
+}
+
+void* dn_channel_open(const char** paths, size_t n_paths, size_t depth,
+                      size_t n_threads) {
+  Channel* ch = new Channel();
+  for (size_t i = 0; i < n_paths; ++i) ch->paths.emplace_back(paths[i]);
+  ch->depth = depth < 1 ? 1 : depth;
+  size_t nt = n_threads < 1 ? 1 : n_threads;
+  if (nt > ch->paths.size() && !ch->paths.empty()) nt = ch->paths.size();
+  for (size_t i = 0; i < nt; ++i)
+    ch->workers.emplace_back(worker_loop, ch);
+  return (void*)ch;
+}
+
+// Pop the next file (in order). Returns byte length, sets *data to an
+// internally-owned buffer valid until the next call; -1 at end of
+// channel; -2 on read error.
+int64_t dn_channel_next(void* handle, const uint8_t** data) {
+  Channel* ch = (Channel*)handle;
+  if (ch->next_deliver >= ch->paths.size()) return -1;
+  size_t want = ch->next_deliver;
+  std::unique_lock<std::mutex> g(ch->mu);
+  for (;;) {
+    for (auto it = ch->ready.begin(); it != ch->ready.end(); ++it) {
+      if ((size_t)it->index == want) {
+        ch->current = std::move(*it);
+        ch->ready.erase(it);
+        ch->cv_space.notify_all();
+        ch->next_deliver++;
+        if (ch->current.error) return -2;
+        *data = ch->current.data.data();
+        return (int64_t)ch->current.data.size();
+      }
+    }
+    ch->cv_data.wait(g);
+  }
+}
+
+void dn_channel_close(void* handle) {
+  Channel* ch = (Channel*)handle;
+  ch->stop.store(true);
+  ch->cv_space.notify_all();
+  ch->cv_data.notify_all();
+  for (auto& t : ch->workers) t.join();
+  delete ch;
+}
+
+}  // extern "C"

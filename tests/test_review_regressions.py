@@ -190,3 +190,36 @@ def test_group_join_count(ctx, dbg):
     by_k = dict(zip(got["k"].tolist(), got["match_count"].tolist()))
     assert by_k == {1: 2, 2: 0, 3: 3, 4: 0}
     check(q(ctx), q(dbg))
+
+
+def test_from_text_trailing_empty_partitions(ctx):
+    """9 tokens on 8 partitions: per=2 leaves partition 5+ empty."""
+    got = ctx.from_text("a b c d e f g h i").collect()
+    assert sorted(got["word"]) == sorted("a b c d e f g h i".split())
+    # 1 token on 8 partitions: 7 empty partitions
+    got1 = ctx.from_text("solo").collect()
+    assert got1["word"].tolist() == ["solo"]
+
+
+def test_compile_cache_not_fooled_by_id_reuse(ctx):
+    """A GC'd lambda's id may be reused; the cache must not serve the
+    old program for a structurally-identical op with a new fn."""
+    tbl = {"x": np.arange(16, dtype=np.int32)}
+    q1 = ctx.from_arrays(tbl).select(lambda c: {"x": c["x"] * 2})
+    r1 = q1.collect()
+    assert sorted(r1["x"].tolist()) == [2 * i for i in range(16)]
+    del q1
+    import gc
+
+    gc.collect()
+    q2 = ctx.from_arrays(tbl).select(lambda c: {"x": c["x"] + 1})
+    r2 = q2.collect()
+    assert sorted(r2["x"].tolist()) == [i + 1 for i in range(16)]
+
+
+def test_take_negative_is_empty(ctx, dbg):
+    tbl = {"x": np.arange(10, dtype=np.int32)}
+    got = ctx.from_arrays(tbl).take(-3).collect()
+    assert len(got["x"]) == 0
+    got0 = ctx.from_arrays(tbl).take(0).collect()
+    assert len(got0["x"]) == 0
