@@ -302,6 +302,273 @@ class Query:
         )
         return Query(self.ctx, node)
 
+    def skip(self, n: int) -> "Query":
+        """Drop the first n rows of global engine order (reference Skip)."""
+        node = Node(
+            "skip", [self.node], self.schema, self.node.partition,
+            n=max(0, int(n)),
+        )
+        return Query(self.ctx, node)
+
+    def tail(self, n: int) -> "Query":
+        """Keep the last n rows of global engine order (the Last /
+        TakeLast shape of the reference dispatch)."""
+        node = Node(
+            "tail", [self.node], self.schema, self.node.partition,
+            n=max(0, int(n)),
+        )
+        return Query(self.ctx, node)
+
+    def take_while(self, fn: Callable[[Dict], Any]) -> "Query":
+        """Rows strictly before the first predicate failure in global
+        engine order (reference TakeWhile)."""
+        node = Node(
+            "take_while", [self.node], self.schema, self.node.partition, fn=fn
+        )
+        return Query(self.ctx, node)
+
+    def skip_while(self, fn: Callable[[Dict], Any]) -> "Query":
+        """Rows from the first predicate failure onward (SkipWhile)."""
+        node = Node(
+            "skip_while", [self.node], self.schema, self.node.partition, fn=fn
+        )
+        return Query(self.ctx, node)
+
+    def reverse(self) -> "Query":
+        """Globally reverse row order (reference Reverse,
+        ``DryadLinqQueryGen.cs:2731``)."""
+        node = Node("reverse", [self.node], self.schema, PartitionInfo())
+        return Query(self.ctx, node)
+
+    def default_if_empty(self, defaults: Optional[Dict[str, Any]] = None) -> "Query":
+        """If empty, a single default row (reference DefaultIfEmpty).
+
+        ``defaults``: logical column -> value; unlisted columns default
+        to zero / empty string."""
+        # The default row materializes on partition 0, which breaks any
+        # inherited hash/range placement — downstream shuffles must not
+        # be elided.
+        node = Node(
+            "default_if_empty", [self.node], self.schema, PartitionInfo(),
+            defaults=self._physical_row(defaults or {}),
+        )
+        return Query(self.ctx, node)
+
+    def of_type(self, tag_col: str, value: Any) -> "Query":
+        """Keep rows whose type-tag column equals ``value`` (reference
+        OfType; a columnar engine models subtype unions as a tag
+        column, so OfType is tag equality)."""
+        self._require_cols([tag_col], "in of_type")
+        f = self.schema.field(tag_col)
+        if f.ctype.is_split:
+            phys = self._physical_row({tag_col: value})
+            h0 = phys[f"{tag_col}#h0"]
+            h1 = phys[f"{tag_col}#h1"]
+
+            def fn(cols):
+                return (cols[f"{tag_col}#h0"] == h0) & (
+                    cols[f"{tag_col}#h1"] == h1
+                )
+        else:
+            def fn(cols):
+                return cols[tag_col] == value
+        return self.where(fn)
+
+    # -- element access (eager, reference First/Last/Single/ElementAt) ------
+    def _one_row(self, q: "Query") -> Optional[Dict[str, Any]]:
+        table = q.collect()
+        n = len(next(iter(table.values()), []))
+        if n == 0:
+            return None
+        return {k: v[0] if np.asarray(v).ndim else v for k, v in table.items()}
+
+    def first(self) -> Dict[str, Any]:
+        row = self._one_row(self.take(1))
+        if row is None:
+            raise ValueError("first() on an empty sequence")
+        return row
+
+    def first_or_default(self) -> Optional[Dict[str, Any]]:
+        return self._one_row(self.take(1))
+
+    def last(self) -> Dict[str, Any]:
+        row = self._one_row(self.tail(1))
+        if row is None:
+            raise ValueError("last() on an empty sequence")
+        return row
+
+    def last_or_default(self) -> Optional[Dict[str, Any]]:
+        return self._one_row(self.tail(1))
+
+    def single(self) -> Dict[str, Any]:
+        table = self.take(2).collect()
+        n = len(next(iter(table.values()), []))
+        if n == 0:
+            raise ValueError("single() on an empty sequence")
+        if n > 1:
+            raise ValueError("single() on a sequence with more than one row")
+        return {k: v[0] for k, v in table.items()}
+
+    def single_or_default(self) -> Optional[Dict[str, Any]]:
+        table = self.take(2).collect()
+        n = len(next(iter(table.values()), []))
+        if n > 1:
+            raise ValueError("single_or_default() on a sequence with more than one row")
+        return {k: v[0] for k, v in table.items()} if n else None
+
+    def element_at(self, n: int) -> Dict[str, Any]:
+        if n < 0:
+            raise IndexError(f"element_at({n}) out of range")
+        row = self._one_row(self.skip(n).take(1))
+        if row is None:
+            raise IndexError(f"element_at({n}) out of range")
+        return row
+
+    def element_at_or_default(self, n: int) -> Optional[Dict[str, Any]]:
+        if n < 0:
+            return None
+        return self._one_row(self.skip(n).take(1))
+
+    def contains(self, row: Dict[str, Any]) -> bool:
+        """Whole-row membership (reference Contains)."""
+        if set(row) != set(self.schema.names):
+            raise ValueError(
+                f"contains() row must bind every column {self.schema.names}"
+            )
+        arrays = {k: np.asarray([v]) for k, v in row.items()}
+        one = self.ctx.from_arrays(arrays, schema=self.schema)
+        return self.semi_join(one, self.schema.names).count() > 0
+
+    def sequence_equal(self, other: "Query") -> bool:
+        """Element-wise equality of two sequences in global engine order
+        (reference SequenceEqual)."""
+        if [
+            (f.name, f.ctype) for f in self.schema.fields
+        ] != [(f.name, f.ctype) for f in other.schema.fields]:
+            return False
+        n1, n2 = self.count(), other.count()
+        if n1 != n2:
+            return False
+        if n1 == 0:
+            return True
+        from dryad_tpu.ops.join import _suffixed
+        from dryad_tpu.plan import keys as K
+
+        suffix = "__sq"
+        z = self.zip_(other, suffix=suffix)
+        lcols = K.equality_cols(self.schema, self.schema.names)
+        rcols = [_suffixed(c, suffix) for c in lcols]
+
+        def fn(cols):
+            m = None
+            for l, r in zip(lcols, rcols):
+                e = cols[l] == cols[r]
+                m = e if m is None else (m & e)
+            return {"eq": m}
+
+        eq = z.select(fn, schema=Schema([("eq", ColumnType.BOOL)]))
+        return bool(eq.all_("eq"))
+
+    # -- outer joins / group-join --------------------------------------------
+    def left_join(
+        self,
+        other: "Query",
+        left_keys: KeyArg,
+        right_keys: Optional[KeyArg] = None,
+        right_defaults: Optional[Dict[str, Any]] = None,
+        expansion: float = 4.0,
+        suffix: str = "_r",
+    ) -> "Query":
+        """Left-outer equi-join: unmatched left rows survive with
+        default-valued right columns (the GroupJoin + DefaultIfEmpty
+        left-outer idiom of the reference)."""
+        lk = _keys(left_keys)
+        rk = _keys(right_keys) if right_keys is not None else lk
+        self._require_cols(lk, "in join left keys")
+        other._require_cols(rk, "in join right keys")
+        fields = [(f.name, f.ctype) for f in self.schema.fields]
+        lnames = {f.name for f in self.schema.fields}
+        for f in other.schema.fields:
+            if f.name in rk:
+                continue
+            name = f.name if f.name not in lnames else f"{f.name}{suffix}"
+            fields.append((name, f.ctype))
+        phys_defaults = other._physical_row(right_defaults or {})
+        node = Node(
+            "join", [self.node, other.node], Schema(fields),
+            PartitionInfo.hashed(lk),
+            left_keys=lk, right_keys=rk, join_kind="left",
+            expansion=expansion, suffix=suffix,
+            right_defaults=phys_defaults,
+        )
+        return Query(self.ctx, node)
+
+    def group_join(
+        self,
+        other: "Query",
+        left_keys: KeyArg,
+        right_keys: Optional[KeyArg] = None,
+        aggs: Optional[Dict[str, Tuple[str, Optional[str]]]] = None,
+        defaults: Optional[Dict[str, Any]] = None,
+        expansion: float = 4.0,
+    ) -> "Query":
+        """GroupJoin (reference ``DryadLinqQueryable`` GroupJoin): per
+        left row, aggregates over the group of matching right rows;
+        left rows with no matches survive with ``defaults`` (count-like
+        aggregates default to 0 automatically)."""
+        lk = _keys(left_keys)
+        rk = _keys(right_keys) if right_keys is not None else lk
+        if not aggs:
+            return self.group_join_count(other, lk, rk, expansion=expansion)
+        right_agg = other.group_by(rk, aggs)
+        dflt = dict(defaults or {})
+        for out_name, (op, _col) in aggs.items():
+            if op == "count" and out_name not in dflt:
+                dflt[out_name] = 0
+        return self.left_join(
+            right_agg, lk, rk, right_defaults=dflt, expansion=expansion
+        )
+
+    def _physical_row(self, values: Dict[str, Any]) -> Dict[str, Any]:
+        """Encode one logical row (missing columns -> zero/empty) into
+        physical column scalars, registering strings in the context
+        dictionary."""
+        from dryad_tpu.columnar.batch import ColumnBatch
+
+        arrays = {}
+        for f in self.schema.fields:
+            v = values.get(f.name)
+            if v is None:
+                v = "" if f.ctype == ColumnType.STRING else 0
+            arrays[f.name] = np.asarray([v])
+        b = ColumnBatch.from_numpy(
+            self.schema, arrays, capacity=1, dictionary=self.ctx.dictionary
+        )
+        return {k: np.asarray(v)[0] for k, v in b.data.items()}
+
+    def aggregate_decomposable(self, dec: "Decomposable") -> Dict[str, Any]:
+        """Whole-table custom aggregate (reference Aggregate with a
+        decomposable combiner): one-group group_by, returns the single
+        result row."""
+        phys = self.schema.device_names()
+
+        def add_key(cols):
+            import jax.numpy as jnp
+
+            out = {c: cols[c] for c in phys}
+            out["__g"] = jnp.zeros_like(
+                next(iter(cols.values())), dtype=jnp.int32
+            )
+            return out
+
+        keyed = self.select(
+            add_key, schema=self.schema.with_field("__g", ColumnType.INT32)
+        )
+        g = keyed.group_by("__g", decomposable=dec)
+        out_names = [n for n, _ in dec.out_fields]
+        table = g.project(out_names).collect()
+        return {k: (v[0] if len(v) else None) for k, v in table.items()}
+
     def group_join_count(
         self,
         other: "Query",

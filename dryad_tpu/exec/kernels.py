@@ -176,9 +176,15 @@ def _k_join(ctx: StageContext, p) -> None:
     out_cap = _round8(
         max(left.capacity, right.capacity) * p["expansion"] * ctx.boost
     )
-    out, ovf = J.hash_join(
-        left, right, p["left_keys"], p["right_keys"], out_cap, p.get("suffix", "_r")
-    )
+    if p.get("outer"):
+        out, ovf = J.hash_join_outer(
+            left, right, p["left_keys"], p["right_keys"], out_cap,
+            p.get("right_defaults") or {}, p.get("suffix", "_r"),
+        )
+    else:
+        out, ovf = J.hash_join(
+            left, right, p["left_keys"], p["right_keys"], out_cap, p.get("suffix", "_r")
+        )
     ctx.slots[p["left_slot"]] = out
     ctx.overflow = ctx.overflow | ovf
 
@@ -303,13 +309,95 @@ def _k_sliding_window(ctx: StageContext, p) -> None:
 
 # -- global ops ------------------------------------------------------------
 
+def _strip_rank(b: ColumnBatch, keep: jax.Array) -> ColumnBatch:
+    return ColumnBatch(
+        {n: c for n, c in b.data.items() if n != "#rank"}, keep
+    )
+
+
 def _k_take(ctx: StageContext, p) -> None:
     b, _total = _rank_column(ctx.slots[p["slot"]], ctx.P)
     rank = b.data["#rank"]
     keep = b.valid & (rank < jnp.uint32(p["n"]))
-    ctx.slots[p["slot"]] = ColumnBatch(
-        {n: c for n, c in b.data.items() if n != "#rank"}, keep
+    ctx.slots[p["slot"]] = _strip_rank(b, keep)
+
+
+def _k_skip(ctx: StageContext, p) -> None:
+    """Drop the first n rows of global engine order (reference Skip)."""
+    b, _total = _rank_column(ctx.slots[p["slot"]], ctx.P)
+    keep = b.valid & (b.data["#rank"] >= jnp.uint32(p["n"]))
+    ctx.slots[p["slot"]] = _strip_rank(b, keep)
+
+
+def _k_tail(ctx: StageContext, p) -> None:
+    """Keep the last n rows of global engine order (Last/TakeLast shape,
+    reference Last/LastOrDefault dispatch ``DryadLinqQueryGen.cs``)."""
+    b, total = _rank_column(ctx.slots[p["slot"]], ctx.P)
+    cut = jnp.maximum(total - jnp.int32(p["n"]), 0).astype(jnp.uint32)
+    keep = b.valid & (b.data["#rank"] >= cut)
+    ctx.slots[p["slot"]] = _strip_rank(b, keep)
+
+
+def _first_false_rank(
+    b: ColumnBatch, pred: jax.Array, total: jax.Array
+) -> jax.Array:
+    """Global rank of the first valid row failing ``pred`` (= total if
+    every row passes)."""
+    rank = b.data["#rank"]
+    failing = jnp.where(
+        b.valid & jnp.logical_not(pred), rank, jnp.uint32(0xFFFFFFFF)
     )
+    local_min = jnp.min(failing)
+    global_min = jax.lax.pmin(local_min, AXIS)
+    return jnp.minimum(global_min, total.astype(jnp.uint32))
+
+
+def _k_take_while(ctx: StageContext, p) -> None:
+    """Rows strictly before the first predicate failure (TakeWhile)."""
+    b, total = _rank_column(ctx.slots[p["slot"]], ctx.P)
+    pred = p["fn"]({n: c for n, c in b.data.items() if n != "#rank"})
+    cut = _first_false_rank(b, pred, total)
+    keep = b.valid & (b.data["#rank"] < cut)
+    ctx.slots[p["slot"]] = _strip_rank(b, keep)
+
+
+def _k_skip_while(ctx: StageContext, p) -> None:
+    """Rows from the first predicate failure onward (SkipWhile)."""
+    b, total = _rank_column(ctx.slots[p["slot"]], ctx.P)
+    pred = p["fn"]({n: c for n, c in b.data.items() if n != "#rank"})
+    cut = _first_false_rank(b, pred, total)
+    keep = b.valid & (b.data["#rank"] >= cut)
+    ctx.slots[p["slot"]] = _strip_rank(b, keep)
+
+
+def _k_reverse(ctx: StageContext, p) -> None:
+    """Globally reverse engine row order (reference Reverse,
+    ``DryadLinqQueryGen.cs:2731``): invert each row's global rank and
+    repartition by the inverted rank."""
+    b, total = _rank_column(ctx.slots[p["slot"]], ctx.P)
+    inv = (total.astype(jnp.uint32) - jnp.uint32(1)) - b.data["#rank"]
+    inv = jnp.where(b.valid, inv, jnp.uint32(0xFFFFFFFF))
+    b = ColumnBatch(dict(b.data, **{"#rank": inv}), b.valid)
+    per = _round8(ctx.base_cap(p["slot"]) * ctx.boost)
+    out = _exchange_by_rank(ctx, b, per)
+    ctx.slots[p["slot"]] = _strip_rank(out, out.valid)
+
+
+def _k_default_if_empty(ctx: StageContext, p) -> None:
+    """If the table is globally empty, emit one default row on partition
+    0 (reference DefaultIfEmpty)."""
+    b = ctx.slots[p["slot"]].compact()
+    total = jax.lax.psum(jnp.sum(b.valid.astype(jnp.int32)), AXIS)
+    me = jax.lax.axis_index(AXIS)
+    emit = (total == 0) & (me == 0)
+    data = {}
+    for name, col in b.data.items():
+        dflt = jnp.asarray(p["defaults"].get(name, 0), col.dtype)
+        data[name] = jnp.where(
+            emit, col.at[0].set(dflt), col
+        )
+    valid = jnp.where(emit, b.valid.at[0].set(True), b.valid)
+    ctx.slots[p["slot"]] = ColumnBatch(data, valid)
 
 
 def _k_scalar_agg(ctx: StageContext, p) -> None:
@@ -395,6 +483,12 @@ _KERNELS = {
     "semi": _k_semi,
     "concat": _k_concat,
     "take": _k_take,
+    "skip": _k_skip,
+    "tail": _k_tail,
+    "take_while": _k_take_while,
+    "skip_while": _k_skip_while,
+    "reverse": _k_reverse,
+    "default_if_empty": _k_default_if_empty,
     "scalar_agg": _k_scalar_agg,
     "fork": _k_fork,
     "group_join_count": _k_group_join_count,

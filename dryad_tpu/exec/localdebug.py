@@ -238,13 +238,20 @@ class LocalDebugInterpreter:
             out[node.params["out"]] = counts
             return out
         li, ri = [], []
+        outer = kind == "left"
+        defaults = node.params.get("right_defaults") or {}
         for i, k in enumerate(ltup):
-            for j in index.get(k, ()):
+            matches = index.get(k, ())
+            for j in matches:
                 li.append(i)
                 ri.append(j)
+            if outer and not matches:
+                li.append(i)
+                ri.append(-1)  # sentinel: default-valued right row
         suffix = node.params.get("suffix", "_r")
         out: Table = {c: np.asarray(lt[c])[li] for c in lt}
         rkset = set(rk)
+        ri_arr = np.asarray(ri, np.int64) if ri else np.zeros(0, np.int64)
         for c in rt:
             if c in rkset:
                 continue
@@ -253,7 +260,11 @@ class LocalDebugInterpreter:
                 name = f"{base}{suffix}#{word}" if word else f"{c}{suffix}"
             else:
                 name = c
-            out[name] = np.asarray(rt[c])[ri]
+            a = np.asarray(rt[c])
+            pad = np.broadcast_to(
+                np.asarray(defaults.get(c, 0), a.dtype), (1,) + a.shape[1:]
+            )
+            out[name] = np.concatenate([a, pad])[ri_arr]
         return out
 
     def _n_zip(self, node: Node) -> Table:
@@ -301,6 +312,43 @@ class LocalDebugInterpreter:
     def _n_take(self, node: Node) -> Table:
         t = self._in(node)
         return _take_rows(t, slice(0, node.params["n"]))
+
+    def _n_skip(self, node: Node) -> Table:
+        t = self._in(node)
+        return _take_rows(t, slice(node.params["n"], None))
+
+    def _n_tail(self, node: Node) -> Table:
+        t = self._in(node)
+        n = node.params["n"]
+        start = max(_rows(t) - n, 0)
+        return _take_rows(t, slice(start, None))
+
+    def _first_false(self, node: Node, t: Table) -> int:
+        mask = np.asarray(node.params["fn"](dict(t))).astype(bool)
+        bad = np.nonzero(~mask)[0]
+        return int(bad[0]) if len(bad) else _rows(t)
+
+    def _n_take_while(self, node: Node) -> Table:
+        t = self._in(node)
+        return _take_rows(t, slice(0, self._first_false(node, t)))
+
+    def _n_skip_while(self, node: Node) -> Table:
+        t = self._in(node)
+        return _take_rows(t, slice(self._first_false(node, t), None))
+
+    def _n_reverse(self, node: Node) -> Table:
+        t = self._in(node)
+        return _take_rows(t, slice(None, None, -1))
+
+    def _n_default_if_empty(self, node: Node) -> Table:
+        t = self._in(node)
+        if _rows(t):
+            return t
+        d = node.params["defaults"]
+        return {
+            k: np.asarray([d.get(k, 0)], dtype=np.asarray(t[k]).dtype)
+            for k in t
+        }
 
     def _n_concat(self, node: Node) -> Table:
         ts = [self.cache[i.id] for i in node.inputs]

@@ -128,6 +128,50 @@ def _exact_pair_match(
     return exact
 
 
+def hash_join_outer(
+    left: ColumnBatch,
+    right: ColumnBatch,
+    left_keys: Sequence[str],
+    right_keys: Sequence[str],
+    out_capacity: int,
+    right_defaults: Dict[str, jnp.ndarray],
+    suffix: str = "_r",
+) -> Tuple[ColumnBatch, jax.Array]:
+    """Left-outer equi-join: inner pairs plus unmatched left rows with
+    default-valued right columns (the GroupJoin left-outer shape,
+    reference ``DryadLinqQueryGen.cs`` GroupJoin + DefaultIfEmpty
+    pattern).  Output capacity is ``out_capacity + left.capacity`` —
+    the unmatched tail is statically reserved so it can never overflow.
+    """
+    rs, lhash, start, counts = _probe_ranges(left, right, left_keys, right_keys)
+    li, ri, pair_valid, overflow = _expand_pairs(start, counts, out_capacity)
+    exact = _exact_pair_match(left, rs, left_keys, right_keys, li, ri, pair_valid)
+
+    # Per-left-row exact-match count -> unmatched mask for the tail.
+    matched = (
+        jnp.zeros((left.capacity,), jnp.int32)
+        .at[li]
+        .add(exact.astype(jnp.int32), mode="drop")
+    )
+    unmatched = left.valid & (matched == 0)
+
+    rk = set(right_keys)
+    data: Dict[str, jax.Array] = {}
+    for name, col in left.data.items():
+        data[name] = jnp.concatenate([col[li], col])
+    for name, col in rs.data.items():
+        if name in rk:
+            continue
+        out_name = _suffixed(name, suffix) if name in data else name
+        dflt = right_defaults.get(name, jnp.zeros((), col.dtype))
+        tail = jnp.broadcast_to(
+            jnp.asarray(dflt, col.dtype), (left.capacity,) + col.shape[1:]
+        )
+        data[out_name] = jnp.concatenate([col[ri], tail])
+    valid = jnp.concatenate([exact, unmatched])
+    return ColumnBatch(data, valid), overflow
+
+
 def group_join_counts(
     left: ColumnBatch,
     right: ColumnBatch,

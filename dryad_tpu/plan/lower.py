@@ -125,7 +125,11 @@ class _Builder:
             stage = self._new_stage("input", [("plan_input", node.id)])
             self.cursor[node.id] = ("open", stage, 0)
 
-        elif k in ("select", "where", "select_many", "apply", "take"):
+        elif k in (
+            "select", "where", "select_many", "apply", "take",
+            "skip", "tail", "take_while", "skip_while", "reverse",
+            "default_if_empty",
+        ):
             stage, slot = self._continue_or_start(node, fanout.get(node.inputs[0].id, 1))
             if k == "select":
                 stage.ops.append(StageOp("select", dict(slot=slot, fn=node.params["fn"])))
@@ -152,12 +156,25 @@ class _Builder:
                     )
                 )
                 stage.growth *= node.params.get("cap_factor", 1.0)
-            elif k == "take":
+            elif k in ("take", "skip", "tail"):
                 # Global rank is partition-major, so take() after order_by
                 # yields the first n in sort order; on unordered input it
                 # is the first n in engine (== ingestion) order.
                 stage.ops.append(
-                    StageOp("take", dict(slot=slot, n=node.params["n"]))
+                    StageOp(k, dict(slot=slot, n=node.params["n"]))
+                )
+            elif k in ("take_while", "skip_while"):
+                stage.ops.append(
+                    StageOp(k, dict(slot=slot, fn=node.params["fn"]))
+                )
+            elif k == "reverse":
+                stage.ops.append(StageOp("reverse", dict(slot=slot)))
+            elif k == "default_if_empty":
+                stage.ops.append(
+                    StageOp(
+                        "default_if_empty",
+                        dict(slot=slot, defaults=node.params["defaults"]),
+                    )
                 )
             self.cursor[node.id] = ("open", stage, slot)
 
@@ -436,7 +453,7 @@ class _Builder:
                     ),
                 )
             )
-        elif jk == "inner":
+        elif jk in ("inner", "left"):
             stage.ops.append(
                 StageOp(
                     "join",
@@ -447,10 +464,14 @@ class _Builder:
                         right_keys=rkeys,
                         expansion=node.params.get("expansion", 1.0),
                         suffix=node.params.get("suffix", "_r"),
+                        outer=(jk == "left"),
+                        right_defaults=node.params.get("right_defaults"),
                     ),
                 )
             )
-            stage.growth = max(1.0, node.params.get("expansion", 1.0))
+            stage.growth = max(1.0, node.params.get("expansion", 1.0)) + (
+                1.0 if jk == "left" else 0.0
+            )
         else:
             stage.ops.append(
                 StageOp(

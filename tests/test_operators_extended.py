@@ -1,0 +1,343 @@
+"""Extended operator-surface tests (reference §2.4 completeness list).
+
+Mirrors ``DryadLinqTests/BasicAPITests.cs`` coverage of the positional /
+element / set operators: Skip, TakeWhile/SkipWhile, Reverse,
+First/Last/Single/ElementAt(+OrDefault), Contains, SequenceEqual,
+DefaultIfEmpty, GroupJoin, OfType — differential against the LocalDebug
+oracle like the reference's Validate.Check pattern.
+"""
+
+import numpy as np
+import pytest
+
+from dryad_tpu import ColumnType, Decomposable, DryadContext, Schema
+from oracle import check
+
+
+@pytest.fixture
+def ctx(mesh8):
+    return DryadContext(num_partitions_=8)
+
+
+@pytest.fixture
+def dbg():
+    return DryadContext(local_debug=True)
+
+
+def _tbl(n=100):
+    return {
+        "x": np.arange(n, dtype=np.int32),
+        "v": (np.arange(n) * 0.5).astype(np.float32),
+    }
+
+
+# -- positional operators ---------------------------------------------------
+
+def test_skip(ctx, dbg):
+    def q(c):
+        return c.from_arrays(_tbl()).skip(37).collect()
+
+    check(q(ctx), q(dbg))
+    assert sorted(q(ctx)["x"].tolist()) == list(range(37, 100))
+
+
+def test_skip_more_than_rows(ctx, dbg):
+    def q(c):
+        return c.from_arrays(_tbl(10)).skip(50).collect()
+
+    check(q(ctx), q(dbg))
+    assert len(q(ctx)["x"]) == 0
+
+
+def test_tail(ctx, dbg):
+    def q(c):
+        return c.from_arrays(_tbl()).tail(7).collect()
+
+    check(q(ctx), q(dbg))
+    assert sorted(q(ctx)["x"].tolist()) == list(range(93, 100))
+
+
+def test_take_while(ctx, dbg):
+    def q(c):
+        return (
+            c.from_arrays(_tbl())
+            .take_while(lambda cols: cols["x"] < 42)
+            .collect()
+        )
+
+    check(q(ctx), q(dbg))
+    assert sorted(q(ctx)["x"].tolist()) == list(range(42))
+
+
+def test_take_while_never_fails(ctx, dbg):
+    def q(c):
+        return (
+            c.from_arrays(_tbl(20))
+            .take_while(lambda cols: cols["x"] >= 0)
+            .collect()
+        )
+
+    check(q(ctx), q(dbg))
+    assert len(q(ctx)["x"]) == 20
+
+
+def test_skip_while(ctx, dbg):
+    def q(c):
+        return (
+            c.from_arrays(_tbl())
+            .skip_while(lambda cols: cols["x"] != 60)
+            .collect()
+        )
+
+    check(q(ctx), q(dbg))
+    assert sorted(q(ctx)["x"].tolist()) == list(range(60, 100))
+
+
+def test_take_while_predicate_not_prefix_closed(ctx, dbg):
+    # Predicate true again after first failure: TakeWhile must still cut
+    # at the FIRST failure (LINQ semantics).
+    def q(c):
+        return (
+            c.from_arrays(_tbl(50))
+            .take_while(lambda cols: (cols["x"] < 10) | (cols["x"] > 20))
+            .collect()
+        )
+
+    check(q(ctx), q(dbg))
+    assert sorted(q(ctx)["x"].tolist()) == list(range(10))
+
+
+def test_reverse(ctx, dbg):
+    def q(c):
+        return c.from_arrays(_tbl(64)).reverse().collect()
+
+    got, want = q(ctx), q(dbg)
+    # Reverse is order-sensitive: compare element-wise, not sorted.
+    assert got["x"].tolist() == want["x"].tolist() == list(range(63, -1, -1))
+
+
+def test_reverse_then_take(ctx, dbg):
+    def q(c):
+        return c.from_arrays(_tbl(64)).reverse().take(5).collect()
+
+    assert sorted(q(ctx)["x"].tolist()) == sorted(q(dbg)["x"].tolist()) == [
+        59, 60, 61, 62, 63,
+    ]
+
+
+# -- element access ---------------------------------------------------------
+
+def test_first_last_single_element_at(ctx, dbg):
+    for c in (ctx, dbg):
+        q = c.from_arrays(_tbl(30))
+        assert q.first()["x"] == 0
+        assert q.last()["x"] == 29
+        assert q.element_at(13)["x"] == 13
+        assert q.element_at_or_default(99) is None
+        with pytest.raises(IndexError):
+            q.element_at(99)
+        with pytest.raises(ValueError):
+            q.single()
+        only = q.where(lambda cols: cols["x"] == 17)
+        assert only.single()["x"] == 17
+        assert only.single_or_default()["x"] == 17
+
+
+def test_first_or_default_empty(ctx, dbg):
+    for c in (ctx, dbg):
+        q = c.from_arrays(_tbl(10)).where(lambda cols: cols["x"] > 100)
+        assert q.first_or_default() is None
+        assert q.last_or_default() is None
+        assert q.single_or_default() is None
+        with pytest.raises(ValueError):
+            q.first()
+        with pytest.raises(ValueError):
+            q.last()
+
+
+def test_default_if_empty(ctx, dbg):
+    def q(c):
+        return (
+            c.from_arrays(_tbl(10))
+            .where(lambda cols: cols["x"] > 100)
+            .default_if_empty({"x": -1, "v": 2.5})
+            .collect()
+        )
+
+    check(q(ctx), q(dbg))
+    got = q(ctx)
+    assert got["x"].tolist() == [-1]
+    assert got["v"].tolist() == [2.5]
+
+
+def test_default_if_empty_nonempty_passthrough(ctx, dbg):
+    def q(c):
+        return c.from_arrays(_tbl(10)).default_if_empty().collect()
+
+    check(q(ctx), q(dbg))
+    assert len(q(ctx)["x"]) == 10
+
+
+# -- membership / equality ---------------------------------------------------
+
+def test_contains(ctx, dbg):
+    for c in (ctx, dbg):
+        q = c.from_arrays(_tbl(20))
+        assert q.contains({"x": 5, "v": 2.5})
+        assert not q.contains({"x": 5, "v": 99.0})
+        assert not q.contains({"x": 500, "v": 2.5})
+
+
+def test_sequence_equal(ctx, dbg):
+    for c in (ctx, dbg):
+        a = c.from_arrays(_tbl(40))
+        b = c.from_arrays(_tbl(40))
+        shorter = c.from_arrays(_tbl(39))
+        assert a.sequence_equal(b)
+        assert not a.sequence_equal(shorter)
+        mutated = b.select(
+            lambda cols: {"x": cols["x"], "v": cols["v"] + (cols["x"] == 7)},
+            schema=a.schema,
+        )
+        assert not a.sequence_equal(mutated)
+
+
+def test_sequence_equal_empty(ctx):
+    a = ctx.from_arrays(_tbl(10)).where(lambda c: c["x"] > 50)
+    b = ctx.from_arrays(_tbl(10)).where(lambda c: c["x"] > 90)
+    assert a.sequence_equal(b)
+
+
+def test_sequence_equal_strings(ctx):
+    a = ctx.from_arrays({"s": np.array(["a", "b", "c"], object)})
+    b = ctx.from_arrays({"s": np.array(["a", "b", "c"], object)})
+    d = ctx.from_arrays({"s": np.array(["a", "x", "c"], object)})
+    assert a.sequence_equal(b)
+    assert not a.sequence_equal(d)
+
+
+# -- of_type ----------------------------------------------------------------
+
+def test_of_type_tag(ctx, dbg):
+    tbl = {
+        "tag": np.array(["dog", "cat", "dog", "bird"] * 5, object),
+        "v": np.arange(20, dtype=np.int32),
+    }
+
+    def q(c):
+        return c.from_arrays(tbl).of_type("tag", "dog").collect()
+
+    check(q(ctx), q(dbg))
+    assert len(q(ctx)["v"]) == 10
+
+
+# -- outer joins / group join ------------------------------------------------
+
+def test_left_join(ctx, dbg):
+    left = {
+        "k": np.array([0, 1, 2, 3, 4] * 4, np.int32),
+        "lv": np.arange(20, dtype=np.int32),
+    }
+    right = {
+        "k": np.array([1, 3, 3], np.int32),
+        "rv": np.array([10, 30, 31], np.float32),
+    }
+
+    def q(c):
+        return (
+            c.from_arrays(left)
+            .left_join(c.from_arrays(right), "k", right_defaults={"rv": -1.0})
+            .collect()
+        )
+
+    check(q(ctx), q(dbg))
+    got = q(ctx)
+    # k=0,2,4 rows survive with default rv; k=1 matches once; k=3 twice.
+    assert len(got["k"]) == 12 + 4 + 8
+    assert set(got["rv"][got["k"] == 0].tolist()) == {-1.0}
+
+
+def test_group_join_aggs(ctx, dbg):
+    left = {
+        "k": np.array([0, 1, 2, 3], np.int32),
+        "lv": np.array([9, 8, 7, 6], np.int32),
+    }
+    right = {
+        "k": np.array([1, 1, 3, 1], np.int32),
+        "rv": np.array([2.0, 4.0, 10.0, 6.0], np.float32),
+    }
+
+    def q(c):
+        return (
+            c.from_arrays(left)
+            .group_join(
+                c.from_arrays(right), "k",
+                aggs={"n": ("count", None), "s": ("sum", "rv")},
+                defaults={"s": 0.0},
+            )
+            .collect()
+        )
+
+    check(q(ctx), q(dbg))
+    got = q(ctx)
+    by_k = {int(k): (int(n), float(s)) for k, n, s in zip(got["k"], got["n"], got["s"])}
+    assert by_k == {0: (0, 0.0), 1: (3, 12.0), 2: (0, 0.0), 3: (1, 10.0)}
+
+
+def test_group_join_default_is_count(ctx, dbg):
+    left = {"k": np.array([0, 1], np.int32)}
+    right = {"k": np.array([1, 1], np.int32)}
+
+    def q(c):
+        return (
+            c.from_arrays(left)
+            .group_join(c.from_arrays(right), "k")
+            .collect()
+        )
+
+    check(q(ctx), q(dbg))
+    got = q(ctx)
+    by_k = dict(zip(got["k"].tolist(), got["match_count"].tolist()))
+    assert by_k == {0: 0, 1: 2}
+
+
+# -- whole-table custom aggregate -------------------------------------------
+
+def test_aggregate_decomposable(ctx, dbg):
+    import jax.numpy as jnp
+
+    dec = Decomposable(
+        seed=lambda cols: {"acc": cols["v"] * cols["v"]},
+        merge=lambda a, b: {"acc": a["acc"] + b["acc"]},
+        state_cols=["acc"],
+        out_fields=[("acc", ColumnType.FLOAT32)],
+    )
+    for c in (ctx, dbg):
+        tbl = {"v": np.arange(10, dtype=np.float32)}
+        out = c.from_arrays(tbl).aggregate_decomposable(dec)
+        assert abs(out["acc"] - float((np.arange(10.0) ** 2).sum())) < 1e-3
+
+
+def test_element_at_negative(ctx):
+    q = ctx.from_arrays(_tbl(10))
+    with pytest.raises(IndexError):
+        q.element_at(-3)
+    assert q.element_at_or_default(-1) is None
+
+
+def test_default_if_empty_then_join_repartitions(ctx, dbg):
+    # The default row lands on partition 0; a following keyed join must
+    # re-exchange rather than trust the pre-existing hash placement.
+    right = {"k": np.array([5], np.int32), "rv": np.array([1.5], np.float32)}
+
+    def q(c):
+        empty = (
+            c.from_arrays({"k": np.arange(8, dtype=np.int32)})
+            .hash_partition("k")
+            .where(lambda cols: cols["k"] > 100)
+            .default_if_empty({"k": 5})
+        )
+        return empty.join(c.from_arrays(right), "k").collect()
+
+    check(q(ctx), q(dbg))
+    assert q(ctx)["rv"].tolist() == [1.5]
