@@ -32,6 +32,15 @@ from dryad_tpu.plan.nodes import Node, PartitionInfo
 KeyArg = Union[str, Sequence[str]]
 OrderArg = Union[str, Tuple[str, bool]]
 
+JOIN_STRATEGIES = ("shuffle", "broadcast", "auto")
+
+
+def _check_strategy(strategy: str) -> None:
+    if strategy not in JOIN_STRATEGIES:
+        raise ValueError(
+            f"unknown join strategy {strategy!r}; expected one of {JOIN_STRATEGIES}"
+        )
+
 _AGG_TYPE_RULES = {
     "count": lambda ct: ColumnType.INT32,
     "sum": lambda ct: ct,
@@ -168,6 +177,16 @@ class Query:
         return Query(self.ctx, node)
 
     # -- joins --------------------------------------------------------------
+    def _join_partition_info(self, lk: List[str], strategy: str) -> PartitionInfo:
+        """Output placement depends on strategy: a broadcast join leaves
+        the left side where it is; a shuffle join co-hash-partitions;
+        'auto' is decided at trace time, so nothing can be assumed."""
+        if strategy == "broadcast":
+            return self.node.partition
+        if strategy == "auto":
+            return PartitionInfo()
+        return PartitionInfo.hashed(lk)
+
     def join(
         self,
         other: "Query",
@@ -175,8 +194,13 @@ class Query:
         right_keys: Optional[KeyArg] = None,
         expansion: float = 4.0,
         suffix: str = "_r",
+        strategy: str = "shuffle",
     ) -> "Query":
-        """Inner equi-join (reference Join): co-hash-partition + local join."""
+        """Inner equi-join (reference Join): co-hash-partition + local
+        join, or replicate a small right side (``strategy`` in
+        shuffle|broadcast|auto; broadcast is the
+        ``DrDynamicBroadcastManager`` copy-tree as one ``all_gather``)."""
+        _check_strategy(strategy)
         lk = _keys(left_keys)
         rk = _keys(right_keys) if right_keys is not None else lk
         self._require_cols(lk, "in join left keys")
@@ -190,34 +214,38 @@ class Query:
             fields.append((name, f.ctype))
         node = Node(
             "join", [self.node, other.node], Schema(fields),
-            PartitionInfo.hashed(lk),
+            self._join_partition_info(lk, strategy),
             left_keys=lk, right_keys=rk, join_kind="inner",
-            expansion=expansion, suffix=suffix,
+            expansion=expansion, suffix=suffix, strategy=strategy,
         )
         return Query(self.ctx, node)
 
     def semi_join(
         self, other: "Query", left_keys: KeyArg,
         right_keys: Optional[KeyArg] = None, expansion: float = 4.0,
+        strategy: str = "shuffle",
     ) -> "Query":
-        return self._semi(other, left_keys, right_keys, expansion, anti=False)
+        return self._semi(other, left_keys, right_keys, expansion, False, strategy)
 
     def anti_join(
         self, other: "Query", left_keys: KeyArg,
         right_keys: Optional[KeyArg] = None, expansion: float = 4.0,
+        strategy: str = "shuffle",
     ) -> "Query":
-        return self._semi(other, left_keys, right_keys, expansion, anti=True)
+        return self._semi(other, left_keys, right_keys, expansion, True, strategy)
 
-    def _semi(self, other, left_keys, right_keys, expansion, anti) -> "Query":
+    def _semi(self, other, left_keys, right_keys, expansion, anti, strategy="shuffle") -> "Query":
+        _check_strategy(strategy)
         lk = _keys(left_keys)
         rk = _keys(right_keys) if right_keys is not None else lk
         self._require_cols(lk, "in join left keys")
         other._require_cols(rk, "in join right keys")
         node = Node(
             "join", [self.node, other.node], self.schema,
-            PartitionInfo.hashed(lk),
+            self._join_partition_info(lk, strategy),
             left_keys=lk, right_keys=rk,
             join_kind="anti" if anti else "semi", expansion=expansion,
+            strategy=strategy,
         )
         return Query(self.ctx, node)
 
@@ -437,7 +465,11 @@ class Query:
             )
         arrays = {k: np.asarray([v]) for k, v in row.items()}
         one = self.ctx.from_arrays(arrays, schema=self.schema)
-        return self.semi_join(one, self.schema.names).count() > 0
+        # One-row probe: broadcast it instead of shuffling the table.
+        return (
+            self.semi_join(one, self.schema.names, strategy="broadcast").count()
+            > 0
+        )
 
     def sequence_equal(self, other: "Query") -> bool:
         """Element-wise equality of two sequences in global engine order
@@ -478,10 +510,12 @@ class Query:
         right_defaults: Optional[Dict[str, Any]] = None,
         expansion: float = 4.0,
         suffix: str = "_r",
+        strategy: str = "shuffle",
     ) -> "Query":
         """Left-outer equi-join: unmatched left rows survive with
         default-valued right columns (the GroupJoin + DefaultIfEmpty
         left-outer idiom of the reference)."""
+        _check_strategy(strategy)
         lk = _keys(left_keys)
         rk = _keys(right_keys) if right_keys is not None else lk
         self._require_cols(lk, "in join left keys")
@@ -496,10 +530,10 @@ class Query:
         phys_defaults = other._physical_row(right_defaults or {})
         node = Node(
             "join", [self.node, other.node], Schema(fields),
-            PartitionInfo.hashed(lk),
+            self._join_partition_info(lk, strategy),
             left_keys=lk, right_keys=rk, join_kind="left",
             expansion=expansion, suffix=suffix,
-            right_defaults=phys_defaults,
+            right_defaults=phys_defaults, strategy=strategy,
         )
         return Query(self.ctx, node)
 
@@ -511,6 +545,7 @@ class Query:
         aggs: Optional[Dict[str, Tuple[str, Optional[str]]]] = None,
         defaults: Optional[Dict[str, Any]] = None,
         expansion: float = 4.0,
+        strategy: str = "shuffle",
     ) -> "Query":
         """GroupJoin (reference ``DryadLinqQueryable`` GroupJoin): per
         left row, aggregates over the group of matching right rows;
@@ -519,14 +554,17 @@ class Query:
         lk = _keys(left_keys)
         rk = _keys(right_keys) if right_keys is not None else lk
         if not aggs:
-            return self.group_join_count(other, lk, rk, expansion=expansion)
+            return self.group_join_count(
+                other, lk, rk, expansion=expansion, strategy=strategy
+            )
         right_agg = other.group_by(rk, aggs)
         dflt = dict(defaults or {})
         for out_name, (op, _col) in aggs.items():
             if op == "count" and out_name not in dflt:
                 dflt[out_name] = 0
         return self.left_join(
-            right_agg, lk, rk, right_defaults=dflt, expansion=expansion
+            right_agg, lk, rk, right_defaults=dflt, expansion=expansion,
+            strategy=strategy,
         )
 
     def _physical_row(self, values: Dict[str, Any]) -> Dict[str, Any]:
@@ -576,10 +614,12 @@ class Query:
         right_keys: Optional[KeyArg] = None,
         out: str = "match_count",
         expansion: float = 4.0,
+        strategy: str = "shuffle",
     ) -> "Query":
         """GroupJoin's aggregate shape (reference GroupJoin): per left
         row, the count of matching right rows as a new INT32 column.
         Richer group aggregations compose via join + group_by."""
+        _check_strategy(strategy)
         lk = _keys(left_keys)
         rk = _keys(right_keys) if right_keys is not None else lk
         self._require_cols(lk, "in group_join left keys")
@@ -588,9 +628,9 @@ class Query:
         fields.append((out, ColumnType.INT32))
         node = Node(
             "join", [self.node, other.node], Schema(fields),
-            PartitionInfo.hashed(lk),
+            self._join_partition_info(lk, strategy),
             left_keys=lk, right_keys=rk, join_kind="count",
-            expansion=expansion, out=out,
+            expansion=expansion, out=out, strategy=strategy,
         )
         return Query(self.ctx, node)
 

@@ -111,13 +111,25 @@ def _k_apply(ctx: StageContext, p) -> None:
 
 # -- exchanges -------------------------------------------------------------
 
-def _k_exchange_hash(ctx: StageContext, p) -> None:
-    b = ctx.slots[p["slot"]]
-    dest = partition_ids([b.data[k] for k in p["keys"]], ctx.P)
+def _do_exchange_hash(ctx: StageContext, slot: int, keys) -> None:
+    b = ctx.slots[slot]
+    dest = partition_ids([b.data[k] for k in keys], ctx.P)
     B = SH.bucket_capacity(b.capacity, ctx.P, ctx.slack * ctx.boost)
     out, ovf = SH.exchange(b, dest, ctx.P, B, AXIS)
-    ctx.slots[p["slot"]] = out
+    ctx.slots[slot] = out
     ctx.overflow = ctx.overflow | ovf
+
+
+def _do_resize(ctx: StageContext, slot: int, factor: float) -> None:
+    b = ctx.slots[slot]
+    target = _round8(ctx.base_cap(slot) * factor * ctx.boost * ctx.slack)
+    out, ovf = SH.resize(b, target)
+    ctx.slots[slot] = out
+    ctx.overflow = ctx.overflow | ovf
+
+
+def _k_exchange_hash(ctx: StageContext, p) -> None:
+    _do_exchange_hash(ctx, p["slot"], p["keys"])
 
 
 def _k_exchange_range(ctx: StageContext, p) -> None:
@@ -136,11 +148,7 @@ def _k_resize(ctx: StageContext, p) -> None:
     # Post-shuffle capacity: entry capacity x pipeline growth x retry
     # boost x slack (hash placement has variance, so the uniform
     # expectation alone overflows regularly).
-    b = ctx.slots[p["slot"]]
-    target = _round8(ctx.base_cap(p["slot"]) * p["factor"] * ctx.boost * ctx.slack)
-    out, ovf = SH.resize(b, target)
-    ctx.slots[p["slot"]] = out
-    ctx.overflow = ctx.overflow | ovf
+    _do_resize(ctx, p["slot"], p["factor"])
 
 
 # -- grouping / sorting ----------------------------------------------------
@@ -170,12 +178,67 @@ def _k_local_sort(ctx: StageContext, p) -> None:
 
 # -- multi-input -----------------------------------------------------------
 
+def _gather_all(b: ColumnBatch) -> ColumnBatch:
+    """Replicate a batch to every partition (the broadcast copy-tree of
+    ``DrDynamicBroadcast.h:23`` as one ``all_gather`` over ICI)."""
+    data = {
+        n: jax.lax.all_gather(c, AXIS, tiled=True) for n, c in b.data.items()
+    }
+    return ColumnBatch(data, jax.lax.all_gather(b.valid, AXIS, tiled=True))
+
+
+def _join_strategy(ctx: StageContext, p, right: ColumnBatch) -> bool:
+    """True -> broadcast the right side; False -> co-hash-partition.
+
+    The capacity-based analog of the reference's dynamic broadcast
+    decision (``DynamicManager.cs:51``): capacities are static at trace
+    time, so the choice is baked per compiled shape and cached."""
+    strategy = p.get("strategy", "shuffle")
+    if strategy == "broadcast":
+        return True
+    if strategy == "auto":
+        return right.capacity * ctx.P <= p.get("broadcast_limit", 1 << 16)
+    return False
+
+
+def _co_partition_for_join(ctx: StageContext, p) -> None:
+    """Hash-exchange whichever sides the plan says are not already
+    partitioned on the join keys (deferred from lowering when the
+    strategy decision is trace-time)."""
+    if p.get("need_left_exchange"):
+        _do_exchange_hash(ctx, p["left_slot"], p["left_keys"])
+        _do_resize(ctx, p["left_slot"], 1.0)
+    if p.get("need_right_exchange"):
+        _do_exchange_hash(ctx, p["right_slot"], p["right_keys"])
+        _do_resize(ctx, p["right_slot"], 1.0)
+
+
+def _apply_join_strategy(ctx: StageContext, p) -> int:
+    """Run the chosen placement (broadcast the right side, or the
+    deferred co-partition exchanges) and return the capacity base for
+    sizing candidate-pair buffers.  The base uses PRE-broadcast sizes:
+    replicating the right side multiplies its capacity by P but not the
+    match count."""
+    base = max(
+        ctx.slots[p["left_slot"]].capacity, ctx.slots[p["right_slot"]].capacity
+    )
+    if "strategy" in p:
+        if _join_strategy(ctx, p, ctx.slots[p["right_slot"]]):
+            ctx.slots[p["right_slot"]] = _gather_all(ctx.slots[p["right_slot"]])
+        else:
+            _co_partition_for_join(ctx, p)
+            base = max(
+                ctx.slots[p["left_slot"]].capacity,
+                ctx.slots[p["right_slot"]].capacity,
+            )
+    return base
+
+
 def _k_join(ctx: StageContext, p) -> None:
+    base = _apply_join_strategy(ctx, p)
     left = ctx.slots[p["left_slot"]]
     right = ctx.slots[p["right_slot"]]
-    out_cap = _round8(
-        max(left.capacity, right.capacity) * p["expansion"] * ctx.boost
-    )
+    out_cap = _round8(base * p["expansion"] * ctx.boost)
     if p.get("outer"):
         out, ovf = J.hash_join_outer(
             left, right, p["left_keys"], p["right_keys"], out_cap,
@@ -190,9 +253,10 @@ def _k_join(ctx: StageContext, p) -> None:
 
 
 def _k_semi(ctx: StageContext, p) -> None:
+    base = _apply_join_strategy(ctx, p)
     left = ctx.slots[p["left_slot"]]
     right = ctx.slots[p["right_slot"]]
-    cap = _round8(max(left.capacity, right.capacity) * p["expansion"] * ctx.boost)
+    cap = _round8(base * p["expansion"] * ctx.boost)
     mask, ovf = J.exists_mask(
         left, right, p["left_keys"], p["right_keys"], cap
     )
@@ -210,9 +274,10 @@ def _k_concat(ctx: StageContext, p) -> None:
 
 
 def _k_group_join_count(ctx: StageContext, p) -> None:
+    base = _apply_join_strategy(ctx, p)
     left = ctx.slots[p["left_slot"]]
     right = ctx.slots[p["right_slot"]]
-    cap = _round8(max(left.capacity, right.capacity) * p["expansion"] * ctx.boost)
+    cap = _round8(base * p["expansion"] * ctx.boost)
     counts, ovf = J.group_join_counts(
         left, right, p["left_keys"], p["right_keys"], cap
     )

@@ -432,12 +432,29 @@ class _Builder:
         stage = self._new_stage("join", [lref, rref])
         lkeys = K.equality_cols(left.schema, node.params["left_keys"])
         rkeys = K.equality_cols(right.schema, node.params["right_keys"])
-        if self._needs_hash_exchange_for(left, node.params["left_keys"]):
-            stage.ops.append(StageOp("exchange_hash", dict(slot=0, keys=lkeys)))
-            stage.ops.append(StageOp("resize", dict(slot=0, factor=1.0)))
-        if self._needs_hash_exchange_for(right, node.params["right_keys"]):
-            stage.ops.append(StageOp("exchange_hash", dict(slot=1, keys=rkeys)))
-            stage.ops.append(StageOp("resize", dict(slot=1, factor=1.0)))
+        strategy = node.params.get("strategy", "shuffle")
+        need_l = self._needs_hash_exchange_for(left, node.params["left_keys"])
+        need_r = self._needs_hash_exchange_for(right, node.params["right_keys"])
+        strat_params = {}
+        if strategy == "shuffle":
+            # Static co-partitioning: exchanges are their own stage ops.
+            if need_l:
+                stage.ops.append(StageOp("exchange_hash", dict(slot=0, keys=lkeys)))
+                stage.ops.append(StageOp("resize", dict(slot=0, factor=1.0)))
+            if need_r:
+                stage.ops.append(StageOp("exchange_hash", dict(slot=1, keys=rkeys)))
+                stage.ops.append(StageOp("resize", dict(slot=1, factor=1.0)))
+        else:
+            # broadcast / auto: the kernel decides at trace time from the
+            # right side's static capacity (DrDynamicBroadcastManager
+            # analog) and either all_gathers the right side or performs
+            # the deferred co-partitioning exchanges itself.
+            strat_params = dict(
+                strategy=strategy,
+                need_left_exchange=need_l,
+                need_right_exchange=need_r,
+                broadcast_limit=self.config.broadcast_limit,
+            )
         jk = node.params.get("join_kind", "inner")
         if jk == "count":
             stage.ops.append(
@@ -450,6 +467,7 @@ class _Builder:
                         right_keys=rkeys,
                         out=node.params["out"],
                         expansion=node.params.get("expansion", 1.0),
+                        **strat_params,
                     ),
                 )
             )
@@ -466,6 +484,7 @@ class _Builder:
                         suffix=node.params.get("suffix", "_r"),
                         outer=(jk == "left"),
                         right_defaults=node.params.get("right_defaults"),
+                        **strat_params,
                     ),
                 )
             )
@@ -483,6 +502,7 @@ class _Builder:
                         right_keys=rkeys,
                         negate=(jk == "anti"),
                         expansion=node.params.get("expansion", 1.0),
+                        **strat_params,
                     ),
                 )
             )

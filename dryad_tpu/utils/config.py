@@ -84,6 +84,12 @@ class DryadConfig:
     # Outlier threshold in sigmas for speculative duplication
     # (reference DrStageStatistics.cpp:24-25: 3 sigma).
     outlier_sigmas: float = 3.0
+    # Broadcast-join threshold: with strategy='auto', a right side whose
+    # TOTAL row capacity (per-partition capacity x P) is at or below this
+    # is replicated via all_gather instead of co-hash-partitioned (the
+    # dynamic broadcast decision of DynamicManager.cs:51 /
+    # DrDynamicBroadcast.h:23, made trace-time from static capacities).
+    broadcast_limit: int = _env_int("DRYAD_TPU_BROADCAST_LIMIT", 1 << 16)
 
     def validate(self) -> None:
         if self.partition_count < 1:

@@ -1,0 +1,162 @@
+"""Join strategy tests: broadcast vs shuffle vs auto.
+
+The broadcast join is the TPU-native ``DrDynamicBroadcastManager``
+(``DrDynamicBroadcast.h:23``; ``DynamicManager.cs:51``): a small right
+side is replicated to every partition with one ``all_gather`` instead of
+co-hash-partitioning both sides.  Every strategy must produce identical
+results; broadcast must additionally preserve the left side's
+partitioning (no exchange on the big side).
+"""
+
+import numpy as np
+import pytest
+
+from dryad_tpu import DryadContext
+from oracle import check
+
+
+@pytest.fixture
+def ctx(mesh8):
+    return DryadContext(num_partitions_=8)
+
+
+@pytest.fixture
+def dbg():
+    return DryadContext(local_debug=True)
+
+
+def _sides(n_left=300, n_right=12):
+    rng = np.random.default_rng(11)
+    left = {
+        "k": rng.integers(0, 16, n_left).astype(np.int32),
+        "lv": np.arange(n_left, dtype=np.int32),
+    }
+    right = {
+        "k": np.arange(0, n_right, dtype=np.int32),
+        "rv": (np.arange(n_right) * 1.5).astype(np.float32),
+    }
+    return left, right
+
+
+@pytest.mark.parametrize("strategy", ["shuffle", "broadcast", "auto"])
+def test_inner_join_strategies_agree(ctx, dbg, strategy):
+    left, right = _sides()
+
+    def q(c, strat):
+        return (
+            c.from_arrays(left)
+            .join(c.from_arrays(right), "k", strategy=strat)
+            .collect()
+        )
+
+    check(q(ctx, strategy), q(dbg, "shuffle"))
+
+
+@pytest.mark.parametrize("strategy", ["broadcast", "auto"])
+def test_semi_anti_join_strategies(ctx, dbg, strategy):
+    left, right = _sides()
+
+    def q(c, strat, anti):
+        a = c.from_arrays(left)
+        b = c.from_arrays(right)
+        j = a.anti_join(b, "k", strategy=strat) if anti else a.semi_join(
+            b, "k", strategy=strat
+        )
+        return j.collect()
+
+    for anti in (False, True):
+        check(q(ctx, strategy, anti), q(dbg, "shuffle", anti))
+
+
+def test_left_join_broadcast(ctx, dbg):
+    left, right = _sides(n_left=100, n_right=4)
+
+    def q(c, strat):
+        return (
+            c.from_arrays(left)
+            .left_join(
+                c.from_arrays(right), "k",
+                right_defaults={"rv": -1.0}, strategy=strat,
+            )
+            .collect()
+        )
+
+    check(q(ctx, "broadcast"), q(dbg, "shuffle"))
+    got = q(ctx, "broadcast")
+    assert (got["rv"][got["k"] >= 4] == -1.0).all()
+
+
+def test_group_join_count_broadcast(ctx, dbg):
+    left, right = _sides()
+
+    def q(c, strat):
+        return (
+            c.from_arrays(left)
+            .group_join_count(c.from_arrays(right), "k", strategy=strat)
+            .collect()
+        )
+
+    check(q(ctx, "broadcast"), q(dbg, "shuffle"))
+
+
+def test_broadcast_preserves_left_partitioning(ctx):
+    """After a broadcast join, a group_by on the left's hash keys must
+    not need another exchange: check the plan, not just the result."""
+    from dryad_tpu.plan.lower import lower
+
+    left, right = _sides()
+    q = (
+        ctx.from_arrays(left)
+        .hash_partition("k")
+        .join(ctx.from_arrays(right), "k", strategy="broadcast")
+        .group_by("k", {"n": ("count", None)})
+    )
+    graph = lower([q.node], ctx.config)
+    ops = [op.kind for s in graph.stages for op in s.ops]
+    # exactly ONE hash exchange (the explicit hash_partition); neither
+    # the broadcast join nor the subsequent group_by adds another.
+    assert ops.count("exchange_hash") == 1, ops
+
+
+def test_auto_chooses_shuffle_when_right_large(ctx, dbg):
+    rng = np.random.default_rng(5)
+    n = 2000
+    left = {"k": rng.integers(0, 50, n).astype(np.int32),
+            "lv": np.arange(n, dtype=np.int32)}
+    right = {"k": rng.integers(0, 50, n).astype(np.int32),
+             "rv": np.arange(n, dtype=np.float32)}
+    ctx.config.broadcast_limit = 64  # force the fallback path
+
+    def q(c, strat):
+        return (
+            c.from_arrays(left)
+            .join(c.from_arrays(right), "k", strategy=strat, expansion=60.0)
+            .collect()
+        )
+
+    check(q(ctx, "auto"), q(dbg, "shuffle"))
+
+
+def test_bad_strategy_rejected(ctx):
+    left, right = _sides()
+    with pytest.raises(ValueError):
+        ctx.from_arrays(left).join(
+            ctx.from_arrays(right), "k", strategy="nope"
+        )
+
+
+def test_group_join_broadcast_strategy(ctx, dbg):
+    left, right = _sides(n_left=60, n_right=6)
+
+    def q(c, strat):
+        return (
+            c.from_arrays(left)
+            .group_join(
+                c.from_arrays(right), "k",
+                aggs={"n": ("count", None), "s": ("sum", "rv")},
+                defaults={"s": 0.0}, strategy=strat,
+            )
+            .collect()
+        )
+
+    check(q(ctx, "broadcast"), q(dbg, "shuffle"))
