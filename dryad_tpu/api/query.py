@@ -767,6 +767,13 @@ class Query:
         return bool(self._scalar("all", col))
 
     # -- materialization -----------------------------------------------------
+    def explain(self) -> str:
+        """Pretty-print the logical plan and fused stage graph
+        (``DryadLinqQueryExplain.cs`` analog)."""
+        from dryad_tpu.tools.explain import explain
+
+        return explain(self)
+
     def collect(self) -> Dict[str, np.ndarray]:
         """Execute and fetch host logical columns (reference
         Submit+enumerate path, ``DryadLinqQuery.cs:608``)."""

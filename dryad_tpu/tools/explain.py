@@ -1,0 +1,84 @@
+"""Query plan explain — the ``DryadLinqQueryExplain`` analog.
+
+The reference pretty-prints the optimized physical plan per submission
+(``LinqToDryad/DryadLinqQueryExplain.cs``, artifacts
+``QueryGraph__.txt``/``DryadLinqProgram__.xml``,
+``DryadLinqQueryGen.cs:46-47``).  Here: a two-part text rendering of
+(1) the logical node DAG with partition metadata and (2) the fused
+stage graph the executor will run — the post-Phase-2/3 view, showing
+which operators fused into one SPMD program and where exchanges
+(shuffles) happen.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+from dryad_tpu.plan.lower import StageGraph
+from dryad_tpu.plan.nodes import Node, walk
+
+# Stage-op kinds that imply a cross-partition exchange inside the
+# compiled program (all_to_all / collective boundary).
+_EXCHANGE_OPS = {"exchange_hash", "exchange_range"}
+
+
+def _fmt_partition(node: Node) -> str:
+    p = node.partition
+    bits = [p.scheme]
+    if p.keys:
+        bits.append("keys=" + ",".join(p.keys))
+    if p.range_by:
+        bits.append(
+            "range=" + ",".join(f"{n}{'v' if d else '^'}" for n, d in p.range_by)
+        )
+    if p.ordered_by:
+        bits.append(
+            "ordered=" + ",".join(f"{n}{'v' if d else '^'}" for n, d in p.ordered_by)
+        )
+    return " ".join(bits)
+
+
+def explain_logical(roots: Sequence[Node]) -> str:
+    """Render the logical DAG in topological order, one node per line."""
+    lines = ["== logical plan =="]
+    for n in walk(roots):
+        ins = ",".join(f"#{i.id}" for i in n.inputs) or "-"
+        cols = ",".join(n.schema.names)
+        lines.append(
+            f"#{n.id:<4} {n.kind:<16} <- {ins:<12} [{cols}]  ({_fmt_partition(n)})"
+        )
+    return "\n".join(lines)
+
+
+def explain_stages(graph: StageGraph) -> str:
+    """Render the fused stage graph (the SuperNode view)."""
+    lines = ["== stage graph =="]
+    for s in graph.stages:
+        refs = []
+        for ref, idx in s.input_refs:
+            if ref == "plan_input":
+                refs.append(f"input#{idx}")
+            else:
+                refs.append(f"stage{ref}.out{idx}")
+        ops = " | ".join(
+            f"{op.kind}{'*' if op.kind in _EXCHANGE_OPS else ''}" for op in s.ops
+        )
+        lines.append(
+            f"stage {s.id:<3} {s.name:<40} <- {','.join(refs) or '-'}"
+        )
+        lines.append(f"      ops: {ops or '-'}   outs={len(s.out_slots)}"
+                     + (f"  growth={s.growth:g}" if s.growth != 1.0 else ""))
+    n_ex = sum(
+        1 for s in graph.stages for op in s.ops if op.kind in _EXCHANGE_OPS
+    )
+    lines.append(f"-- {len(graph.stages)} stages, {n_ex} exchanges "
+                 f"(* = cross-partition collective)")
+    return "\n".join(lines)
+
+
+def explain(query) -> str:
+    """Full explain text for an API ``Query`` (logical + fused stages)."""
+    from dryad_tpu.plan.lower import lower
+
+    graph = lower([query.node], query.ctx.config)
+    return explain_logical([query.node]) + "\n\n" + explain_stages(graph)

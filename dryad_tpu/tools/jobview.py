@@ -1,0 +1,221 @@
+"""Job viewer — the JobBrowser / JOM analog.
+
+The reference ships a WinForms GUI that reconstructs a job (stages,
+vertices, per-vertex timings, failures) from the GraphManager's Calypso
+event log and runs a failure **Diagnosis** pass
+(``JobBrowser/JOM/jobinfo.cs:62``, ``JobBrowser/JobBrowser/Diagnosis.cs``).
+Here the event source is the executor's JSONL event log
+(``dryad_tpu.exec.events``); this module rebuilds the job model,
+renders a text report, and diagnoses common failure shapes.
+
+CLI: ``python -m dryad_tpu.tools.jobview <events.jsonl>``
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+from typing import Any, Dict, List, Optional
+
+from dryad_tpu.exec.events import EventLog
+
+
+@dataclasses.dataclass
+class StageInfo:
+    """Runtime record of one stage (the JOM DryadLinqJobStage analog)."""
+
+    id: int
+    name: str
+    versions: int = 0
+    completed: bool = False
+    failures: int = 0
+    overflows: int = 0
+    stragglers: int = 0
+    seconds: float = 0.0
+    last_error: Optional[str] = None
+    max_boost: int = 1
+
+
+@dataclasses.dataclass
+class JobInfo:
+    stages: Dict[int, StageInfo]
+    n_stages_declared: int
+    started: bool
+    completed: bool
+    failed: bool
+    do_while_iters: int
+    wall_seconds: float
+
+    @property
+    def ok(self) -> bool:
+        return self.completed and not self.failed
+
+
+def split_jobs(events: List[Dict[str, Any]]) -> List[List[Dict[str, Any]]]:
+    """Split a per-context event stream into per-job segments.
+
+    One context appends every submission to the same log, so a log may
+    hold several job_start..job_complete spans; events before the first
+    job_start (if any) join the first segment."""
+    segments: List[List[Dict[str, Any]]] = []
+    cur: List[Dict[str, Any]] = []
+    for ev in events:
+        if ev["kind"] == "job_start" and any(
+            e["kind"] == "job_start" for e in cur
+        ):
+            segments.append(cur)
+            cur = []
+        cur.append(ev)
+    if cur:
+        segments.append(cur)
+    return segments
+
+
+def build_jobs(events: List[Dict[str, Any]]) -> List["JobInfo"]:
+    return [_fold_job(seg) for seg in split_jobs(events)]
+
+
+def build_job(events: List[Dict[str, Any]]) -> JobInfo:
+    """Job model of the MOST RECENT job in the stream."""
+    segs = split_jobs(events)
+    return _fold_job(segs[-1] if segs else [])
+
+
+def _fold_job(events: List[Dict[str, Any]]) -> JobInfo:
+    """Fold one job's event segment into a job model."""
+    stages: Dict[int, StageInfo] = {}
+    declared = 0
+    started = completed = failed = False
+    iters = 0
+    t0 = t1 = None
+
+    def stage(ev) -> StageInfo:
+        sid = ev["stage"]
+        if sid not in stages:
+            stages[sid] = StageInfo(sid, ev.get("name", f"stage{sid}"))
+        return stages[sid]
+
+    for ev in events:
+        kind = ev["kind"]
+        ts = ev.get("ts")
+        if ts is not None:
+            t0 = ts if t0 is None else t0
+            t1 = ts
+        if kind == "job_start":
+            started = True
+            declared = ev.get("stages", 0)
+        elif kind == "job_complete":
+            completed = True
+        elif kind == "job_failed":
+            failed = True
+        elif kind == "stage_start":
+            s = stage(ev)
+            s.versions = max(s.versions, ev.get("version", s.versions + 1))
+            s.max_boost = max(s.max_boost, ev.get("boost", 1))
+        elif kind == "stage_complete":
+            s = stage(ev)
+            s.completed = True
+            s.seconds += ev.get("seconds", 0.0)
+        elif kind == "stage_failed":
+            s = stage(ev)
+            s.failures += 1
+            s.last_error = ev.get("error")
+        elif kind == "stage_overflow":
+            stage(ev).overflows += 1
+        elif kind == "stage_straggler":
+            stage(ev).stragglers += 1
+        elif kind in ("do_while_iter",):
+            iters = max(iters, ev.get("iter", 0))
+    wall = (t1 - t0) if (t0 is not None and t1 is not None) else 0.0
+    return JobInfo(stages, declared, started, completed, failed, iters, wall)
+
+
+def diagnose(job: JobInfo) -> List[str]:
+    """Failure/performance diagnosis (Diagnosis.cs analog): name the
+    likely cause and the knob to turn."""
+    out: List[str] = []
+    if not job.started:
+        out.append("no job_start event — log is empty or truncated")
+        return out
+    for s in sorted(job.stages.values(), key=lambda s: s.id):
+        if not s.completed and job.failed:
+            if s.failures:
+                why = f": {s.last_error}" if s.last_error else ""
+                out.append(
+                    f"stage {s.id} ({s.name}) FAILED after {s.failures} "
+                    f"attempt(s){why} — exceeded the failure budget "
+                    f"(config.max_stage_failures)"
+                )
+            elif s.overflows:
+                out.append(
+                    f"stage {s.id} ({s.name}) FAILED: shuffle capacity "
+                    f"exhausted after {s.overflows} overflow retries "
+                    f"(boost reached {s.max_boost}x) — severe skew or "
+                    f"under-provisioned capacity; raise "
+                    f"config.shuffle_slack / max_shuffle_retries or "
+                    f"repartition on a better key"
+                )
+            else:
+                out.append(
+                    f"stage {s.id} ({s.name}) did not complete before the "
+                    f"job failed"
+                )
+        if s.overflows:
+            out.append(
+                f"stage {s.id} ({s.name}) overflowed {s.overflows}x "
+                f"(final capacity boost {s.max_boost}x) — shuffle skew or "
+                f"under-provisioned capacity; raise config.shuffle_slack "
+                f"or pre-partition on a better key"
+            )
+        if s.stragglers:
+            out.append(
+                f"stage {s.id} ({s.name}) flagged as straggler "
+                f"{s.stragglers}x — duration beyond the Gaussian outlier "
+                f"threshold; candidate for speculative duplication"
+            )
+        if s.failures and s.completed:
+            out.append(
+                f"stage {s.id} ({s.name}) recovered after {s.failures} "
+                f"failure(s) via versioned re-execution"
+            )
+    if job.completed and not job.failed and not out:
+        out.append("job completed cleanly; no anomalies")
+    return out
+
+
+def render(job: JobInfo) -> str:
+    """Text report: per-stage table + status + diagnosis."""
+    lines = []
+    status = "FAILED" if job.failed else ("OK" if job.completed else "INCOMPLETE")
+    lines.append(
+        f"job: {status}  stages={len(job.stages)}/{job.n_stages_declared or '?'}"
+        f"  wall={job.wall_seconds:.3f}s"
+        + (f"  do_while_iters={job.do_while_iters}" if job.do_while_iters else "")
+    )
+    lines.append(
+        f"{'id':>4} {'stage':<40} {'vers':>4} {'fail':>4} {'ovfl':>4} "
+        f"{'slow':>4} {'secs':>8}  state"
+    )
+    for s in sorted(job.stages.values(), key=lambda s: s.id):
+        lines.append(
+            f"{s.id:>4} {s.name[:40]:<40} {s.versions:>4} {s.failures:>4} "
+            f"{s.overflows:>4} {s.stragglers:>4} {s.seconds:>8.3f}  "
+            f"{'done' if s.completed else 'NOT DONE'}"
+        )
+    lines.append("-- diagnosis --")
+    lines.extend("  " + d for d in diagnose(job))
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) != 1:
+        print("usage: python -m dryad_tpu.tools.jobview <events.jsonl>")
+        return 2
+    job = build_job(EventLog.load(argv[0]))
+    print(render(job))
+    return 0 if job.ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,123 @@
+"""Tools tests: plan explain + jobview (JobBrowser/Diagnosis analog)."""
+
+import numpy as np
+import pytest
+
+from dryad_tpu import DryadConfig, DryadContext
+from dryad_tpu.exec.executor import StageFailedError
+from dryad_tpu.exec.faults import clear_faults, set_fake_stage_failure
+from dryad_tpu.tools.jobview import build_job, diagnose, main, render
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    clear_faults()
+    yield
+    clear_faults()
+
+
+def _wordcountish(ctx):
+    q = ctx.from_arrays(
+        {"k": np.arange(100, dtype=np.int32) % 7,
+         "v": np.ones(100, np.float32)}
+    )
+    return q.group_by("k", {"s": ("sum", "v")}).order_by([("s", True)])
+
+
+def test_explain_renders_logical_and_stages(mesh8):
+    ctx = DryadContext(num_partitions_=8)
+    text = _wordcountish(ctx).explain()
+    assert "== logical plan ==" in text
+    assert "== stage graph ==" in text
+    assert "group_by" in text
+    # exchanges are marked: group_by hash exchange + order_by range exchange
+    assert "exchange_hash*" in text
+    assert "exchange_range*" in text
+
+
+def test_explain_is_side_effect_free(mesh8):
+    ctx = DryadContext(num_partitions_=8)
+    q = _wordcountish(ctx)
+    q.explain()
+    out = q.collect()
+    assert len(out["k"]) == 7
+    assert float(np.asarray(out["s"]).sum()) == 100.0
+
+
+def test_jobview_clean_job(mesh8):
+    ctx = DryadContext(num_partitions_=8)
+    _wordcountish(ctx).collect()
+    job = build_job(ctx.events.events())
+    assert job.ok
+    assert all(s.completed for s in job.stages.values())
+    text = render(job)
+    assert "job: OK" in text
+    assert "completed cleanly" in text or "recovered" in text
+
+
+def test_jobview_recovered_failure(mesh8):
+    ctx = DryadContext(num_partitions_=8)
+    set_fake_stage_failure("group_by", 1)
+    _wordcountish(ctx).collect()
+    job = build_job(ctx.events.events())
+    assert job.ok
+    notes = diagnose(job)
+    assert any("recovered" in n and "versioned re-execution" in n for n in notes)
+
+
+def test_jobview_failed_job_diagnosis(mesh8):
+    ctx = DryadContext(num_partitions_=8, config=DryadConfig(max_stage_failures=2))
+    set_fake_stage_failure("group_by", 99)
+    with pytest.raises(StageFailedError):
+        _wordcountish(ctx).collect()
+    job = build_job(ctx.events.events())
+    assert job.failed and not job.ok
+    notes = diagnose(job)
+    assert any("FAILED" in n and "failure budget" in n for n in notes)
+    assert "FAILED" in render(job)
+
+
+def test_jobview_multi_job_log_uses_last_job(mesh8):
+    """Regression: one context's log holds every submission; build_job
+    must fold only the most recent job, not merge all of them."""
+    from dryad_tpu.tools.jobview import build_jobs
+
+    ctx = DryadContext(num_partitions_=8)
+    _wordcountish(ctx).collect()
+    n_first = len(build_job(ctx.events.events()).stages)
+    _wordcountish(ctx).collect()
+    jobs = build_jobs(ctx.events.events())
+    assert len(jobs) == 2
+    last = build_job(ctx.events.events())
+    assert len(last.stages) == n_first  # not doubled
+    assert last.ok
+
+
+def test_jobview_overflow_exhaustion_not_blamed_on_budget():
+    """Regression: overflow-exhaustion job failure (no stage_failed
+    events) must be diagnosed as capacity, not failure budget."""
+    events = [
+        {"ts": 0.0, "kind": "job_start", "stages": 1},
+        {"ts": 0.1, "kind": "stage_start", "stage": 3, "name": "join", "version": 1, "boost": 1},
+        {"ts": 0.2, "kind": "stage_overflow", "stage": 3, "name": "join", "version": 1, "boost": 1},
+        {"ts": 0.3, "kind": "stage_start", "stage": 3, "name": "join", "version": 2, "boost": 8},
+        {"ts": 0.4, "kind": "stage_overflow", "stage": 3, "name": "join", "version": 2, "boost": 8},
+        {"ts": 0.5, "kind": "job_failed", "stage": 3, "name": "join"},
+    ]
+    notes = diagnose(build_job(events))
+    assert any("capacity exhausted" in n for n in notes)
+    assert not any("failure budget" in n for n in notes)
+
+
+def test_jobview_cli_roundtrip(mesh8, tmp_path):
+    import glob
+    import os
+
+    ctx = DryadContext(
+        num_partitions_=8, config=DryadConfig(event_log_dir=str(tmp_path))
+    )
+    _wordcountish(ctx).collect()
+    ctx.events.close()
+    (log_path,) = glob.glob(os.path.join(str(tmp_path), "*.jsonl"))
+    assert main([log_path]) == 0
+    assert main([]) == 2
