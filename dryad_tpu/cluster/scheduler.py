@@ -1,0 +1,218 @@
+"""LocalScheduler — affinity queues with delay-based locality relaxation.
+
+The analog of the reference scheduler (``LocalScheduler/LocalScheduler.cs``):
+processes queue at their preferred computer first, relax to the rack
+queue after ``rack_delay`` seconds and to the cluster-wide queue after
+``cluster_delay`` seconds (reference defaults 1s/2s,
+``LocalScheduler.cs:52-53``); hard constraints never relax
+(``:149-160``).  Computer membership is elastic
+(``WaitForReasonableNumberOfComputers``, ``LocalScheduler.cs:88``).
+
+Worker slots are threads; a "process" is host-side work (stage
+materialization, ingest/egress, control) — see ``interfaces`` docstring.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from dryad_tpu.cluster.interfaces import (
+    Affinity,
+    ClusterProcess,
+    Computer,
+    ProcessState,
+    Scheduler,
+)
+from dryad_tpu.utils.logging import get_logger
+
+log = get_logger("dryad_tpu.cluster")
+
+
+class _Entry:
+    def __init__(self, process: ClusterProcess):
+        self.process = process
+        self.enqueued = time.monotonic()
+
+
+class LocalScheduler(Scheduler):
+    def __init__(
+        self,
+        computers: Optional[List[Computer]] = None,
+        rack_delay: float = 1.0,
+        cluster_delay: float = 2.0,
+        poll_interval: float = 0.02,
+    ):
+        self.rack_delay = rack_delay
+        self.cluster_delay = cluster_delay
+        self.poll_interval = poll_interval
+        self._lock = threading.Condition()
+        self._computers: Dict[str, Computer] = {}
+        self._busy: Dict[str, int] = {}  # computer -> running count
+        self._queue: List[_Entry] = []  # single list; eligibility by age
+        self._stop = False
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name="dryad-scheduler", daemon=True
+        )
+        for c in computers or []:
+            self._computers[c.name] = c
+            self._busy[c.name] = 0
+        self._dispatcher.start()
+
+    # -- membership (elastic, Interfaces.cs:336-343) -------------------------
+    def add_computer(self, computer: Computer) -> None:
+        with self._lock:
+            self._computers[computer.name] = computer
+            self._busy.setdefault(computer.name, 0)
+            self._lock.notify_all()
+
+    def remove_computer(self, name: str) -> None:
+        with self._lock:
+            self._computers.pop(name, None)
+
+    def computers(self) -> List[Computer]:
+        with self._lock:
+            return list(self._computers.values())
+
+    def wait_for_computers(self, n: int, timeout: float = 30.0) -> bool:
+        """Block until >= n computers joined (LocalScheduler.cs:88)."""
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while len(self._computers) < n:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._lock.wait(left)
+            return True
+
+    # -- scheduling ----------------------------------------------------------
+    def schedule(self, process: ClusterProcess) -> None:
+        with self._lock:
+            process._transition(ProcessState.QUEUED)
+            self._queue.append(_Entry(process))
+            self._lock.notify_all()
+
+    def cancel(self, process: ClusterProcess) -> None:
+        """Cancel a queued or running process (``ICluster.CancelProcess``).
+
+        Running work observes ``process.cancelled`` cooperatively (the
+        reference kills the worker process; slots here are threads)."""
+        with self._lock:
+            for e in list(self._queue):
+                if e.process is process:
+                    self._queue.remove(e)
+                    process._cancel.set()
+                    process._transition(ProcessState.CANCELED)
+                    return
+        process._cancel.set()  # running: cooperative
+
+    # -- placement policy ----------------------------------------------------
+    def _rack_of(self, locality: str) -> str:
+        """A locality names a computer or a rack; resolve to a rack."""
+        c = self._computers.get(locality)
+        return c.rack if c is not None else locality
+
+    def _eligible(self, entry: _Entry, comp: Computer) -> bool:
+        affs = entry.process.affinities
+        if not affs:
+            return True
+        hard = [a for a in affs if a.hard]
+        if hard:
+            # a hard computer constraint pins exactly that computer; a
+            # hard rack constraint allows any computer in the rack
+            return any(
+                a.locality == comp.name
+                or (
+                    a.locality not in self._computers
+                    and a.locality == comp.rack
+                )
+                for a in hard
+            )
+        age = time.monotonic() - entry.enqueued
+        if any(a.locality == comp.name for a in affs):
+            return True
+        if age >= self.rack_delay and any(
+            self._rack_of(a.locality) == comp.rack for a in affs
+        ):
+            return True
+        return age >= self.cluster_delay
+
+    def _pick(self) -> Optional[tuple]:
+        """Find (entry, computer) to run; prefer older entries and their
+        stronger (higher-weight) affinities."""
+        idle = [
+            c
+            for c in self._computers.values()
+            if self._busy.get(c.name, 0) < c.slots
+        ]
+        if not idle:
+            return None
+        for entry in self._queue:  # FIFO
+            affs = sorted(
+                entry.process.affinities, key=lambda a: -a.weight
+            )
+            # strongest preference first: exact computer, then rack
+            for a in affs:
+                for c in idle:
+                    if c.name == a.locality and self._eligible(entry, c):
+                        return entry, c
+            for a in affs:
+                for c in idle:
+                    if c.rack == a.locality and self._eligible(entry, c):
+                        return entry, c
+            for c in idle:
+                if self._eligible(entry, c):
+                    return entry, c
+        return None
+
+    # -- dispatch loop -------------------------------------------------------
+    def _dispatch_loop(self) -> None:
+        while True:
+            with self._lock:
+                if self._stop:
+                    return
+                pick = self._pick()
+                if pick is None:
+                    self._lock.wait(self.poll_interval)
+                    continue
+                entry, comp = pick
+                self._queue.remove(entry)
+                self._busy[comp.name] += 1
+            threading.Thread(
+                target=self._run, args=(entry.process, comp), daemon=True
+            ).start()
+
+    def _run(self, process: ClusterProcess, comp: Computer) -> None:
+        process.computer = comp.name
+        process._transition(ProcessState.RUNNING)
+        try:
+            if process.cancelled:
+                process._transition(ProcessState.CANCELED)
+                return
+            process.result = process.fn(process)
+        except BaseException as e:  # noqa: BLE001 — report, don't die
+            process.error = e
+            log.warning("process %s failed on %s: %s", process.name, comp.name, e)
+            process._transition(ProcessState.FAILED)
+        else:
+            if process.cancelled:
+                process._transition(ProcessState.CANCELED)
+            else:
+                process._transition(ProcessState.COMPLETED)
+        finally:
+            with self._lock:
+                if comp.name in self._busy:
+                    self._busy[comp.name] -= 1
+                self._lock.notify_all()
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._stop = True
+            drained = [e.process for e in self._queue]
+            self._queue.clear()
+            self._lock.notify_all()
+        for p in drained:  # never-started work must still reach a terminal state
+            p._cancel.set()
+            p._transition(ProcessState.CANCELED)
+        self._dispatcher.join(timeout=5)

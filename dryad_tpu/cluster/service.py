@@ -1,0 +1,351 @@
+"""Per-node process service — mailbox + file server + block cache.
+
+The analog of the reference's per-worker daemon (``ProcessService/``):
+
+- **versioned property mailbox** (``ProcessService.cs:42-126``
+  ``ValueVersion``/``MailboxRecord``): the control plane.  The job
+  manager sets a command property; the worker long-polls for a version
+  newer than the last it saw, and posts status back the same way.
+- **file server** (``HttpServer.cs:498,631-667`` FileServer): serves
+  channel/partition files by ``?offset=&length=`` range reads so remote
+  consumers stream persisted stage outputs over HTTP/DCN.
+- **block cache with spill-to-disk** (``Cache.cs:32``,
+  ``SpillMachine.cs:30``): hot file blocks stay in memory under a byte
+  budget; evicted blocks spill to a local directory before re-reading
+  from the source.
+
+In the TPU framework this service is the DCN-side control/data plane for
+multi-host jobs; intra-slice data movement rides ICI collectives inside
+compiled programs and never touches it.
+"""
+
+from __future__ import annotations
+
+import collections
+import http.client
+import http.server
+import os
+import threading
+import time
+import urllib.parse
+from typing import Dict, List, Optional, Tuple
+
+from dryad_tpu.utils.logging import get_logger
+
+log = get_logger("dryad_tpu.cluster.service")
+
+DEFAULT_BLOCK = 2 * 1024 * 1024  # 2MB blocks, HttpServer.cs FileServer
+
+
+class Mailbox:
+    """Versioned key-value property store, long-poll reads."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Condition()
+        # (pid, name) -> (version, value)
+        self._props: Dict[Tuple[str, str], Tuple[int, bytes]] = {}
+
+    def set_prop(self, pid: str, name: str, value: bytes) -> int:
+        with self._lock:
+            ver = self._props.get((pid, name), (0, b""))[0] + 1
+            self._props[(pid, name)] = (ver, value)
+            self._lock.notify_all()
+            return ver
+
+    def get_prop(
+        self,
+        pid: str,
+        name: str,
+        after_version: int = 0,
+        timeout: float = 0.0,
+    ) -> Optional[Tuple[int, bytes]]:
+        """Return (version, value) once version > after_version, else
+        None after ``timeout`` (0 = non-blocking)."""
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while True:
+                cur = self._props.get((pid, name))
+                if cur is not None and cur[0] > after_version:
+                    return cur
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return None
+                self._lock.wait(left)
+
+    def processes(self) -> List[str]:
+        with self._lock:
+            return sorted({pid for pid, _ in self._props})
+
+
+class BlockCache:
+    """Memory block cache with LRU spill-to-disk (Cache + SpillMachine)."""
+
+    def __init__(
+        self,
+        root: str,
+        spill_dir: Optional[str] = None,
+        memory_budget: int = 64 * 1024 * 1024,
+        block_size: int = DEFAULT_BLOCK,
+    ):
+        self.root = os.path.abspath(root)
+        self.block_size = block_size
+        self.memory_budget = memory_budget
+        self.spill_dir = spill_dir
+        self._lock = threading.Lock()
+        self._mem: "collections.OrderedDict[Tuple[str, int], bytes]" = (
+            collections.OrderedDict()
+        )
+        self._mem_bytes = 0
+        self._spilled: Dict[Tuple[str, int], str] = {}
+        self.hits = self.misses = self.spills = 0
+        if spill_dir:
+            os.makedirs(spill_dir, exist_ok=True)
+
+    def _source_path(self, rel: str) -> str:
+        path = os.path.normpath(os.path.join(self.root, rel))
+        if not path.startswith(os.path.abspath(self.root) + os.sep) and (
+            path != os.path.abspath(self.root)
+        ):
+            # normalize against traversal; root itself is not a file
+            raise PermissionError(f"path escapes root: {rel}")
+        return path
+
+    def _load_block(self, rel: str, bi: int) -> bytes:
+        key = (rel, bi)
+        spath = self._spilled.get(key)
+        if spath is not None and os.path.exists(spath):
+            with open(spath, "rb") as fh:
+                return fh.read()
+        with open(self._source_path(rel), "rb") as fh:
+            fh.seek(bi * self.block_size)
+            return fh.read(self.block_size)
+
+    def _put(self, key: Tuple[str, int], block: bytes) -> None:
+        old = self._mem.pop(key, None)
+        if old is not None:
+            self._mem_bytes -= len(old)
+        self._mem[key] = block
+        self._mem_bytes += len(block)
+        while self._mem_bytes > self.memory_budget and len(self._mem) > 1:
+            old_key, old = self._mem.popitem(last=False)
+            self._mem_bytes -= len(old)
+            if self.spill_dir and old_key not in self._spilled:
+                sp = os.path.join(
+                    self.spill_dir,
+                    f"{abs(hash(old_key)):016x}.blk",
+                )
+                with open(sp, "wb") as fh:
+                    fh.write(old)
+                self._spilled[old_key] = sp
+                self.spills += 1
+
+    def read(self, rel: str, offset: int, length: int) -> bytes:
+        """Range read through the cache."""
+        out = bytearray()
+        end = offset + length
+        while offset < end:
+            bi = offset // self.block_size
+            key = (rel, bi)
+            with self._lock:
+                block = self._mem.get(key)
+                if block is not None:
+                    self._mem.move_to_end(key)
+                    self.hits += 1
+            if block is None:
+                block = self._load_block(rel, bi)
+                with self._lock:
+                    self.misses += 1
+                    # a short tail block may still be growing (reader
+                    # racing a writer) — serving it from cache later
+                    # would permanently truncate the file
+                    if len(block) == self.block_size:
+                        self._put(key, block)
+            lo = offset - bi * self.block_size
+            take = min(end - offset, len(block) - lo)
+            if take <= 0:
+                break  # EOF
+            out += block[lo : lo + take]
+            offset += take
+        return bytes(out)
+
+    def file_size(self, rel: str) -> int:
+        return os.path.getsize(self._source_path(rel))
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    """Routes:
+    GET  /prop/<pid>/<name>?after=V&timeout=T   long-poll property read
+    POST /prop/<pid>/<name>                     set property (body=value)
+    GET  /file/<relpath>?offset=O&length=L      range read via block cache
+    GET  /status                                service health/stats
+    """
+
+    service: "ProcessService"
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def _send(self, code: int, body: bytes, headers: Dict[str, str] = {}):
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in headers.items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        u = urllib.parse.urlparse(self.path)
+        q = urllib.parse.parse_qs(u.query)
+        parts = u.path.strip("/").split("/")
+        try:
+            if parts[0] == "prop" and len(parts) >= 3:
+                pid, name = parts[1], "/".join(parts[2:])
+                after = int(q.get("after", ["0"])[0])
+                timeout = float(q.get("timeout", ["0"])[0])
+                got = self.service.mailbox.get_prop(pid, name, after, timeout)
+                if got is None:
+                    self._send(204, b"")
+                else:
+                    ver, val = got
+                    self._send(200, val, {"X-Version": str(ver)})
+            elif parts[0] == "file" and len(parts) >= 2:
+                rel = "/".join(parts[1:])
+                offset = int(q.get("offset", ["0"])[0])
+                length = int(
+                    q.get("length", [str(self.service.cache.block_size)])[0]
+                )
+                data = self.service.cache.read(rel, offset, length)
+                self._send(200, data, {"X-File-Size": str(self.service.cache.file_size(rel))})
+            elif parts[0] == "status":
+                c = self.service.cache
+                body = (
+                    f'{{"hits": {c.hits}, "misses": {c.misses}, '
+                    f'"spills": {c.spills}}}'
+                ).encode()
+                self._send(200, body, {"Content-Type": "application/json"})
+            else:
+                self._send(404, b"not found")
+        except (FileNotFoundError, PermissionError) as e:
+            self._send(404, str(e).encode())
+        except Exception as e:  # noqa: BLE001
+            self._send(500, str(e).encode())
+
+    def do_POST(self):
+        u = urllib.parse.urlparse(self.path)
+        parts = u.path.strip("/").split("/")
+        try:
+            if parts[0] == "prop" and len(parts) >= 3:
+                n = int(self.headers.get("Content-Length", "0"))
+                body = self.rfile.read(n)
+                pid, name = parts[1], "/".join(parts[2:])
+                ver = self.service.mailbox.set_prop(pid, name, body)
+                self._send(200, b"", {"X-Version": str(ver)})
+            else:
+                self._send(404, b"not found")
+        except Exception as e:  # noqa: BLE001
+            self._send(500, str(e).encode())
+
+
+class ProcessService:
+    """The per-node daemon: mailbox + file server on one HTTP port."""
+
+    def __init__(
+        self,
+        root: str,
+        port: int = 0,
+        spill_dir: Optional[str] = None,
+        memory_budget: int = 64 * 1024 * 1024,
+        block_size: int = DEFAULT_BLOCK,
+    ):
+        self.root = os.path.abspath(root)
+        self.mailbox = Mailbox()
+        self.cache = BlockCache(
+            self.root, spill_dir, memory_budget, block_size
+        )
+        handler = type("BoundHandler", (_Handler,), {"service": self})
+        self._httpd = http.server.ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="dryad-psvc", daemon=True
+        )
+        self._thread.start()
+        log.info("ProcessService on port %d root=%s", self.port, self.root)
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class ServiceClient:
+    """HTTP client for a remote ProcessService (HttpReader/ICluster side,
+    ``managedchannel/HttpReader.cs:78-110``)."""
+
+    def __init__(self, host: str, port: int):
+        self.host, self.port = host, port
+
+    def _conn(self, timeout: float = 30.0) -> http.client.HTTPConnection:
+        return http.client.HTTPConnection(self.host, self.port, timeout=timeout)
+
+    def set_prop(self, pid: str, name: str, value: bytes) -> int:
+        c = self._conn()
+        try:
+            c.request("POST", f"/prop/{pid}/{name}", body=value)
+            r = c.getresponse()
+            r.read()
+            if r.status != 200:
+                raise RuntimeError(f"set_prop failed: {r.status}")
+            return int(r.getheader("X-Version", "0"))
+        finally:
+            c.close()
+
+    def get_prop(
+        self, pid: str, name: str, after_version: int = 0, timeout: float = 0.0
+    ) -> Optional[Tuple[int, bytes]]:
+        # socket deadline must outlast the server-side long-poll window
+        c = self._conn(timeout=timeout + 30.0)
+        try:
+            c.request(
+                "GET",
+                f"/prop/{pid}/{name}?after={after_version}&timeout={timeout}",
+            )
+            r = c.getresponse()
+            body = r.read()
+            if r.status == 204:
+                return None
+            if r.status != 200:
+                raise RuntimeError(f"get_prop failed: {r.status} {body!r}")
+            return int(r.getheader("X-Version", "0")), body
+        finally:
+            c.close()
+
+    def read_file(self, rel: str, offset: int = 0, length: int = DEFAULT_BLOCK) -> bytes:
+        c = self._conn()
+        try:
+            c.request("GET", f"/file/{rel}?offset={offset}&length={length}")
+            r = c.getresponse()
+            body = r.read()
+            if r.status == 404:
+                raise FileNotFoundError(rel)
+            if r.status != 200:
+                raise RuntimeError(f"read_file failed: {r.status} {body!r}")
+            return body
+        finally:
+            c.close()
+
+    def read_whole_file(self, rel: str, chunk: int = DEFAULT_BLOCK) -> bytes:
+        """Stream a whole remote file by range reads."""
+        out = bytearray()
+        offset = 0
+        while True:
+            data = self.read_file(rel, offset, chunk)
+            out += data
+            offset += len(data)
+            if len(data) < chunk:
+                return bytes(out)

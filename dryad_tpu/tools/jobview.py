@@ -59,12 +59,13 @@ def split_jobs(events: List[Dict[str, Any]]) -> List[List[Dict[str, Any]]]:
     job_start (if any) join the first segment."""
     segments: List[List[Dict[str, Any]]] = []
     cur: List[Dict[str, Any]] = []
+    seen_start = False
     for ev in events:
-        if ev["kind"] == "job_start" and any(
-            e["kind"] == "job_start" for e in cur
-        ):
+        if ev["kind"] == "job_start" and seen_start:
             segments.append(cur)
             cur = []
+        if ev["kind"] == "job_start":
+            seen_start = True
         cur.append(ev)
     if cur:
         segments.append(cur)
@@ -160,7 +161,10 @@ def diagnose(job: JobInfo) -> List[str]:
                     f"stage {s.id} ({s.name}) did not complete before the "
                     f"job failed"
                 )
-        if s.overflows:
+        failed_by_overflow = (
+            not s.completed and job.failed and not s.failures and s.overflows
+        )
+        if s.overflows and not failed_by_overflow:
             out.append(
                 f"stage {s.id} ({s.name}) overflowed {s.overflows}x "
                 f"(final capacity boost {s.max_boost}x) — shuffle skew or "

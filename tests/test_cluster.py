@@ -1,0 +1,261 @@
+"""Cluster layer tests: locality scheduler, mailbox, file server, cache.
+
+Mirrors the reference's L3 semantics (SURVEY.md C13-C15): delay-based
+locality relaxation, hard constraints, elastic membership, versioned
+property long-poll, HTTP range reads, block cache spill.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from dryad_tpu.cluster.interfaces import (
+    Affinity,
+    ClusterProcess,
+    Computer,
+    ProcessState,
+)
+from dryad_tpu.cluster.scheduler import LocalScheduler
+from dryad_tpu.cluster.service import (
+    BlockCache,
+    ProcessService,
+    ServiceClient,
+)
+
+
+@pytest.fixture
+def sched():
+    s = LocalScheduler(
+        [
+            Computer("m0", "rackA", slots=1),
+            Computer("m1", "rackA", slots=1),
+            Computer("m2", "rackB", slots=1),
+        ],
+        rack_delay=0.1,
+        cluster_delay=0.25,
+    )
+    yield s
+    s.shutdown()
+
+
+def _proc(fn=None, **kw):
+    return ClusterProcess(fn or (lambda p: "ok"), **kw)
+
+
+class TestScheduler:
+    def test_runs_and_completes(self, sched):
+        p = _proc(lambda p: 41 + 1)
+        sched.schedule(p)
+        assert p.wait(5)
+        assert p.state is ProcessState.COMPLETED
+        assert p.result == 42
+
+    def test_failure_reported(self, sched):
+        def boom(p):
+            raise ValueError("nope")
+
+        p = _proc(boom)
+        sched.schedule(p)
+        assert p.wait(5)
+        assert p.state is ProcessState.FAILED
+        assert isinstance(p.error, ValueError)
+
+    def test_soft_affinity_prefers_computer(self, sched):
+        p = _proc(affinities=[Affinity("m1")])
+        sched.schedule(p)
+        assert p.wait(5)
+        assert p.computer == "m1"
+
+    def test_soft_affinity_relaxes_to_rack_then_cluster(self, sched):
+        # occupy m0 so a m0-affine process must relax
+        release = threading.Event()
+        blocker = _proc(lambda p: release.wait(10), affinities=[Affinity("m0", hard=True)])
+        sched.schedule(blocker)
+        t0 = time.monotonic()
+        p = _proc(affinities=[Affinity("m0")])
+        sched.schedule(p)
+        assert p.wait(5)
+        dt = time.monotonic() - t0
+        release.set()
+        # ran elsewhere, but only after the rack delay elapsed
+        assert p.computer in ("m1", "m2")
+        assert dt >= sched.rack_delay * 0.8
+
+    def test_hard_affinity_never_relaxes(self, sched):
+        release = threading.Event()
+        blocker = _proc(lambda p: release.wait(10), affinities=[Affinity("m2", hard=True)])
+        sched.schedule(blocker)
+        time.sleep(0.05)
+        p = _proc(affinities=[Affinity("m2", hard=True)])
+        sched.schedule(p)
+        assert not p.wait(0.6)  # well past cluster_delay, still queued
+        assert p.state is ProcessState.QUEUED
+        release.set()
+        assert p.wait(5)
+        assert p.computer == "m2"
+
+    def test_hard_rack_affinity(self, sched):
+        p = _proc(affinities=[Affinity("rackB", hard=True)])
+        sched.schedule(p)
+        assert p.wait(5)
+        assert p.computer == "m2"
+
+    def test_cancel_queued(self, sched):
+        release = threading.Event()
+        for name in ("m0", "m1", "m2"):
+            sched.schedule(
+                _proc(lambda p: release.wait(10), affinities=[Affinity(name, hard=True)])
+            )
+        p = _proc()
+        sched.schedule(p)
+        time.sleep(0.05)
+        sched.cancel(p)
+        release.set()
+        assert p.wait(5)
+        assert p.state is ProcessState.CANCELED
+
+    def test_elastic_membership(self):
+        s = LocalScheduler([], rack_delay=0.05, cluster_delay=0.1)
+        try:
+            p = _proc()
+            s.schedule(p)
+            assert not p.wait(0.2)  # no computers yet
+            got = []
+            t = threading.Thread(
+                target=lambda: got.append(s.wait_for_computers(1, 5))
+            )
+            t.start()
+            s.add_computer(Computer("late0", "rackZ"))
+            t.join(5)
+            assert got == [True]
+            assert p.wait(5)
+            assert p.state is ProcessState.COMPLETED
+        finally:
+            s.shutdown()
+
+    def test_state_watcher_sequence(self, sched):
+        seen = []
+        p = _proc()
+        p.on_state(lambda pr: seen.append(pr.state))
+        sched.schedule(p)
+        assert p.wait(5)
+        time.sleep(0.02)
+        assert seen[0] is ProcessState.QUEUED
+        assert ProcessState.RUNNING in seen
+        assert seen[-1] is ProcessState.COMPLETED
+
+
+class TestServiceAndCache:
+    def test_mailbox_versioned_long_poll(self, tmp_path):
+        with ProcessService(str(tmp_path)) as svc:
+            cl = ServiceClient("127.0.0.1", svc.port)
+            assert cl.get_prop("p1", "DVertexCommand") is None
+            v1 = cl.set_prop("p1", "DVertexCommand", b"Start")
+            assert v1 == 1
+            got = cl.get_prop("p1", "DVertexCommand")
+            assert got == (1, b"Start")
+            # long-poll: no newer version within timeout
+            t0 = time.monotonic()
+            assert cl.get_prop("p1", "DVertexCommand", after_version=1, timeout=0.2) is None
+            assert time.monotonic() - t0 >= 0.15
+            # a concurrent writer wakes the poller
+            def write_later():
+                time.sleep(0.1)
+                cl.set_prop("p1", "DVertexCommand", b"Terminate")
+
+            threading.Thread(target=write_later).start()
+            got = cl.get_prop("p1", "DVertexCommand", after_version=1, timeout=5)
+            assert got == (2, b"Terminate")
+
+    def test_file_range_reads(self, tmp_path):
+        payload = bytes(range(256)) * 1000
+        (tmp_path / "chan").mkdir()
+        (tmp_path / "chan" / "part0.bin").write_bytes(payload)
+        with ProcessService(str(tmp_path), block_size=4096) as svc:
+            cl = ServiceClient("127.0.0.1", svc.port)
+            assert cl.read_file("chan/part0.bin", 0, 16) == payload[:16]
+            assert cl.read_file("chan/part0.bin", 5000, 300) == payload[5000:5300]
+            assert cl.read_whole_file("chan/part0.bin", chunk=10000) == payload
+            with pytest.raises(FileNotFoundError):
+                cl.read_file("chan/missing.bin")
+            with pytest.raises(FileNotFoundError):
+                cl.read_file("../escape.bin")
+
+    def test_block_cache_hits_and_spill(self, tmp_path):
+        src = tmp_path / "data.bin"
+        payload = os.urandom(64 * 1024)
+        src.write_bytes(payload)
+        cache = BlockCache(
+            str(tmp_path),
+            spill_dir=str(tmp_path / "spill"),
+            memory_budget=8 * 1024,  # forces eviction
+            block_size=4 * 1024,
+        )
+        assert cache.read("data.bin", 0, len(payload)) == payload
+        assert cache.misses == 16
+        assert cache.spills > 0  # evictions spilled to disk
+        # re-read: some from memory, rest from spill files (not source)
+        os.rename(src, tmp_path / "data.hidden")
+        # only spilled/in-memory blocks are readable now
+        got = cache.read("data.bin", 0, 8 * 1024)
+        assert got == payload[: 8 * 1024]
+
+    def test_shutdown_cancels_queued(self):
+        """Regression: shutdown must give never-started work a terminal
+        state so wait() callers don't hang."""
+        s = LocalScheduler([], rack_delay=0.05, cluster_delay=0.1)
+        p = _proc()
+        s.schedule(p)
+        s.shutdown()
+        assert p.wait(2)
+        assert p.state is ProcessState.CANCELED
+
+    def test_cache_budget_stable_under_concurrent_misses(self, tmp_path):
+        """Regression: concurrent misses on one block must not
+        double-count _mem_bytes and shrink the effective budget."""
+        payload = os.urandom(32 * 1024)
+        (tmp_path / "d.bin").write_bytes(payload)
+        cache = BlockCache(str(tmp_path), memory_budget=1 << 20, block_size=4096)
+        errs = []
+
+        def reader():
+            try:
+                for _ in range(20):
+                    assert cache.read("d.bin", 0, len(payload)) == payload
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        ts = [threading.Thread(target=reader) for _ in range(8)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert not errs
+        assert cache._mem_bytes == sum(len(b) for b in cache._mem.values())
+
+    def test_cache_does_not_truncate_growing_file(self, tmp_path):
+        """Regression: a short tail block read mid-write must not be
+        cached (would permanently truncate the file for readers)."""
+        f = tmp_path / "grow.bin"
+        f.write_bytes(b"a" * 100)
+        cache = BlockCache(str(tmp_path), block_size=4096)
+        assert cache.read("grow.bin", 0, 4096) == b"a" * 100
+        with open(f, "ab") as fh:
+            fh.write(b"b" * 100)
+        assert cache.read("grow.bin", 0, 4096) == b"a" * 100 + b"b" * 100
+
+    def test_cache_status_endpoint(self, tmp_path):
+        (tmp_path / "f.bin").write_bytes(b"x" * 100)
+        with ProcessService(str(tmp_path), block_size=64) as svc:
+            cl = ServiceClient("127.0.0.1", svc.port)
+            cl.read_file("f.bin", 0, 10)
+            cl.read_file("f.bin", 0, 10)
+            import http.client as hc
+            import json
+
+            c = hc.HTTPConnection("127.0.0.1", svc.port)
+            c.request("GET", "/status")
+            stats = json.loads(c.getresponse().read())
+            c.close()
+            assert stats["hits"] >= 1
+            assert stats["misses"] >= 1
