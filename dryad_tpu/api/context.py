@@ -81,6 +81,7 @@ class DryadContext:
         self.platform = platform
         self.dictionary = StringDictionary()
         self._bindings: Dict[int, tuple] = {}
+        self._binding_fp_cache: Dict[int, Optional[str]] = {}
         if local_debug:
             self.mesh = None
             self.executor = None
@@ -214,12 +215,42 @@ class DryadContext:
             return D.shard_batch(ColumnBatch.concatenate(batches), self.mesh)
         raise RuntimeError(f"unknown binding kind {kind}")
 
+    def _binding_fp(self, node: Node):
+        """Content SHA-1 of a plan-input binding (checkpoint identity);
+        None for device-resident bindings, which can't be fingerprinted
+        without a host transfer.  Cached per input node."""
+        if node.id in self._binding_fp_cache:
+            return self._binding_fp_cache[node.id]
+        from dryad_tpu.exec.checkpoint import content_fingerprint
+
+        kind, *rest = self._bindings[node.id]
+        fp = None
+        if kind == "host":
+            arrays, cap = rest
+            fp = content_fingerprint({str(k): np.asarray(v) for k, v in arrays.items()}) + f":{cap}"
+        elif kind == "host_physical":
+            (phys,) = rest
+            fp = content_fingerprint(phys)
+        elif kind == "store":
+            parts, schema = rest
+            merged = {
+                f"p{i}/{c}": v for i, cols in enumerate(parts) for c, v in cols.items()
+            }
+            fp = content_fingerprint(merged)
+        self._binding_fp_cache[node.id] = fp
+        return fp
+
     def _execute_device(self, query: Query) -> ColumnBatch:
         graph = lower([query.node], self.config)
         bindings = {
             nid: self._bind_device(n) for nid, n in graph.inputs.items()
         }
-        results = self.executor.execute(graph, bindings)
+        binding_fps = None
+        if self.config.checkpoint_dir:
+            binding_fps = {
+                nid: self._binding_fp(n) for nid, n in graph.inputs.items()
+            }
+        results = self.executor.execute(graph, bindings, binding_fps)
         sid, oidx = graph.outputs[query.node.id]
         return results[(sid, oidx)]
 

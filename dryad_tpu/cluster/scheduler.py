@@ -130,7 +130,14 @@ class LocalScheduler(Scheduler):
                 for a in hard
             )
         age = time.monotonic() - entry.enqueued
-        if any(a.locality == comp.name for a in affs):
+        # the preferred locality itself is served immediately: an exact
+        # computer match, or a rack-level affinity naming this rack —
+        # delays only gate *relaxation* away from the preference
+        if any(
+            a.locality == comp.name
+            or (a.locality not in self._computers and a.locality == comp.rack)
+            for a in affs
+        ):
             return True
         if age >= self.rack_delay and any(
             self._rack_of(a.locality) == comp.rack for a in affs

@@ -102,11 +102,11 @@ class BlockCache:
             os.makedirs(spill_dir, exist_ok=True)
 
     def _source_path(self, rel: str) -> str:
-        path = os.path.normpath(os.path.join(self.root, rel))
-        if not path.startswith(os.path.abspath(self.root) + os.sep) and (
-            path != os.path.abspath(self.root)
-        ):
-            # normalize against traversal; root itself is not a file
+        # realpath resolves symlinks too, so a link under root pointing
+        # outside cannot bypass the containment check
+        path = os.path.realpath(os.path.join(self.root, rel))
+        root = os.path.realpath(self.root)
+        if not path.startswith(root + os.sep) and path != root:
             raise PermissionError(f"path escapes root: {rel}")
         return path
 
@@ -130,10 +130,12 @@ class BlockCache:
             old_key, old = self._mem.popitem(last=False)
             self._mem_bytes -= len(old)
             if self.spill_dir and old_key not in self._spilled:
-                sp = os.path.join(
-                    self.spill_dir,
-                    f"{abs(hash(old_key)):016x}.blk",
-                )
+                import hashlib
+
+                digest = hashlib.sha1(
+                    f"{old_key[0]}:{old_key[1]}".encode()
+                ).hexdigest()
+                sp = os.path.join(self.spill_dir, f"{digest}.blk")
                 with open(sp, "wb") as fh:
                     fh.write(old)
                 self._spilled[old_key] = sp

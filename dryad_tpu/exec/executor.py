@@ -29,6 +29,7 @@ import jax
 
 from dryad_tpu.columnar.batch import ColumnBatch
 from dryad_tpu.exec import faults
+from dryad_tpu.exec.checkpoint import CheckpointStore, stage_fingerprint
 from dryad_tpu.exec.events import EventLog
 from dryad_tpu.exec.kernels import build_stage_fn
 from dryad_tpu.exec.stats import StageStatistics
@@ -61,6 +62,11 @@ class GraphExecutor:
         self.stats: Dict[str, StageStatistics] = {}
         # Callback used by do_while stages to run body/cond subplans.
         self.subquery_runner = subquery_runner
+        self.checkpoints = (
+            CheckpointStore(self.config.checkpoint_dir)
+            if self.config.checkpoint_dir
+            else None
+        )
 
     # -- compilation cache -------------------------------------------------
     @staticmethod
@@ -107,18 +113,26 @@ class GraphExecutor:
         self,
         graph: StageGraph,
         bindings: Dict[int, ColumnBatch],
+        binding_fps: Optional[Dict[int, Optional[str]]] = None,
     ) -> Dict[Tuple[int, int], ColumnBatch]:
         """Run all stages; returns (stage_id, out_idx) -> output batch.
 
         ``bindings``: plan-input node id -> mesh-sharded global batch.
+        ``binding_fps``: plan-input node id -> content SHA-1 (or None if
+        the binding can't be fingerprinted) for checkpoint identity.
         """
         self.events.emit("job_start", stages=len(graph.stages))
         results: Dict[Tuple[int, int], ColumnBatch] = {}
+        # stage id -> Merkle fingerprint (None = not checkpointable)
+        stage_fps: Dict[int, Optional[str]] = {}
         for stage in graph.stages:
             if stage.ops and stage.ops[0].kind == "do_while":
+                stage_fps[stage.id] = None  # loop state is data-dependent
                 self._run_do_while(stage, graph, bindings, results)
                 continue
-            self._run_stage(stage, graph, bindings, results)
+            self._run_stage(
+                stage, graph, bindings, results, binding_fps or {}, stage_fps
+            )
         self.events.emit("job_complete")
         return results
 
@@ -142,9 +156,36 @@ class GraphExecutor:
         graph: StageGraph,
         bindings: Dict[int, ColumnBatch],
         results: Dict[Tuple[int, int], ColumnBatch],
+        binding_fps: Dict[int, Optional[str]] = {},
+        stage_fps: Dict[int, Optional[str]] = {},
     ) -> None:
         inputs = self._resolve_inputs(stage, bindings, results)
         shape_key = self._shape_key(inputs)
+        fp = None
+        if self.checkpoints is not None:
+            input_fps = tuple(
+                (
+                    binding_fps.get(idx)
+                    if ref == "plan_input"
+                    else (
+                        f"{stage_fps.get(ref)}:{idx}"
+                        if stage_fps.get(ref) is not None
+                        else None
+                    )
+                )
+                for ref, idx in stage.input_refs
+            )
+            fp = stage_fingerprint(stage, shape_key, input_fps)
+            stage_fps[stage.id] = fp
+            if fp is not None:
+                hit = self.checkpoints.load(stage, fp, self.mesh)
+                if hit is not None and len(hit) == len(stage.out_slots):
+                    self.events.emit(
+                        "stage_checkpoint_hit", stage=stage.id, name=stage.name
+                    )
+                    for i in range(len(stage.out_slots)):
+                        results[(stage.id, i)] = hit[i]
+                    return
         st = self.stats.setdefault(stage.name, StageStatistics(self.config.outlier_sigmas))
 
         boost = 1
@@ -203,6 +244,21 @@ class GraphExecutor:
             )
             for i, out_idx in enumerate(range(len(stage.out_slots))):
                 results[(stage.id, out_idx)] = outs[i]
+            if self.checkpoints is not None and fp is not None:
+                try:
+                    path = self.checkpoints.save(
+                        stage, fp, tuple(outs[: len(stage.out_slots)])
+                    )
+                    self.events.emit(
+                        "stage_checkpoint_saved", stage=stage.id,
+                        name=stage.name, path=path,
+                    )
+                except OSError as e:
+                    # the computation succeeded; a full/unwritable
+                    # checkpoint volume must not fail the job
+                    log.warning(
+                        "checkpoint save failed for %s: %s", stage.name, e
+                    )
             return
 
     def _run_do_while(

@@ -28,6 +28,7 @@ class StageInfo:
     name: str
     versions: int = 0
     completed: bool = False
+    from_checkpoint: bool = False
     failures: int = 0
     overflows: int = 0
     stragglers: int = 0
@@ -117,6 +118,10 @@ def _fold_job(events: List[Dict[str, Any]]) -> JobInfo:
             s = stage(ev)
             s.completed = True
             s.seconds += ev.get("seconds", 0.0)
+        elif kind == "stage_checkpoint_hit":
+            s = stage(ev)
+            s.completed = True
+            s.from_checkpoint = True
         elif kind == "stage_failed":
             s = stage(ev)
             s.failures += 1
@@ -182,6 +187,11 @@ def diagnose(job: JobInfo) -> List[str]:
                 f"stage {s.id} ({s.name}) recovered after {s.failures} "
                 f"failure(s) via versioned re-execution"
             )
+    n_ckpt = sum(1 for s in job.stages.values() if s.from_checkpoint)
+    if n_ckpt:
+        out.append(
+            f"{n_ckpt} stage(s) served from checkpoint (resumed run)"
+        )
     if job.completed and not job.failed and not out:
         out.append("job completed cleanly; no anomalies")
     return out
@@ -201,10 +211,12 @@ def render(job: JobInfo) -> str:
         f"{'slow':>4} {'secs':>8}  state"
     )
     for s in sorted(job.stages.values(), key=lambda s: s.id):
+        state = "NOT DONE"
+        if s.completed:
+            state = "ckpt" if s.from_checkpoint else "done"
         lines.append(
             f"{s.id:>4} {s.name[:40]:<40} {s.versions:>4} {s.failures:>4} "
-            f"{s.overflows:>4} {s.stragglers:>4} {s.seconds:>8.3f}  "
-            f"{'done' if s.completed else 'NOT DONE'}"
+            f"{s.overflows:>4} {s.stragglers:>4} {s.seconds:>8.3f}  {state}"
         )
     lines.append("-- diagnosis --")
     lines.extend("  " + d for d in diagnose(job))

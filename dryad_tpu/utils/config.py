@@ -79,6 +79,9 @@ class DryadConfig:
     materialize_at_shuffle: bool = False
     # Event log directory (Calypso analog); None disables.
     event_log_dir: Optional[str] = None
+    # Stage-output checkpoint directory (durable DCT_File channel
+    # analog, SURVEY §5.4); None disables checkpoint/resume.
+    checkpoint_dir: Optional[str] = None
     # Thread count for host-side IO (DRYAD_THREADS_PER_WORKER analog).
     io_threads: int = _env_int("DRYAD_TPU_IO_THREADS", 4)
     # Outlier threshold in sigmas for speculative duplication

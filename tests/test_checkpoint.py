@@ -1,0 +1,155 @@
+"""Checkpoint/resume tests: stage-boundary materialization (SURVEY §5.4)."""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from dryad_tpu import DryadConfig, DryadContext
+from dryad_tpu.exec.faults import clear_faults, set_fake_stage_failure
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    clear_faults()
+    yield
+    clear_faults()
+
+
+def _job(ctx):
+    q = ctx.from_arrays(
+        {"k": np.arange(1000, dtype=np.int32) % 13,
+         "v": np.ones(1000, np.float32)}
+    )
+    return q.group_by("k", {"s": ("sum", "v")}).order_by([("s", True)])
+
+
+def test_checkpoints_written_and_resumed(mesh8, tmp_path):
+    cdir = str(tmp_path / "ckpt")
+    ctx1 = DryadContext(num_partitions_=8, config=DryadConfig(checkpoint_dir=cdir))
+    out1 = _job(ctx1).collect()
+    saved = [e for e in ctx1.events.events() if e["kind"] == "stage_checkpoint_saved"]
+    assert saved, "expected checkpoints written"
+    assert glob.glob(os.path.join(cdir, "*-*"))
+
+    # resume in a fresh context (simulates a restarted driver process):
+    # the stage would now fail permanently, but the checkpoint skips it
+    ctx2 = DryadContext(num_partitions_=8, config=DryadConfig(checkpoint_dir=cdir))
+    set_fake_stage_failure("group_by", 99)
+    out2 = _job(ctx2).collect()
+    hits = [e for e in ctx2.events.events() if e["kind"] == "stage_checkpoint_hit"]
+    assert hits, "expected checkpoint hit on resume"
+    np.testing.assert_array_equal(out1["k"], out2["k"])
+    np.testing.assert_array_equal(out1["s"], out2["s"])
+
+
+def test_checkpoint_disabled_by_default(mesh8):
+    ctx = DryadContext(num_partitions_=8)
+    _job(ctx).collect()
+    kinds = [e["kind"] for e in ctx.events.events()]
+    assert "stage_checkpoint_saved" not in kinds
+
+
+def test_same_process_rerun_hits_checkpoint(mesh8, tmp_path):
+    """Re-submitting the same query in the same context must hit (the
+    identity is content-addressed, not job-ordinal-addressed)."""
+    cdir = str(tmp_path / "ckpt")
+    ctx = DryadContext(num_partitions_=8, config=DryadConfig(checkpoint_dir=cdir))
+    q = _job(ctx)
+    out1 = q.collect()
+    n_dirs = len(glob.glob(os.path.join(cdir, "*-*")))
+    out2 = q.collect()
+    assert [e for e in ctx.events.events() if e["kind"] == "stage_checkpoint_hit"]
+    # no duplicate checkpoint set is written for the rerun
+    assert len(glob.glob(os.path.join(cdir, "*-*"))) == n_dirs
+    np.testing.assert_array_equal(out1["s"], out2["s"])
+
+
+def test_changed_input_data_does_not_hit_stale_checkpoint(mesh8, tmp_path):
+    """Regression: same query shape over different same-shaped data must
+    recompute, not serve the previous data's results."""
+    cdir = str(tmp_path / "ckpt")
+
+    def run(values):
+        ctx = DryadContext(
+            num_partitions_=8, config=DryadConfig(checkpoint_dir=cdir)
+        )
+        q = ctx.from_arrays(
+            {"k": np.arange(1000, dtype=np.int32) % 13, "v": values}
+        ).group_by("k", {"s": ("sum", "v")})
+        return q.collect()
+
+    out1 = run(np.ones(1000, np.float32))
+    out2 = run(np.full(1000, 3.0, np.float32))  # same shape, new content
+    assert float(np.asarray(out1["s"]).sum()) == 1000.0
+    assert float(np.asarray(out2["s"]).sum()) == 3000.0
+
+
+def test_corrupt_checkpoint_recomputes(mesh8, tmp_path):
+    cdir = str(tmp_path / "ckpt")
+    ctx1 = DryadContext(num_partitions_=8, config=DryadConfig(checkpoint_dir=cdir))
+    out1 = _job(ctx1).collect()
+    for d in glob.glob(os.path.join(cdir, "*-*")):
+        for f in glob.glob(os.path.join(d, "*.dpf")):
+            with open(f, "wb") as fh:
+                fh.write(b"garbage")
+    ctx2 = DryadContext(num_partitions_=8, config=DryadConfig(checkpoint_dir=cdir))
+    out2 = _job(ctx2).collect()  # falls back to recompute
+    np.testing.assert_array_equal(out1["s"], out2["s"])
+
+
+def test_different_query_does_not_hit_same_checkpoint(mesh8, tmp_path):
+    cdir = str(tmp_path / "ckpt")
+    ctx1 = DryadContext(num_partitions_=8, config=DryadConfig(checkpoint_dir=cdir))
+    _job(ctx1).collect()
+    ctx2 = DryadContext(num_partitions_=8, config=DryadConfig(checkpoint_dir=cdir))
+    q = ctx2.from_arrays(
+        {"k": np.arange(1000, dtype=np.int32) % 7,  # different data shape-compatible
+         "v": np.full(1000, 2.0, np.float32)}
+    ).group_by("k", {"s": ("max", "v")})  # different aggs
+    out = q.collect()
+    assert len(out["k"]) == 7
+    assert float(np.asarray(out["s"]).max()) == 2.0
+
+
+def test_jobview_reports_checkpointed_stages(mesh8, tmp_path):
+    """A resumed job renders checkpoint-served stages as completed."""
+    from dryad_tpu.tools.jobview import build_job, diagnose, render
+
+    cdir = str(tmp_path / "ckpt")
+    ctx1 = DryadContext(num_partitions_=8, config=DryadConfig(checkpoint_dir=cdir))
+    _job(ctx1).collect()
+    ctx2 = DryadContext(num_partitions_=8, config=DryadConfig(checkpoint_dir=cdir))
+    _job(ctx2).collect()
+    job = build_job(ctx2.events.events())
+    assert job.ok
+    assert any(s.from_checkpoint for s in job.stages.values())
+    assert all(s.completed for s in job.stages.values())
+    assert "ckpt" in render(job)
+    assert any("served from checkpoint" in n for n in diagnose(job))
+
+
+def test_multi_output_fork_checkpoint(mesh8, tmp_path):
+    from dryad_tpu.columnar.schema import ColumnType, Schema
+
+    cdir = str(tmp_path / "ckpt")
+
+    def run(ctx):
+        q = ctx.from_arrays({"x": np.arange(64, dtype=np.int32)})
+        evens, odds = q.fork(
+            lambda b: (
+                b.filter((b["x"] % 2) == 0),
+                b.filter((b["x"] % 2) == 1),
+            ),
+            [Schema([("x", ColumnType.INT32)]), Schema([("x", ColumnType.INT32)])],
+        )
+        return evens.collect(), odds.collect()
+
+    ctx1 = DryadContext(num_partitions_=8, config=DryadConfig(checkpoint_dir=cdir))
+    e1, o1 = run(ctx1)
+    ctx2 = DryadContext(num_partitions_=8, config=DryadConfig(checkpoint_dir=cdir))
+    e2, o2 = run(ctx2)
+    assert [e for e in ctx2.events.events() if e["kind"] == "stage_checkpoint_hit"]
+    np.testing.assert_array_equal(sorted(e1["x"]), sorted(e2["x"]))
+    np.testing.assert_array_equal(sorted(o1["x"]), sorted(o2["x"]))

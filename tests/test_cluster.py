@@ -96,6 +96,16 @@ class TestScheduler:
         assert p.wait(5)
         assert p.computer == "m2"
 
+    def test_soft_rack_affinity_immediate(self, sched):
+        """Regression: a rack-level soft preference is the preferred
+        locality itself — no rack_delay wait when the rack is free."""
+        t0 = time.monotonic()
+        p = _proc(affinities=[Affinity("rackB")])
+        sched.schedule(p)
+        assert p.wait(5)
+        assert p.computer == "m2"
+        assert time.monotonic() - t0 < sched.rack_delay + 0.5
+
     def test_hard_rack_affinity(self, sched):
         p = _proc(affinities=[Affinity("rackB", hard=True)])
         sched.schedule(p)
@@ -182,6 +192,18 @@ class TestServiceAndCache:
                 cl.read_file("chan/missing.bin")
             with pytest.raises(FileNotFoundError):
                 cl.read_file("../escape.bin")
+
+    def test_symlink_escape_blocked(self, tmp_path):
+        outside = tmp_path / "outside"
+        outside.mkdir()
+        (outside / "secret.txt").write_bytes(b"secret")
+        root = tmp_path / "root"
+        root.mkdir()
+        os.symlink(str(outside), str(root / "link"))
+        with ProcessService(str(root)) as svc:
+            cl = ServiceClient("127.0.0.1", svc.port)
+            with pytest.raises(FileNotFoundError):
+                cl.read_file("link/secret.txt")
 
     def test_block_cache_hits_and_spill(self, tmp_path):
         src = tmp_path / "data.bin"
