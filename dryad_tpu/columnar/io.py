@@ -92,10 +92,12 @@ def write_store(
     if dictionary is not None:
         with open(os.path.join(path, DICTFILE), "w") as fh:
             json.dump({format(h, "016x"): s for h, s in dictionary.items()}, fh)
+    # Native writer compresses columns on a thread pool when available
+    # (falls back to write_partition_file).
+    from dryad_tpu.runtime.bindings import write_partition
+
     for i, cols in enumerate(partitions):
-        write_partition_file(
-            os.path.join(path, _part_name(i)), cols, compression
-        )
+        write_partition(os.path.join(path, _part_name(i)), cols, compression)
 
 
 def read_store(

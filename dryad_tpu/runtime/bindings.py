@@ -72,6 +72,43 @@ def _load() -> Optional[ctypes.CDLL]:
         ]
         lib.dn_channel_close.restype = None
         lib.dn_channel_close.argtypes = [ctypes.c_void_p]
+        lib.dn_write_partition.restype = ctypes.c_int32
+        lib.dn_write_partition.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_uint64, ctypes.c_int32,
+        ]
+        lib.dn_fifo_create.restype = ctypes.c_void_p
+        lib.dn_fifo_create.argtypes = [ctypes.c_size_t]
+        lib.dn_fifo_push.restype = ctypes.c_int32
+        lib.dn_fifo_push.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+        ]
+        lib.dn_fifo_pop.restype = ctypes.c_int64
+        lib.dn_fifo_pop.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ]
+        lib.dn_fifo_close.restype = None
+        lib.dn_fifo_close.argtypes = [ctypes.c_void_p]
+        lib.dn_fifo_destroy.restype = None
+        lib.dn_fifo_destroy.argtypes = [ctypes.c_void_p]
+        lib.dn_tlv_encode.restype = ctypes.c_size_t
+        lib.dn_tlv_encode.argtypes = [
+            ctypes.c_size_t, ctypes.POINTER(ctypes.c_uint16),
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_char_p, ctypes.c_size_t,
+        ]
+        lib.dn_tlv_encoded_size.restype = ctypes.c_size_t
+        lib.dn_tlv_encoded_size.argtypes = [
+            ctypes.c_size_t, ctypes.POINTER(ctypes.c_uint32),
+        ]
+        lib.dn_tlv_decode.restype = ctypes.c_size_t
+        lib.dn_tlv_decode.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint16), ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint32),
+        ]
         _lib = lib
         log.info("native runtime loaded from %s", _LIB_PATH)
         return _lib
@@ -140,6 +177,171 @@ def tokenize(
         np.array(starts_l, np.uint64),
         np.array([len(t) for t in tokens], np.uint32),
     )
+
+
+def write_partition(
+    path: str, cols: "dict[str, np.ndarray]", compression: Optional[str] = None
+) -> None:
+    """Write one ``.dpf`` partition file (format: ``columnar/io.py``).
+
+    Native path compresses columns concurrently on a thread pool (the
+    async channel-writer analog); falls back to the Python writer.
+    """
+    lib = _load()
+    if lib is None:
+        from dryad_tpu.columnar import io as cio
+
+        cio.write_partition_file(path, cols, compression)
+        return
+    names = list(cols.keys())
+    arrays = [np.ascontiguousarray(cols[n]) for n in names]
+    rows = len(arrays[0]) if arrays else 0
+    name_arr = (ctypes.c_char_p * len(names))(*[n.encode() for n in names])
+    dt_arr = (ctypes.c_char_p * len(names))(
+        *[str(a.dtype).encode() for a in arrays]
+    )
+    buf_arr = (ctypes.c_void_p * len(names))(
+        *[a.ctypes.data_as(ctypes.c_void_p).value for a in arrays]
+    )
+    len_arr = (ctypes.c_uint64 * len(names))(*[a.nbytes for a in arrays])
+    level = 6 if (compression or "none") == "zlib" else -1
+    rc = lib.dn_write_partition(
+        path.encode(), len(names), name_arr, dt_arr, buf_arr, len_arr,
+        rows, level,
+    )
+    if rc != 0:
+        raise IOError(f"native partition write failed rc={rc} path={path}")
+
+
+class Fifo:
+    """Bounded blocking byte-block queue (reference RChannelFifo,
+    ``channelfifo.h:31-136``): the in-process channel between pipelined
+    producer/consumer threads, with latch flow control.
+
+    Semantics (both backends): ``push`` blocks while full, returns False
+    once closed; ``pop`` blocks until a block or close, then returns
+    None at end-of-stream (repeatably); ``close`` never blocks.
+    """
+
+    def __init__(self, depth: int = 4):
+        self._lib = _load()
+        # The native pop hands out a pointer into a buffer owned by the
+        # channel that is only valid until the next pop — serialize
+        # pop+copy so concurrent consumers can't invalidate it.
+        self._pop_lock = threading.Lock()
+        if self._lib is not None:
+            self._handle = self._lib.dn_fifo_create(depth)
+        else:
+            self._handle = None
+            self._depth = max(1, depth)
+            self._deque: List[bytes] = []
+            self._closed = False
+            self._cv = threading.Condition()
+
+    def push(self, data: bytes) -> bool:
+        if self._handle is not None:
+            return self._lib.dn_fifo_push(self._handle, data, len(data)) == 0
+        with self._cv:
+            while not self._closed and len(self._deque) >= self._depth:
+                self._cv.wait()
+            if self._closed:
+                return False
+            self._deque.append(data)
+            self._cv.notify_all()
+            return True
+
+    def pop(self) -> Optional[bytes]:
+        """Next block, or None at end of stream (writer closed + drained)."""
+        if self._handle is not None:
+            with self._pop_lock:
+                ptr = ctypes.POINTER(ctypes.c_uint8)()
+                n = self._lib.dn_fifo_pop(self._handle, ctypes.byref(ptr))
+                if n < 0:
+                    return None
+                return ctypes.string_at(ptr, n)
+        with self._cv:
+            while not self._closed and not self._deque:
+                self._cv.wait()
+            if not self._deque:
+                return None
+            item = self._deque.pop(0)
+            self._cv.notify_all()
+            return item
+
+    def close(self) -> None:
+        if self._handle is not None:
+            self._lib.dn_fifo_close(self._handle)
+            return
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    def destroy(self) -> None:
+        if self._handle is not None:
+            self._lib.dn_fifo_destroy(self._handle)
+            self._handle = None
+
+
+def tlv_encode(entries: List[Tuple[int, bytes]]) -> bytes:
+    """Encode (tag, value) pairs as the TLV property wire format
+    (reference property blocks, ``gang/DrProperty.cpp``):
+    tag u16 LE + len u32 LE + value."""
+    for tag, val in entries:
+        if not 0 <= tag <= 0xFFFF:
+            raise ValueError(f"TLV tag {tag} outside u16 range")
+        if len(val) > 0xFFFFFFFF:
+            raise ValueError("TLV value exceeds u32 length")
+    lib = _load()
+    if lib is not None and entries:
+        tags = (ctypes.c_uint16 * len(entries))(*[t for t, _ in entries])
+        vals = [v for _, v in entries]
+        lens = (ctypes.c_uint32 * len(entries))(*[len(v) for v in vals])
+        ptrs = (ctypes.c_void_p * len(entries))(
+            *[ctypes.cast(ctypes.c_char_p(v), ctypes.c_void_p).value
+              for v in vals]
+        )
+        size = lib.dn_tlv_encoded_size(len(entries), lens)
+        out = ctypes.create_string_buffer(size)
+        got = lib.dn_tlv_encode(len(entries), tags, ptrs, lens, out, size)
+        if got != size:
+            raise ValueError("tlv encode overflow")
+        return out.raw
+    import struct
+
+    parts = []
+    for tag, val in entries:
+        parts.append(struct.pack("<HI", tag, len(val)))
+        parts.append(val)
+    return b"".join(parts)
+
+
+def tlv_decode(buf: bytes) -> List[Tuple[int, bytes]]:
+    """Decode a TLV property block; raises ValueError on malformed input."""
+    lib = _load()
+    if lib is not None and buf:
+        max_n = max(1, len(buf) // 6)
+        tags = (ctypes.c_uint16 * max_n)()
+        offs = (ctypes.c_uint64 * max_n)()
+        lens = (ctypes.c_uint32 * max_n)()
+        n = lib.dn_tlv_decode(buf, len(buf), max_n, tags, offs, lens)
+        if n == ctypes.c_size_t(-1).value:
+            raise ValueError("malformed TLV block")
+        return [
+            (int(tags[i]), buf[offs[i] : offs[i] + lens[i]]) for i in range(n)
+        ]
+    import struct
+
+    out = []
+    at = 0
+    while at < len(buf):
+        if at + 6 > len(buf):
+            raise ValueError("malformed TLV block")
+        tag, ln = struct.unpack_from("<HI", buf, at)
+        if at + 6 + ln > len(buf):
+            raise ValueError("malformed TLV block")
+        out.append((tag, buf[at + 6 : at + 6 + ln]))
+        at += 6 + ln
+    return out
 
 
 class PrefetchChannel:

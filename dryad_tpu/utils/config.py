@@ -94,6 +94,9 @@ class DryadConfig:
     # DrDynamicBroadcast.h:23, made trace-time from static capacities).
     broadcast_limit: int = _env_int("DRYAD_TPU_BROADCAST_LIMIT", 1 << 16)
 
+    def __post_init__(self) -> None:
+        self.validate()
+
     def validate(self) -> None:
         if self.partition_count < 1:
             raise ValueError("partition_count must be >= 1")

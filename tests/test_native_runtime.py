@@ -82,3 +82,85 @@ def test_compressed_store_roundtrip(tmp_path, mesh8):
     back = DryadContext(num_partitions_=8).from_store(path).collect()
     assert sorted(back["w"]) == sorted(tbl["w"])
     assert sorted(back["v"].tolist()) == sorted(tbl["v"].tolist())
+
+
+def test_native_write_partition_matches_python(tmp_path):
+    from dryad_tpu.columnar import io as cio
+
+    cols = {
+        "a": np.arange(1000, dtype=np.int32),
+        "b": np.linspace(0, 1, 1000).astype(np.float32),
+    }
+    for comp in (None, "zlib"):
+        p_native = str(tmp_path / f"n_{comp}.dpf")
+        p_python = str(tmp_path / f"p_{comp}.dpf")
+        B.write_partition(p_native, cols, comp)
+        cio.write_partition_file(p_python, cols, comp)
+        got_n = cio.read_partition_file(p_native)
+        got_p = cio.read_partition_file(p_python)
+        for k in cols:
+            np.testing.assert_array_equal(got_n[k], cols[k])
+            np.testing.assert_array_equal(got_p[k], got_n[k])
+
+
+def test_fifo_pipelined_producer_consumer():
+    import threading
+
+    f = B.Fifo(depth=2)
+    blocks = [bytes([i]) * (i + 1) for i in range(50)]
+
+    def produce():
+        for b in blocks:
+            f.push(b)
+        f.close()
+
+    t = threading.Thread(target=produce)
+    t.start()
+    got = []
+    while True:
+        b = f.pop()
+        if b is None:
+            break
+        got.append(b)
+    t.join()
+    f.destroy()
+    assert got == blocks
+
+
+def test_tlv_roundtrip_and_malformed():
+    entries = [(1, b"hello"), (42, b""), (65535, bytes(range(256)))]
+    buf = B.tlv_encode(entries)
+    assert B.tlv_decode(buf) == entries
+    assert B.tlv_decode(b"") == []
+    with pytest.raises(ValueError):
+        B.tlv_decode(buf[:-1])
+    with pytest.raises(ValueError):
+        B.tlv_decode(b"\x01\x00")
+
+
+def test_write_partition_escapes_column_names(tmp_path):
+    from dryad_tpu.columnar import io as cio
+
+    cols = {'a"b\\c': np.arange(10, dtype=np.int32)}
+    p = str(tmp_path / "esc.dpf")
+    B.write_partition(p, cols, "zlib")
+    got = cio.read_partition_file(p)
+    np.testing.assert_array_equal(got['a"b\\c'], cols['a"b\\c'])
+
+
+def test_fifo_closed_semantics():
+    f = B.Fifo(depth=2)
+    f.push(b"x")
+    f.close()
+    assert f.push(b"y") is False
+    assert f.pop() == b"x"
+    assert f.pop() is None
+    assert f.pop() is None  # repeatable end-of-stream
+    f.destroy()
+
+
+def test_tlv_tag_range_checked():
+    with pytest.raises(ValueError):
+        B.tlv_encode([(0x10000, b"x")])
+    with pytest.raises(ValueError):
+        B.tlv_encode([(-1, b"x")])
