@@ -74,6 +74,8 @@ class DryadContext:
         config: Optional[DryadConfig] = None,
         local_debug: bool = False,
         platform: PlatformKind = PlatformKind.AUTO,
+        dcn_slices: Optional[int] = None,
+        mesh=None,
     ):
         self.config = config or DryadConfig()
         self.config.validate()
@@ -87,7 +89,29 @@ class DryadContext:
             self.executor = None
             self.events = EventLog(None)
         else:
-            self.mesh = make_mesh(num_partitions_)
+            if mesh is not None:
+                self.mesh = mesh
+            elif dcn_slices is not None:
+                # Hybrid multi-slice mesh: inner axis over ICI, outer
+                # over DCN (reference machine→pod hierarchy).
+                from dryad_tpu.parallel.mesh import make_hybrid_mesh
+
+                if (
+                    num_partitions_ is not None
+                    and num_partitions_ % dcn_slices != 0
+                ):
+                    raise ValueError(
+                        f"num_partitions_ {num_partitions_} not divisible "
+                        f"by dcn_slices {dcn_slices}"
+                    )
+                ici = (
+                    num_partitions_ // dcn_slices
+                    if num_partitions_ is not None
+                    else None
+                )
+                self.mesh = make_hybrid_mesh(dcn_slices, ici)
+            else:
+                self.mesh = make_mesh(num_partitions_)
             path = None
             if self.config.event_log_dir:
                 path = os.path.join(

@@ -33,7 +33,7 @@ from dryad_tpu.exec.checkpoint import CheckpointStore, stage_fingerprint
 from dryad_tpu.exec.events import EventLog
 from dryad_tpu.exec.kernels import build_stage_fn
 from dryad_tpu.exec.stats import StageStatistics
-from dryad_tpu.parallel.mesh import num_partitions
+from dryad_tpu.parallel.mesh import mesh_axes, num_partitions
 from dryad_tpu.parallel.stage import compile_stage
 from dryad_tpu.plan.lower import Stage, StageGraph
 from dryad_tpu.utils.config import DryadConfig
@@ -97,7 +97,8 @@ class GraphExecutor:
         key = (self._stage_key(stage), boost, shape_key)
         hit = self._compiled.get(key)
         if hit is None:
-            fn = build_stage_fn(stage, self.P, self.config.shuffle_slack, boost)
+            fn = build_stage_fn(stage, self.P, self.config.shuffle_slack, boost,
+                                mesh_axes(self.mesh))
             hit = compile_stage(self.mesh, fn)
             self._compiled[key] = hit
         return hit

@@ -32,8 +32,10 @@ def _round8(n: float) -> int:
 class StageContext:
     """Mutable trace-time state while composing one stage function."""
 
-    def __init__(self, P: int, slack: float, boost: int):
+    def __init__(self, P: int, slack: float, boost: int,
+                 axes: Tuple[str, ...] = (AXIS,)):
         self.P = P
+        self.axes = axes
         self.slack = slack
         self.boost = boost
         self.slots: Dict[int, ColumnBatch] = {}
@@ -101,7 +103,7 @@ def _k_select_many(ctx: StageContext, p) -> None:
 def _k_apply(ctx: StageContext, p) -> None:
     b = ctx.slots[p["slot"]]
     if p.get("with_index"):
-        out = p["fn"](b, jax.lax.axis_index(AXIS))
+        out = p["fn"](b, jax.lax.axis_index(ctx.axes))
     else:
         out = p["fn"](b)
     if not isinstance(out, ColumnBatch):
@@ -115,7 +117,7 @@ def _do_exchange_hash(ctx: StageContext, slot: int, keys) -> None:
     b = ctx.slots[slot]
     dest = partition_ids([b.data[k] for k in keys], ctx.P)
     B = SH.bucket_capacity(b.capacity, ctx.P, ctx.slack * ctx.boost)
-    out, ovf = SH.exchange(b, dest, ctx.P, B, AXIS)
+    out, ovf = SH.exchange(b, dest, ctx.P, B, ctx.axes)
     ctx.slots[slot] = out
     ctx.overflow = ctx.overflow | ovf
 
@@ -136,10 +138,10 @@ def _k_exchange_range(ctx: StageContext, p) -> None:
     b = ctx.slots[p["slot"]]
     operands = p["operands_fn"](b)
     m = min(128, max(16, b.capacity // 8))
-    splitters = SORT.sample_splitters(operands[0], b.valid, ctx.P, m, AXIS)
+    splitters = SORT.sample_splitters(operands[0], b.valid, ctx.P, m, ctx.axes)
     dest = SORT.range_dest(operands[0], splitters)
     B = SH.bucket_capacity(b.capacity, ctx.P, ctx.slack * ctx.boost)
-    out, ovf = SH.exchange(b, dest, ctx.P, B, AXIS)
+    out, ovf = SH.exchange(b, dest, ctx.P, B, ctx.axes)
     ctx.slots[p["slot"]] = out
     ctx.overflow = ctx.overflow | ovf
 
@@ -178,13 +180,13 @@ def _k_local_sort(ctx: StageContext, p) -> None:
 
 # -- multi-input -----------------------------------------------------------
 
-def _gather_all(b: ColumnBatch) -> ColumnBatch:
+def _gather_all(b: ColumnBatch, axes: Tuple[str, ...]) -> ColumnBatch:
     """Replicate a batch to every partition (the broadcast copy-tree of
     ``DrDynamicBroadcast.h:23`` as one ``all_gather`` over ICI)."""
     data = {
-        n: jax.lax.all_gather(c, AXIS, tiled=True) for n, c in b.data.items()
+        n: jax.lax.all_gather(c, axes, tiled=True) for n, c in b.data.items()
     }
-    return ColumnBatch(data, jax.lax.all_gather(b.valid, AXIS, tiled=True))
+    return ColumnBatch(data, jax.lax.all_gather(b.valid, axes, tiled=True))
 
 
 def _join_strategy(ctx: StageContext, p, right: ColumnBatch) -> bool:
@@ -224,7 +226,7 @@ def _apply_join_strategy(ctx: StageContext, p) -> int:
     )
     if "strategy" in p:
         if _join_strategy(ctx, p, ctx.slots[p["right_slot"]]):
-            ctx.slots[p["right_slot"]] = _gather_all(ctx.slots[p["right_slot"]])
+            ctx.slots[p["right_slot"]] = _gather_all(ctx.slots[p["right_slot"]], ctx.axes)
         else:
             _co_partition_for_join(ctx, p)
             base = max(
@@ -285,16 +287,16 @@ def _k_group_join_count(ctx: StageContext, p) -> None:
     ctx.overflow = ctx.overflow | ovf
 
 
-def _rank_column(b: ColumnBatch, P: int) -> Tuple[ColumnBatch, jax.Array]:
+def _rank_column(b: ColumnBatch, P: int, axes: Tuple[str, ...]) -> Tuple[ColumnBatch, jax.Array]:
     """Compact and attach each valid row's global rank (partition-major)."""
     c = b.compact()
     local = jnp.sum(c.valid.astype(jnp.int32))
-    counts = jax.lax.all_gather(local, AXIS)
-    me = jax.lax.axis_index(AXIS)
+    counts = jax.lax.all_gather(local, axes)
+    me = jax.lax.axis_index(axes)
     offset = jnp.sum(jnp.where(jnp.arange(P) < me, counts, 0))
     rank = (offset + jnp.arange(c.capacity, dtype=jnp.int32)).astype(jnp.uint32)
     rank = jnp.where(c.valid, rank, jnp.uint32(0xFFFFFFFF))
-    total = jax.lax.psum(local, AXIS)
+    total = jax.lax.psum(local, axes)
     return ColumnBatch(dict(c.data, **{"#rank": rank}), c.valid), total
 
 
@@ -306,7 +308,7 @@ def _exchange_by_rank(
     rank = b.data["#rank"].astype(jnp.int32)
     dest = jnp.clip(rank // per, 0, ctx.P - 1)
     B = SH.bucket_capacity(b.capacity, ctx.P, ctx.slack * ctx.boost)
-    out, ovf = SH.exchange(b, dest, ctx.P, B, AXIS)
+    out, ovf = SH.exchange(b, dest, ctx.P, B, ctx.axes)
     ctx.overflow = ctx.overflow | ovf
     out, ovf2 = SH.resize(out, per)
     ctx.overflow = ctx.overflow | ovf2
@@ -319,8 +321,8 @@ def _k_zip(ctx: StageContext, p) -> None:
     left = ctx.slots[p["left_slot"]]
     right = ctx.slots[p["right_slot"]]
     per = _round8(max(ctx.base_cap(p["left_slot"]), ctx.base_cap(p["right_slot"])) * ctx.boost)
-    lb, _lt = _rank_column(left, ctx.P)
-    rb, _rt = _rank_column(right, ctx.P)
+    lb, _lt = _rank_column(left, ctx.P, ctx.axes)
+    rb, _rt = _rank_column(right, ctx.P, ctx.axes)
     la = _exchange_by_rank(ctx, lb, per)
     ra = _exchange_by_rank(ctx, rb, per)
     data: Dict[str, jax.Array] = {
@@ -347,7 +349,7 @@ def _k_sliding_window(ctx: StageContext, p) -> None:
     ext_len = cap + w - 1
     out_cols: Dict[str, jax.Array] = {}
     # Halo of validity first (same construction as data columns).
-    halo_v = jax.lax.ppermute(b.valid[: w - 1], AXIS, perm) if w > 1 else None
+    halo_v = jax.lax.ppermute(b.valid[: w - 1], ctx.axes, perm) if w > 1 else None
     ext_v = jnp.zeros((ext_len,), jnp.bool_)
     ext_v = jax.lax.dynamic_update_slice(ext_v, b.valid, (0,))
     if w > 1:
@@ -361,7 +363,7 @@ def _k_sliding_window(ctx: StageContext, p) -> None:
 
     for c in p["cols"]:
         col = b.data[c]
-        halo = jax.lax.ppermute(col[: w - 1], AXIS, perm) if w > 1 else None
+        halo = jax.lax.ppermute(col[: w - 1], ctx.axes, perm) if w > 1 else None
         ext = jnp.zeros((ext_len,), col.dtype)
         ext = jax.lax.dynamic_update_slice(ext, col, (0,))
         if w > 1:
@@ -381,7 +383,7 @@ def _strip_rank(b: ColumnBatch, keep: jax.Array) -> ColumnBatch:
 
 
 def _k_take(ctx: StageContext, p) -> None:
-    b, _total = _rank_column(ctx.slots[p["slot"]], ctx.P)
+    b, _total = _rank_column(ctx.slots[p["slot"]], ctx.P, ctx.axes)
     rank = b.data["#rank"]
     keep = b.valid & (rank < jnp.uint32(p["n"]))
     ctx.slots[p["slot"]] = _strip_rank(b, keep)
@@ -389,7 +391,7 @@ def _k_take(ctx: StageContext, p) -> None:
 
 def _k_skip(ctx: StageContext, p) -> None:
     """Drop the first n rows of global engine order (reference Skip)."""
-    b, _total = _rank_column(ctx.slots[p["slot"]], ctx.P)
+    b, _total = _rank_column(ctx.slots[p["slot"]], ctx.P, ctx.axes)
     keep = b.valid & (b.data["#rank"] >= jnp.uint32(p["n"]))
     ctx.slots[p["slot"]] = _strip_rank(b, keep)
 
@@ -397,14 +399,14 @@ def _k_skip(ctx: StageContext, p) -> None:
 def _k_tail(ctx: StageContext, p) -> None:
     """Keep the last n rows of global engine order (Last/TakeLast shape,
     reference Last/LastOrDefault dispatch ``DryadLinqQueryGen.cs``)."""
-    b, total = _rank_column(ctx.slots[p["slot"]], ctx.P)
+    b, total = _rank_column(ctx.slots[p["slot"]], ctx.P, ctx.axes)
     cut = jnp.maximum(total - jnp.int32(p["n"]), 0).astype(jnp.uint32)
     keep = b.valid & (b.data["#rank"] >= cut)
     ctx.slots[p["slot"]] = _strip_rank(b, keep)
 
 
 def _first_false_rank(
-    b: ColumnBatch, pred: jax.Array, total: jax.Array
+    b: ColumnBatch, pred: jax.Array, total: jax.Array, axes: Tuple[str, ...]
 ) -> jax.Array:
     """Global rank of the first valid row failing ``pred`` (= total if
     every row passes)."""
@@ -413,24 +415,24 @@ def _first_false_rank(
         b.valid & jnp.logical_not(pred), rank, jnp.uint32(0xFFFFFFFF)
     )
     local_min = jnp.min(failing)
-    global_min = jax.lax.pmin(local_min, AXIS)
+    global_min = jax.lax.pmin(local_min, axes)
     return jnp.minimum(global_min, total.astype(jnp.uint32))
 
 
 def _k_take_while(ctx: StageContext, p) -> None:
     """Rows strictly before the first predicate failure (TakeWhile)."""
-    b, total = _rank_column(ctx.slots[p["slot"]], ctx.P)
+    b, total = _rank_column(ctx.slots[p["slot"]], ctx.P, ctx.axes)
     pred = p["fn"]({n: c for n, c in b.data.items() if n != "#rank"})
-    cut = _first_false_rank(b, pred, total)
+    cut = _first_false_rank(b, pred, total, ctx.axes)
     keep = b.valid & (b.data["#rank"] < cut)
     ctx.slots[p["slot"]] = _strip_rank(b, keep)
 
 
 def _k_skip_while(ctx: StageContext, p) -> None:
     """Rows from the first predicate failure onward (SkipWhile)."""
-    b, total = _rank_column(ctx.slots[p["slot"]], ctx.P)
+    b, total = _rank_column(ctx.slots[p["slot"]], ctx.P, ctx.axes)
     pred = p["fn"]({n: c for n, c in b.data.items() if n != "#rank"})
-    cut = _first_false_rank(b, pred, total)
+    cut = _first_false_rank(b, pred, total, ctx.axes)
     keep = b.valid & (b.data["#rank"] >= cut)
     ctx.slots[p["slot"]] = _strip_rank(b, keep)
 
@@ -439,7 +441,7 @@ def _k_reverse(ctx: StageContext, p) -> None:
     """Globally reverse engine row order (reference Reverse,
     ``DryadLinqQueryGen.cs:2731``): invert each row's global rank and
     repartition by the inverted rank."""
-    b, total = _rank_column(ctx.slots[p["slot"]], ctx.P)
+    b, total = _rank_column(ctx.slots[p["slot"]], ctx.P, ctx.axes)
     inv = (total.astype(jnp.uint32) - jnp.uint32(1)) - b.data["#rank"]
     inv = jnp.where(b.valid, inv, jnp.uint32(0xFFFFFFFF))
     b = ColumnBatch(dict(b.data, **{"#rank": inv}), b.valid)
@@ -452,8 +454,8 @@ def _k_default_if_empty(ctx: StageContext, p) -> None:
     """If the table is globally empty, emit one default row on partition
     0 (reference DefaultIfEmpty)."""
     b = ctx.slots[p["slot"]].compact()
-    total = jax.lax.psum(jnp.sum(b.valid.astype(jnp.int32)), AXIS)
-    me = jax.lax.axis_index(AXIS)
+    total = jax.lax.psum(jnp.sum(b.valid.astype(jnp.int32)), ctx.axes)
+    me = jax.lax.axis_index(ctx.axes)
     emit = (total == 0) & (me == 0)
     data = {}
     for name, col in b.data.items():
@@ -472,37 +474,37 @@ def _k_scalar_agg(ctx: StageContext, p) -> None:
     for a in p["aggs"]:
         if a.op == "count":
             loc = jnp.sum(v.astype(jnp.int32))
-            out[a.out] = jax.lax.psum(loc, AXIS)[None]
+            out[a.out] = jax.lax.psum(loc, ctx.axes)[None]
         elif a.op == "sum":
             col = b.data[a.col]
             loc = jnp.sum(jnp.where(v, col, jnp.zeros((), col.dtype)))
-            out[a.out] = jax.lax.psum(loc, AXIS)[None]
+            out[a.out] = jax.lax.psum(loc, ctx.axes)[None]
         elif a.op == "min":
             col = b.data[a.col]
             big = _dtype_max(col.dtype)
             loc = jnp.min(jnp.where(v, col, big))
-            out[a.out] = jax.lax.pmin(loc, AXIS)[None]
+            out[a.out] = jax.lax.pmin(loc, ctx.axes)[None]
         elif a.op == "max":
             col = b.data[a.col]
             small = _dtype_min(col.dtype)
             loc = jnp.max(jnp.where(v, col, small))
-            out[a.out] = jax.lax.pmax(loc, AXIS)[None]
+            out[a.out] = jax.lax.pmax(loc, ctx.axes)[None]
         elif a.op == "mean":
             col = b.data[a.col].astype(jnp.float32)
-            s = jax.lax.psum(jnp.sum(jnp.where(v, col, 0.0)), AXIS)
-            c = jax.lax.psum(jnp.sum(v.astype(jnp.float32)), AXIS)
+            s = jax.lax.psum(jnp.sum(jnp.where(v, col, 0.0)), ctx.axes)
+            c = jax.lax.psum(jnp.sum(v.astype(jnp.float32)), ctx.axes)
             out[a.out] = (s / jnp.maximum(c, 1.0))[None]
         elif a.op == "any":
             col = b.data[a.col]
             loc = jnp.any(v & col).astype(jnp.int32)
-            out[a.out] = (jax.lax.psum(loc, AXIS) > 0)[None]
+            out[a.out] = (jax.lax.psum(loc, ctx.axes) > 0)[None]
         elif a.op == "all":
             col = b.data[a.col]
             loc = jnp.all(jnp.where(v, col, True)).astype(jnp.int32)
-            out[a.out] = (jax.lax.psum(loc, AXIS) >= ctx.P)[None]
+            out[a.out] = (jax.lax.psum(loc, ctx.axes) >= ctx.P)[None]
         else:
             raise ValueError(f"unknown scalar agg {a.op!r}")
-    me = jax.lax.axis_index(AXIS)
+    me = jax.lax.axis_index(ctx.axes)
     valid = (me == 0)[None]
     ctx.slots[p["slot"]] = ColumnBatch(out, valid)
 
@@ -562,11 +564,12 @@ _KERNELS = {
 }
 
 
-def build_stage_fn(stage, P: int, slack: float, boost: int):
+def build_stage_fn(stage, P: int, slack: float, boost: int,
+                   axes: "Tuple[str, ...]" = (AXIS,)):
     """Compose the stage's ops into one per-partition function."""
 
     def fn(sharded_inputs, _replicated):
-        ctx = StageContext(P, slack, boost)
+        ctx = StageContext(P, slack, boost, axes)
         ctx.bind_inputs(tuple(sharded_inputs))
         for op in stage.ops:
             if op.kind == "do_while":
@@ -576,7 +579,7 @@ def build_stage_fn(stage, P: int, slack: float, boost: int):
         # Overflow flags from resize/join are per-device; reduce across the
         # mesh so the replicated output is truly uniform (a silently
         # device-local flag loses rows without tripping the retry).
-        overflow = jax.lax.psum(ctx.overflow.astype(jnp.int32), AXIS) > 0
+        overflow = jax.lax.psum(ctx.overflow.astype(jnp.int32), axes) > 0
         return outs, (overflow,)
 
     return fn

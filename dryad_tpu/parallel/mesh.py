@@ -19,6 +19,10 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 AXIS = "p"
+# Cross-slice axis of a hybrid mesh: partitions within a slice talk over
+# ICI ("p"), slices talk over DCN ("d") — the reference's machine→pod
+# hierarchy (DrDynamicAggregateManager.h:35-168) as mesh structure.
+DCN_AXIS = "d"
 
 
 def make_mesh(num_partitions: Optional[int] = None) -> Mesh:
@@ -37,8 +41,58 @@ def make_mesh(num_partitions: Optional[int] = None) -> Mesh:
     return Mesh(np.array(devices[:n]), (AXIS,))
 
 
+def make_hybrid_mesh(
+    dcn_slices: int, ici_partitions: Optional[int] = None
+) -> Mesh:
+    """2-D (DCN_AXIS, AXIS) mesh: ``dcn_slices`` TPU slices (or host
+    groups) by ``ici_partitions`` devices each.
+
+    On real multi-slice TPU topologies the device grid comes from
+    ``mesh_utils.create_hybrid_device_mesh`` so the inner axis rides ICI
+    and the outer axis DCN; elsewhere (CPU meshes, single slice) devices
+    are reshaped in order.  The engine's global partition id is the
+    flattened (d, p) index, d-major.
+    """
+    devices = jax.devices()
+    if dcn_slices < 1:
+        raise ValueError("dcn_slices must be >= 1")
+    n_ici = (
+        ici_partitions
+        if ici_partitions is not None
+        else len(devices) // dcn_slices
+    )
+    if n_ici < 1 or dcn_slices * n_ici > len(devices):
+        raise ValueError(
+            f"hybrid mesh {dcn_slices}x{n_ici} exceeds "
+            f"available devices {len(devices)}"
+        )
+    used = devices[: dcn_slices * n_ici]
+    # Only a genuinely multi-slice topology gets the topology-aware
+    # layout; everything else (CPU meshes, single slice) is an in-order
+    # reshape.  A failure on real multi-slice hardware must NOT silently
+    # degrade: the inner axis would span DCN and every exchange would
+    # ride the slow network while claiming ICI.
+    slice_ids = {getattr(d, "slice_index", None) for d in used}
+    if len(slice_ids - {None}) > 1:
+        from jax.experimental import mesh_utils
+
+        arr = mesh_utils.create_hybrid_device_mesh(
+            (1, n_ici), (dcn_slices, 1), devices=used
+        )
+    else:
+        arr = np.array(used).reshape(dcn_slices, n_ici)
+    return Mesh(arr, (DCN_AXIS, AXIS))
+
+
+def mesh_axes(mesh: Mesh) -> tuple:
+    """The mesh's partition axes, outermost first — ("p",) for a flat
+    mesh, (DCN_AXIS, AXIS) for a hybrid one.  Collectives over this
+    tuple address the flattened global partition id."""
+    return tuple(mesh.axis_names)
+
+
 def partition_sharding(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, P(AXIS))
+    return NamedSharding(mesh, P(mesh_axes(mesh)))
 
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
@@ -46,7 +100,10 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
 
 
 def num_partitions(mesh: Mesh) -> int:
-    return mesh.shape[AXIS]
+    n = 1
+    for name in mesh.axis_names:
+        n *= mesh.shape[name]
+    return n
 
 
 @contextlib.contextmanager

@@ -22,16 +22,17 @@ from typing import Any, Callable, Tuple
 import jax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from dryad_tpu.parallel.mesh import AXIS
+from dryad_tpu.parallel.mesh import mesh_axes
 
 
 def compile_stage(mesh: Mesh, fn: Callable[[Any, Any], Tuple[Any, Any]]):
     """Compile a per-partition stage fn into a jitted SPMD callable."""
+    axes = mesh_axes(mesh)
     mapped = jax.shard_map(
         fn,
         mesh=mesh,
-        in_specs=(P(AXIS), P()),
-        out_specs=(P(AXIS), P()),
+        in_specs=(P(axes), P()),
+        out_specs=(P(axes), P()),
         check_vma=False,
     )
     return jax.jit(mapped)
