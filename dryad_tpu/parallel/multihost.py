@@ -1,0 +1,223 @@
+"""Multi-host driver: distributed runtime init + mailbox control plane.
+
+The reference coordinates a job across machines with the GM's cluster
+abstraction (``ClusterInterface/Interfaces.cs:324``), Peloponnese
+process groups (``LinqToDryad/YarnJobSubmission.cs:63-111``), and the
+per-node ProcessService property mailbox (``ProcessService.cs:42-126``).
+The TPU-native split (SURVEY §5.8): the *data plane* is the SPMD
+program itself — XLA collectives over ICI/DCN synchronise the gang — so
+the control plane only needs a thin service for membership, barriers,
+failure reporting and file exchange.  That service is our
+``cluster.service.ProcessService``; this module is the driver-side
+client logic on top of it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from dryad_tpu.cluster.service import Mailbox, ServiceClient
+from dryad_tpu.utils.logging import get_logger
+
+log = get_logger("dryad_tpu.parallel.multihost")
+
+_initialized = False
+_init_lock = threading.Lock()
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialise the JAX multi-controller runtime (idempotent).
+
+    The analog of the reference's job-wide process-group bring-up: after
+    this, ``jax.devices()`` spans every host's chips and compiled
+    programs gang-launch across them.  Arguments default from the
+    standard env vars (``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``
+    /``JAX_PROCESS_ID``); returns False (no-op) when neither arguments
+    nor env request a multi-process runtime.
+    """
+    global _initialized
+    with _init_lock:
+        if _initialized:
+            return True
+        coordinator_address = coordinator_address or os.environ.get(
+            "JAX_COORDINATOR_ADDRESS"
+        )
+        if num_processes is None:
+            v = os.environ.get("JAX_NUM_PROCESSES")
+            num_processes = int(v) if v else None
+        if process_id is None:
+            v = os.environ.get("JAX_PROCESS_ID")
+            process_id = int(v) if v else None
+        if not coordinator_address or not num_processes or num_processes <= 1:
+            return False
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        _initialized = True
+        log.info(
+            "jax.distributed initialised: %d processes via %s",
+            num_processes, coordinator_address,
+        )
+        return True
+
+
+class ControlPlane:
+    """Job control plane over a property mailbox.
+
+    One instance per driver process.  Backed either by a remote
+    ``ProcessService`` (``ServiceClient``) or an in-process ``Mailbox``
+    (local/test mode).  Properties live under the job id, mirroring the
+    reference's per-process mailbox records
+    (``ProcessService.cs:81-126`` MailboxRecord):
+
+    - ``member/<i>``    — membership announcement (JSON metadata)
+    - ``hb/<i>``        — heartbeat timestamps (failure detection)
+    - ``barrier/<name>/<i>`` — barrier arrivals
+    - ``fail/<i>``      — failure reports (JSON)
+    """
+
+    def __init__(
+        self,
+        job_id: str,
+        process_id: int,
+        client: Optional[ServiceClient] = None,
+        mailbox: Optional[Mailbox] = None,
+        heartbeat_interval: float = 2.0,
+    ):
+        if (client is None) == (mailbox is None):
+            raise ValueError("exactly one of client/mailbox required")
+        self.job_id = job_id
+        self.process_id = process_id
+        self._client = client
+        self._mailbox = mailbox
+        self._hb_interval = heartbeat_interval
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+
+    # -- mailbox primitives -------------------------------------------------
+    def _set(self, name: str, value: bytes) -> int:
+        if self._client is not None:
+            return self._client.set_prop(self.job_id, name, value)
+        return self._mailbox.set_prop(self.job_id, name, value)
+
+    def _get(
+        self, name: str, after: int = 0, timeout: float = 0.0
+    ):
+        if self._client is not None:
+            return self._client.get_prop(self.job_id, name, after, timeout)
+        return self._mailbox.get_prop(self.job_id, name, after, timeout)
+
+    # -- membership ---------------------------------------------------------
+    def announce(self, meta: Optional[Dict] = None) -> None:
+        """Register this process (LocalScheduler computer-join analog)."""
+        body = json.dumps(
+            dict(meta or {}, pid=self.process_id, ts=time.time())
+        ).encode()
+        self._set(f"member/{self.process_id}", body)
+
+    def wait_for_members(
+        self, n: int, timeout: float = 60.0, poll: float = 0.1
+    ) -> List[int]:
+        """Block until >= n processes announced (the reference's
+        ``WaitForReasonableNumberOfComputers``, ``LocalScheduler.cs:88``)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            members = [
+                i for i in range(n) if self._get(f"member/{i}") is not None
+            ]
+            if len(members) >= n:
+                return members
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"only {len(members)}/{n} members after {timeout}s"
+                )
+            time.sleep(poll)
+
+    # -- heartbeats / failure detection ------------------------------------
+    def start_heartbeat(self) -> None:
+        """Background liveness beacon (ProcessService child-watch analog,
+        ``Interfaces.cs:214-258`` IProcessWatcher)."""
+        if self._hb_thread is not None:
+            return
+
+        def beat():
+            while not self._hb_stop.wait(self._hb_interval):
+                try:
+                    self._set(
+                        f"hb/{self.process_id}", str(time.time()).encode()
+                    )
+                except Exception as e:  # control plane hiccup: keep beating
+                    log.warning("heartbeat failed: %s", e)
+
+        self._set(f"hb/{self.process_id}", str(time.time()).encode())
+        self._hb_thread = threading.Thread(target=beat, daemon=True)
+        self._hb_thread.start()
+
+    def stop_heartbeat(self) -> None:
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5)
+            self._hb_thread = None
+
+    def alive_members(self, n: int, ttl: float = 10.0) -> List[int]:
+        """Processes whose heartbeat is fresher than ``ttl`` seconds."""
+        now = time.time()
+        alive = []
+        for i in range(n):
+            got = self._get(f"hb/{i}")
+            if got is not None and now - float(got[1]) <= ttl:
+                alive.append(i)
+        return alive
+
+    # -- barriers -----------------------------------------------------------
+    def barrier(
+        self, name: str, n: int, timeout: float = 120.0, poll: float = 0.05
+    ) -> None:
+        """Arrive at a named barrier and wait for all n processes.
+
+        Control-plane only (slow path): intra-program synchronisation is
+        the SPMD collectives'; this guards host-side stage boundaries
+        (e.g. everyone finished materialising before anyone reads).
+        """
+        self._set(f"barrier/{name}/{self.process_id}", b"1")
+        deadline = time.monotonic() + timeout
+        while True:
+            arrived = sum(
+                1
+                for i in range(n)
+                if self._get(f"barrier/{name}/{i}") is not None
+            )
+            if arrived >= n:
+                return
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"barrier {name!r}: {arrived}/{n} after {timeout}s"
+                )
+            time.sleep(poll)
+
+    # -- failures -----------------------------------------------------------
+    def report_failure(self, info: Dict) -> None:
+        self._set(
+            f"fail/{self.process_id}",
+            json.dumps(dict(info, ts=time.time())).encode(),
+        )
+
+    def failures(self, n: int) -> Dict[int, Dict]:
+        out: Dict[int, Dict] = {}
+        for i in range(n):
+            got = self._get(f"fail/{i}")
+            if got is not None:
+                out[i] = json.loads(got[1])
+        return out

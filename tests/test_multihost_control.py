@@ -1,0 +1,118 @@
+"""Control-plane tests: membership, heartbeats, barriers, failures —
+over both the in-process Mailbox and a real ProcessService HTTP server."""
+
+import threading
+import time
+
+import pytest
+
+from dryad_tpu.cluster.service import Mailbox, ProcessService, ServiceClient
+from dryad_tpu.parallel.multihost import ControlPlane, init_distributed
+
+
+def make_planes(n, client=None, mailbox=None):
+    return [
+        ControlPlane(
+            "job-1", i,
+            client=client, mailbox=mailbox, heartbeat_interval=0.05,
+        )
+        for i in range(n)
+    ]
+
+
+def test_requires_exactly_one_backend():
+    with pytest.raises(ValueError):
+        ControlPlane("j", 0)
+    with pytest.raises(ValueError):
+        ControlPlane("j", 0, client=object(), mailbox=Mailbox())
+
+
+def test_membership_and_wait(tmp_path):
+    mb = Mailbox()
+    planes = make_planes(3, mailbox=mb)
+    planes[0].announce()
+    with pytest.raises(TimeoutError):
+        planes[0].wait_for_members(3, timeout=0.3)
+    planes[1].announce({"host": "b"})
+    planes[2].announce()
+    assert planes[0].wait_for_members(3, timeout=2.0) == [0, 1, 2]
+
+
+def test_heartbeat_failure_detection():
+    mb = Mailbox()
+    planes = make_planes(2, mailbox=mb)
+    for p in planes:
+        p.start_heartbeat()
+    time.sleep(0.15)
+    assert planes[0].alive_members(2, ttl=5.0) == [0, 1]
+    planes[1].stop_heartbeat()
+    time.sleep(0.3)
+    assert planes[0].alive_members(2, ttl=0.25) == [0]
+    planes[0].stop_heartbeat()
+
+
+def test_barrier_blocks_until_all_arrive():
+    mb = Mailbox()
+    planes = make_planes(3, mailbox=mb)
+    order = []
+
+    def arrive(i, delay):
+        time.sleep(delay)
+        planes[i].barrier("stage-0", 3, timeout=5.0)
+        order.append(i)
+
+    ts = [
+        threading.Thread(target=arrive, args=(i, 0.05 * i)) for i in range(3)
+    ]
+    t0 = time.monotonic()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert sorted(order) == [0, 1, 2]
+    assert time.monotonic() - t0 >= 0.1  # gated on the slowest arrival
+
+
+def test_barrier_timeout():
+    mb = Mailbox()
+    planes = make_planes(2, mailbox=mb)
+    with pytest.raises(TimeoutError):
+        planes[0].barrier("lonely", 2, timeout=0.3)
+
+
+def test_failure_reporting():
+    mb = Mailbox()
+    planes = make_planes(2, mailbox=mb)
+    planes[1].report_failure({"stage": "sort", "error": "overflow"})
+    fails = planes[0].failures(2)
+    assert list(fails) == [1]
+    assert fails[1]["stage"] == "sort"
+
+
+def test_control_plane_over_http(tmp_path):
+    with ProcessService(str(tmp_path)) as svc:
+        client = ServiceClient("127.0.0.1", svc.port)
+        planes = [
+            ControlPlane("job-h", i, client=client, heartbeat_interval=0.05)
+            for i in range(2)
+        ]
+        for p in planes:
+            p.announce()
+        assert planes[0].wait_for_members(2, timeout=5.0) == [0, 1]
+        done = []
+
+        def arrive(i):
+            planes[i].barrier("b", 2, timeout=5.0)
+            done.append(i)
+
+        t = threading.Thread(target=arrive, args=(1,))
+        t.start()
+        planes[0].barrier("b", 2, timeout=5.0)
+        t.join()
+        assert sorted(done + [0]) == [0, 1]
+
+
+def test_init_distributed_noop_without_env(monkeypatch):
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
+    assert init_distributed() is False
