@@ -136,13 +136,41 @@ class Query:
         keys: KeyArg,
         aggs: Optional[Dict[str, Tuple[str, Optional[str]]]] = None,
         decomposable: Optional[Decomposable] = None,
+        dense: Optional[int] = None,
     ) -> "Query":
         """GroupBy with builtin aggregates or a Decomposable.
 
         ``aggs``: out_name -> (op, col) with op in
         sum|count|min|max|mean|first|any|all (col None for count).
+
+        ``dense=K`` declares the single INT32 key lies in [0, K): the
+        engine then skips the sort+shuffle pipeline and reduces on the
+        MXU via one-hot matmul buckets (Pallas kernel on TPU) followed
+        by one ``psum_scatter`` — the aggregation-tree fast path.  Only
+        sum/count/mean aggregates; rows with keys outside [0, K) are
+        dropped.  Output is range-partitioned and ordered by the key.
         """
         keys = _keys(keys)
+        if dense is not None:
+            if decomposable is not None:
+                raise ValueError("dense group_by takes builtin aggs only")
+            if len(keys) != 1:
+                raise ValueError("dense group_by requires exactly one key")
+            if self.schema.field(keys[0]).ctype != ColumnType.INT32:
+                raise ValueError("dense group_by key must be INT32")
+            if dense < 1:
+                raise ValueError("dense bucket count must be >= 1")
+            bad = [
+                op for op, _c, _o in (
+                    (op, c, o) for o, (op, c) in (aggs or {}).items()
+                ) if op not in ("sum", "count", "mean")
+            ]
+            if not aggs:
+                raise ValueError("group_by needs aggs")
+            if bad:
+                raise ValueError(
+                    f"dense group_by supports sum/count/mean, got {bad}"
+                )
         fields: List[Tuple[str, ColumnType]] = [
             (k, self.schema.field(k).ctype) for k in keys
         ]
@@ -162,10 +190,19 @@ class Query:
             ct = self.schema.field(col).ctype if col is not None else ColumnType.INT32
             fields.append((out_name, _AGG_TYPE_RULES[op](ct)))
             agg_list.append((op, col, out_name))
-        node = Node(
-            "group_by", [self.node], Schema(fields),
-            PartitionInfo.hashed(keys), keys=keys, aggs=agg_list,
-        )
+        if dense is not None:
+            part = PartitionInfo.ranged(
+                [(keys[0], False)], ordered=[(keys[0], False)]
+            )
+            node = Node(
+                "group_by", [self.node], Schema(fields), part,
+                keys=keys, aggs=agg_list, dense=int(dense),
+            )
+        else:
+            node = Node(
+                "group_by", [self.node], Schema(fields),
+                PartitionInfo.hashed(keys), keys=keys, aggs=agg_list,
+            )
         return Query(self.ctx, node)
 
     def distinct(self, keys: Optional[KeyArg] = None) -> "Query":

@@ -167,6 +167,60 @@ def _k_group_combine(ctx: StageContext, p) -> None:
     )
 
 
+def _k_group_reduce_dense(ctx: StageContext, p) -> None:
+    """Dense-key GroupBy: per-partition MXU bucket reduce (Pallas on
+    TPU, ``ops/pallas_bucket.py``) + one ``psum_scatter`` over the mesh.
+
+    Output partition i holds buckets [i*per, (i+1)*per); rows for keys
+    outside [0, K) are dropped (API contract).  Count accumulates in
+    f32 — exact up to 2^24 rows per bucket per partition.
+    """
+    from dryad_tpu.ops.pallas_bucket import bucket_sum_count
+
+    b = ctx.slots[p["slot"]]
+    K = int(p["num_buckets"])
+    per = max(1, -(-K // ctx.P))  # ceil
+    Kp = per * ctx.P
+    key = b.data[p["key"]]
+    in_range = b.valid & (key >= 0) & (key < K)
+
+    # Distinct value columns needed by sum/mean aggs.
+    val_cols: List[str] = []
+    for a in p["aggs"]:
+        if a.op in ("sum", "mean") and a.col not in val_cols:
+            val_cols.append(a.col)
+    sums, cnt = bucket_sum_count(
+        key, [b.data[c] for c in val_cols], in_range, Kp
+    )
+    by_col = dict(zip(val_cols, sums))
+
+    scat = lambda x: jax.lax.psum_scatter(
+        x, ctx.axes, scatter_dimension=0, tiled=True
+    )
+    cnt = scat(cnt)
+    by_col = {c: scat(s) for c, s in by_col.items()}
+
+    me = jax.lax.axis_index(ctx.axes)
+    kcol = (me * per + jnp.arange(per, dtype=jnp.int32)).astype(key.dtype)
+    out: Dict[str, jax.Array] = {p["key"]: kcol}
+    for a in p["aggs"]:
+        if a.op == "count":
+            out[a.out] = cnt.astype(jnp.int32)
+        elif a.op == "sum":
+            s = by_col[a.col]
+            dt = b.data[a.col].dtype
+            out[a.out] = (
+                jnp.round(s).astype(dt) if jnp.issubdtype(dt, jnp.integer)
+                else s.astype(dt)
+            )
+        elif a.op == "mean":
+            out[a.out] = by_col[a.col] / jnp.maximum(cnt, 1.0)
+        else:  # guarded at the API layer
+            raise ValueError(f"dense group_by cannot compute {a.op!r}")
+    valid = (cnt > 0) & (kcol < K)
+    ctx.slots[p["slot"]] = ColumnBatch(out, valid)
+
+
 def _k_distinct(ctx: StageContext, p) -> None:
     b = ctx.slots[p["slot"]]
     ctx.slots[p["slot"]] = SEG.distinct(b, p["keys"])
@@ -543,6 +597,7 @@ _KERNELS = {
     "exchange_range": _k_exchange_range,
     "resize": _k_resize,
     "group_reduce": _k_group_reduce,
+    "group_reduce_dense": _k_group_reduce_dense,
     "group_combine": _k_group_combine,
     "distinct": _k_distinct,
     "local_sort": _k_local_sort,

@@ -1,0 +1,157 @@
+"""Pallas TPU kernel: dense-key bucket reduction on the MXU.
+
+The fast path for GroupBy over *dense integer* keys (key in [0, K) with
+K known at trace time — categorical codes, dictionary ranks): instead of
+the general sort + segmented-reduce + shuffle pipeline
+(``ops/segmented.py``, the TPU analog of the reference's GroupBy
+machinery), each row block is one-hot encoded and reduced as a matmul on
+the MXU, accumulating per-bucket sums/counts in a VMEM-resident
+accumulator across the row-block grid.  Cross-partition combination is
+then a single ``psum_scatter`` — the aggregation *tree* of the reference
+(``DrDynamicAggregateManager.h:35-168``) becomes one XLA collective and
+the shuffle disappears entirely.
+
+The kernel runs under Pallas on TPU (or in interpret mode, used on CPU
+in tests); elsewhere ``bucket_sum_count`` falls back to a pure-XLA scan
+of one-hot matmuls with identical semantics.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax.experimental import pallas as pl
+except Exception:  # pragma: no cover - pallas always present in-tree
+    pl = None
+
+DEFAULT_BLOCK = 1024
+
+
+def _pad_rows(n: int, block: int) -> int:
+    return ((n + block - 1) // block) * block
+
+
+def _pad_buckets(k: int) -> int:
+    return max(128, ((k + 127) // 128) * 128)
+
+
+def _make_kernel(n_vals: int, K: int):
+    """Kernel over refs (k, mask, v_0..v_{n-1}, cnt, sum_0..sum_{n-1})."""
+
+    def kernel(*refs):
+        k_ref, m_ref = refs[0], refs[1]
+        v_refs = refs[2 : 2 + n_vals]
+        cnt_ref = refs[2 + n_vals]
+        sum_refs = refs[3 + n_vals :]
+
+        i = pl.program_id(0)
+        kb = k_ref[0, :]  # (B,) int32
+        mb = m_ref[0, :]  # (B,) bool
+        B = kb.shape[0]
+        iota = jax.lax.broadcasted_iota(jnp.int32, (B, K), 1)
+        oh = ((kb[:, None] == iota) & mb[:, None]).astype(jnp.float32)
+
+        @pl.when(i == 0)
+        def _init():
+            cnt_ref[:] = jnp.zeros((K,), jnp.float32)
+            for s in sum_refs:
+                s[:] = jnp.zeros((K,), jnp.float32)
+
+        ones = jnp.ones((B,), jnp.float32)
+        # (B,) . (B, K) -> (K,) rides the MXU.
+        cnt_ref[:] += jax.lax.dot_general(
+            ones, oh, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        for v_ref, s_ref in zip(v_refs, sum_refs):
+            vb = v_ref[0, :].astype(jnp.float32)
+            s_ref[:] += jax.lax.dot_general(
+                vb, oh, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+    return kernel
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+def bucket_sum_count(
+    keys: jax.Array,
+    values: Sequence[jax.Array],
+    valid: jax.Array,
+    num_buckets: int,
+    block: int = DEFAULT_BLOCK,
+    interpret: Optional[bool] = None,
+) -> Tuple[List[jax.Array], jax.Array]:
+    """Per-bucket sums of each value column + row counts.
+
+    ``keys``: int32, in [0, num_buckets) for valid rows (values are
+    clamped defensively; callers guarantee range).  Returns
+    ``([sum per value col], counts)``, each of shape (num_buckets,) f32.
+    ``interpret``: force Pallas interpret mode (CPU testing); default
+    picks the Pallas kernel on TPU and the XLA fallback elsewhere.
+    """
+    n = keys.shape[0]
+    K = _pad_buckets(num_buckets)
+    npad = _pad_rows(max(n, block), block)
+    if npad != n:
+        pad = npad - n
+        keys = jnp.concatenate([keys, jnp.zeros((pad,), keys.dtype)])
+        valid = jnp.concatenate([valid, jnp.zeros((pad,), jnp.bool_)])
+        values = [
+            jnp.concatenate([v, jnp.zeros((pad,), v.dtype)]) for v in values
+        ]
+    keys = jnp.clip(jnp.where(valid, keys, 0).astype(jnp.int32), 0, K - 1)
+    nb = npad // block
+    k2 = keys.reshape(nb, block)
+    m2 = valid.reshape(nb, block)
+    v2 = [v.reshape(nb, block) for v in values]
+
+    use_pallas = pl is not None and (
+        interpret is True or (interpret is None and _on_tpu())
+    )
+    if use_pallas:
+        row_spec = pl.BlockSpec((1, block), lambda i: (i, 0))
+        out_spec = pl.BlockSpec((K,), lambda i: (0,))
+        outs = pl.pallas_call(
+            _make_kernel(len(values), K),
+            grid=(nb,),
+            in_specs=[row_spec] * (2 + len(values)),
+            out_specs=[out_spec] * (1 + len(values)),
+            out_shape=[jax.ShapeDtypeStruct((K,), jnp.float32)]
+            * (1 + len(values)),
+            interpret=bool(interpret),
+        )(k2, m2, *v2)
+        cnt, sums = outs[0], list(outs[1:])
+    else:
+        # Pure-XLA fallback: scan of one-hot matmuls (same math).
+        def body(acc, xs):
+            kb, mb, *vbs = xs
+            oh = (
+                (kb[:, None] == jnp.arange(K, dtype=jnp.int32)[None, :])
+                & mb[:, None]
+            ).astype(jnp.float32)
+            cnt_a, sums_a = acc
+            cnt_a = cnt_a + oh.sum(axis=0)
+            sums_a = [
+                s + vb.astype(jnp.float32) @ oh
+                for s, vb in zip(sums_a, vbs)
+            ]
+            return (cnt_a, sums_a), None
+
+        init = (
+            jnp.zeros((K,), jnp.float32),
+            [jnp.zeros((K,), jnp.float32) for _ in values],
+        )
+        (cnt, sums), _ = jax.lax.scan(body, init, (k2, m2, *v2))
+
+    return [s[:num_buckets] for s in sums], cnt[:num_buckets]

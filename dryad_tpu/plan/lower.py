@@ -329,6 +329,27 @@ class _Builder:
             self.cursor[node.id] = ("open", stage, slot)
             return
 
+        # dense-key fast path: MXU bucket reduce + psum_scatter, no shuffle
+        # (see ops/pallas_bucket.py; plan-level analog of swapping the
+        # reference's aggregation tree for one collective).
+        if node.kind == "group_by" and node.params.get("dense"):
+            aggs = self._phys_aggs(in_schema, node.params["aggs"])
+            stage.ops.append(
+                StageOp(
+                    "group_reduce_dense",
+                    dict(
+                        slot=slot,
+                        key=carry_cols[0],
+                        aggs=aggs,
+                        num_buckets=int(node.params["dense"]),
+                    ),
+                )
+            )
+            want = K.group_carry_cols(node.schema, node.schema.names)
+            stage.ops.append(StageOp("project", dict(slot=slot, cols=want)))
+            self.cursor[node.id] = ("open", stage, slot)
+            return
+
         # group_by with builtin aggs or a Decomposable
         decomposable = node.params.get("decomposable")
         if decomposable is not None:
