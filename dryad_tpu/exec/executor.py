@@ -97,8 +97,11 @@ class GraphExecutor:
         key = (self._stage_key(stage), boost, shape_key)
         hit = self._compiled.get(key)
         if hit is None:
-            fn = build_stage_fn(stage, self.P, self.config.shuffle_slack, boost,
-                                mesh_axes(self.mesh))
+            fn = build_stage_fn(
+                stage, self.P, self.config.shuffle_slack, boost,
+                mesh_axes(self.mesh),
+                tuple(self.mesh.shape[a] for a in mesh_axes(self.mesh)),
+            )
             hit = compile_stage(self.mesh, fn)
             self._compiled[key] = hit
         return hit

@@ -33,9 +33,11 @@ class StageContext:
     """Mutable trace-time state while composing one stage function."""
 
     def __init__(self, P: int, slack: float, boost: int,
-                 axes: Tuple[str, ...] = (AXIS,)):
+                 axes: Tuple[str, ...] = (AXIS,),
+                 axis_sizes: Tuple[int, ...] = ()):
         self.P = P
         self.axes = axes
+        self.axis_sizes = axis_sizes if axis_sizes else (P,)
         self.slack = slack
         self.boost = boost
         self.slots: Dict[int, ColumnBatch] = {}
@@ -113,13 +115,63 @@ def _k_apply(ctx: StageContext, p) -> None:
 
 # -- exchanges -------------------------------------------------------------
 
-def _do_exchange_hash(ctx: StageContext, slot: int, keys) -> None:
+def _do_exchange_hash(ctx: StageContext, slot: int, keys, tree=None) -> None:
     b = ctx.slots[slot]
+    if tree is not None and len(ctx.axes) == 2:
+        _tree_exchange_hash(ctx, slot, keys, tree)
+        return
     dest = partition_ids([b.data[k] for k in keys], ctx.P)
     B = SH.bucket_capacity(b.capacity, ctx.P, ctx.slack * ctx.boost)
     out, ovf = SH.exchange(b, dest, ctx.P, B, ctx.axes)
     ctx.slots[slot] = out
     ctx.overflow = ctx.overflow | ovf
+
+
+def _tree_exchange_hash(ctx: StageContext, slot: int, keys, tree) -> None:
+    """Hierarchical shuffle on a hybrid mesh: ICI hop -> per-slice
+    combine -> DCN hop.
+
+    The reference's machine→pod→overall aggregation tree
+    (``DrDynamicAggregateManager.h:35-168``) in collective form: rows
+    for global partition g first travel over ICI to local device
+    g %% P_ici within their slice, duplicate keys are combined there,
+    and only the per-slice partials cross DCN to slice g // P_ici —
+    cutting DCN bytes by the per-slice duplication factor.  The final
+    combine after the DCN hop is the stage's own downstream op.
+    """
+    D, P_in = ctx.axis_sizes[0], ctx.axis_sizes[1]
+    slack = ctx.slack * ctx.boost
+
+    def dest_global(batch):
+        return partition_ids([batch.data[k] for k in keys], ctx.P)
+
+    # Hop 1: within-slice exchange over ICI to local index g %% P_ici.
+    b = ctx.slots[slot]
+    B1 = SH.bucket_capacity(b.capacity, P_in, slack)
+    out, ovf = SH.exchange(
+        b, dest_global(b) % P_in, P_in, B1, (ctx.axes[1],)
+    )
+    ctx.overflow = ctx.overflow | ovf
+    out, ovf = SH.resize(out, _round8(b.capacity * ctx.slack))
+    ctx.overflow = ctx.overflow | ovf
+
+    # Per-slice combine (RecursiveAccumulate analog; idempotent specs).
+    if tree.get("distinct"):
+        out = SEG.distinct(out, tree["keys"])
+    elif "merge" in tree:
+        out = SEG.group_combine(
+            out, tree["keys"], tree["state_cols"], tree["merge"]
+        )
+    else:
+        out = SEG.group_reduce(out, tree["keys"], tree["aggs"])
+
+    # Hop 2: cross-slice exchange over DCN to slice g // P_ici.
+    B2 = SH.bucket_capacity(out.capacity, D, slack)
+    out2, ovf = SH.exchange(
+        out, dest_global(out) // P_in, D, B2, (ctx.axes[0],)
+    )
+    ctx.overflow = ctx.overflow | ovf
+    ctx.slots[slot] = out2
 
 
 def _do_resize(ctx: StageContext, slot: int, factor: float) -> None:
@@ -131,7 +183,7 @@ def _do_resize(ctx: StageContext, slot: int, factor: float) -> None:
 
 
 def _k_exchange_hash(ctx: StageContext, p) -> None:
-    _do_exchange_hash(ctx, p["slot"], p["keys"])
+    _do_exchange_hash(ctx, p["slot"], p["keys"], p.get("tree"))
 
 
 def _k_exchange_range(ctx: StageContext, p) -> None:
@@ -620,11 +672,12 @@ _KERNELS = {
 
 
 def build_stage_fn(stage, P: int, slack: float, boost: int,
-                   axes: "Tuple[str, ...]" = (AXIS,)):
+                   axes: "Tuple[str, ...]" = (AXIS,),
+                   axis_sizes: "Tuple[int, ...]" = ()):
     """Compose the stage's ops into one per-partition function."""
 
     def fn(sharded_inputs, _replicated):
-        ctx = StageContext(P, slack, boost, axes)
+        ctx = StageContext(P, slack, boost, axes, axis_sizes)
         ctx.bind_inputs(tuple(sharded_inputs))
         for op in stage.ops:
             if op.kind == "do_while":

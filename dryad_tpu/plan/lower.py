@@ -323,7 +323,11 @@ class _Builder:
         if node.kind == "distinct":
             if need_exchange:
                 stage.ops.append(StageOp("distinct", dict(slot=slot, keys=eq_cols)))
-                stage.ops.append(StageOp("exchange_hash", dict(slot=slot, keys=eq_cols)))
+                stage.ops.append(StageOp(
+                    "exchange_hash",
+                    dict(slot=slot, keys=eq_cols,
+                         tree=dict(keys=eq_cols, distinct=True)),
+                ))
                 stage.ops.append(StageOp("resize", dict(slot=slot, factor=stage.growth)))
             stage.ops.append(StageOp("distinct", dict(slot=slot, keys=eq_cols)))
             self.cursor[node.id] = ("open", stage, slot)
@@ -373,7 +377,13 @@ class _Builder:
                 )
             )
             if need_exchange:
-                stage.ops.append(StageOp("exchange_hash", dict(slot=slot, keys=eq_cols)))
+                stage.ops.append(StageOp(
+                    "exchange_hash",
+                    dict(slot=slot, keys=eq_cols,
+                         tree=dict(keys=carry_cols,
+                                   state_cols=decomposable.state_cols,
+                                   merge=decomposable.merge)),
+                ))
                 stage.ops.append(StageOp("resize", dict(slot=slot, factor=stage.growth)))
                 stage.ops.append(
                     StageOp(
@@ -401,7 +411,11 @@ class _Builder:
                 StageOp("group_reduce", dict(slot=slot, keys=carry_cols, aggs=partial))
             )
             if need_exchange:
-                stage.ops.append(StageOp("exchange_hash", dict(slot=slot, keys=eq_cols)))
+                stage.ops.append(StageOp(
+                    "exchange_hash",
+                    dict(slot=slot, keys=eq_cols,
+                         tree=dict(keys=carry_cols, aggs=final)),
+                ))
                 stage.ops.append(StageOp("resize", dict(slot=slot, factor=stage.growth)))
                 stage.ops.append(
                     StageOp("group_reduce", dict(slot=slot, keys=carry_cols, aggs=final))

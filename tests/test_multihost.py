@@ -125,3 +125,39 @@ def test_hybrid_matches_flat_mesh(table):
     )
     assert fq["k"].tolist() == hq["k"].tolist()
     np.testing.assert_allclose(fq["m"], hq["m"], rtol=1e-5)
+
+
+def test_hybrid_distinct_tree(hctx, rng):
+    ks = rng.integers(0, 50, 3000).astype(np.int32)
+    out = hctx.from_arrays({"k": ks}).distinct().collect()
+    assert sorted(out["k"].tolist()) == sorted(set(ks.tolist()))
+
+
+def test_hybrid_decomposable_tree(hctx, rng):
+    from dryad_tpu import Decomposable
+    import jax.numpy as jnp
+    from dryad_tpu.columnar.schema import ColumnType
+
+    # Custom sum-of-squares decomposable through the hierarchical path.
+    dec = Decomposable(
+        seed=lambda cols: {"ss": cols["v"] * cols["v"]},
+        merge=lambda a, b: {"ss": a["ss"] + b["ss"]},
+        state_cols=["ss"],
+        out_fields=[("ss", ColumnType.FLOAT32)],
+    )
+    tbl = {
+        "k": rng.integers(0, 16, 2048).astype(np.int32),
+        "v": rng.standard_normal(2048).astype(np.float32),
+    }
+    out = (
+        hctx.from_arrays(tbl)
+        .group_by("k", decomposable=dec)
+        .order_by([("k", False)])
+        .collect()
+    )
+    import collections
+    ref = collections.defaultdict(float)
+    for k, v in zip(tbl["k"], tbl["v"]):
+        ref[int(k)] += float(v) ** 2
+    assert out["k"].tolist() == sorted(ref)
+    np.testing.assert_allclose(out["ss"], [ref[k] for k in sorted(ref)], rtol=2e-4)
