@@ -174,8 +174,12 @@ class DryadContext:
         return Query(self, node)
 
     def from_store(self, path: str) -> Query:
-        """Open a partitioned store (reference FromStore/GetTable)."""
-        schema, parts, dictionary = CIO.read_store(path)
+        """Open a store by path or URI (reference FromStore/GetTable;
+        scheme registry ``columnar/uri.py`` — partfile://, file://,
+        mem://, http://)."""
+        from dryad_tpu.columnar.uri import read_store_uri
+
+        schema, parts, dictionary = read_store_uri(path)
         self.dictionary = self.dictionary.merge(dictionary)
         node = Node(
             "input", [], schema, PartitionInfo.roundrobin(), source="store",
@@ -302,7 +306,9 @@ class DryadContext:
             parts = [
                 {c: np.asarray(v) for c, v in b.data.items()}
             ]
-            CIO.write_store(
+            from dryad_tpu.columnar.uri import write_store_uri
+
+            write_store_uri(
                 path, parts, query.schema, self.dictionary,
                 self.config.intermediate_compression,
             )
@@ -317,7 +323,9 @@ class DryadContext:
             sl = slice(i * cap, (i + 1) * cap)
             m = valid[sl]
             parts.append({c: v[sl][m] for c, v in host_cols.items()})
-        CIO.write_store(
+        from dryad_tpu.columnar.uri import write_store_uri
+
+        write_store_uri(
             path, parts, query.schema, self.dictionary,
             self.config.intermediate_compression,
         )

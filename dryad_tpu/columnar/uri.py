@@ -1,0 +1,186 @@
+"""Data-provider URI registry — the DataProvider/DataPath analog.
+
+The reference maps URI schemes to pluggable storage providers
+(``LinqToDryad/DataProvider.cs:682`` scheme registry, ``DataPath.cs``:
+``partfile://``, ``hdfs://``, ``azureblob://``).  Here:
+
+- ``partfile://<dir>`` (or a bare path) — local partitioned columnar
+  store (``columnar/io.py``).
+- ``file://<path>``   — raw text file (one STRING ``line`` column).
+- ``mem://<name>``    — in-process named table registry (the
+  LocalDebug-style test provider).
+- ``http://host:port/<rel>`` — a store served by a remote node's
+  ProcessService file server (``cluster/service.py``), read with 2MB
+  range reads like the reference's HTTP channel readers
+  (``managedchannel/HttpReader.cs:78-110``).  Read-only.
+
+Register custom providers with ``register_provider``.
+"""
+
+from __future__ import annotations
+
+import io as _io
+import json
+import os
+import urllib.parse
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dryad_tpu.columnar import io as CIO
+from dryad_tpu.columnar.schema import ColumnType, Schema, StringDictionary
+
+ReadResult = Tuple[Schema, List[Dict[str, np.ndarray]], StringDictionary]
+
+
+class DataProvider:
+    """Provider interface: read a URI into (schema, partitions,
+    dictionary); optionally write a store to a URI."""
+
+    def read(self, uri: str) -> ReadResult:
+        raise NotImplementedError
+
+    def write(
+        self,
+        uri: str,
+        partitions: List[Dict[str, np.ndarray]],
+        schema: Schema,
+        dictionary: Optional[StringDictionary],
+        compression: Optional[str],
+    ) -> None:
+        raise NotImplementedError(f"provider for {uri!r} is read-only")
+
+
+_PROVIDERS: Dict[str, DataProvider] = {}
+
+
+def register_provider(scheme: str, provider: DataProvider) -> None:
+    _PROVIDERS[scheme] = provider
+
+
+def split_uri(uri: str) -> Tuple[str, str]:
+    """(scheme, rest); bare paths map to 'partfile'."""
+    if "://" not in uri:
+        return "partfile", uri
+    scheme, rest = uri.split("://", 1)
+    return scheme.lower(), rest
+
+
+def get_provider(uri: str) -> Tuple[DataProvider, str]:
+    scheme, rest = split_uri(uri)
+    p = _PROVIDERS.get(scheme)
+    if p is None:
+        raise ValueError(
+            f"no data provider for scheme {scheme!r} "
+            f"(registered: {sorted(_PROVIDERS)})"
+        )
+    return p, rest
+
+
+def read_store_uri(uri: str) -> ReadResult:
+    p, rest = get_provider(uri)
+    return p.read(rest)
+
+
+def write_store_uri(
+    uri: str,
+    partitions: List[Dict[str, np.ndarray]],
+    schema: Schema,
+    dictionary: Optional[StringDictionary],
+    compression: Optional[str],
+) -> None:
+    p, rest = get_provider(uri)
+    p.write(rest, partitions, schema, dictionary, compression)
+
+
+# -- built-in providers ----------------------------------------------------
+
+class PartfileProvider(DataProvider):
+    def read(self, path: str) -> ReadResult:
+        return CIO.read_store(path)
+
+    def write(self, path, partitions, schema, dictionary, compression):
+        CIO.write_store(path, partitions, schema, dictionary, compression)
+
+
+class TextFileProvider(DataProvider):
+    """Raw text: one partition, one STRING column ``line``."""
+
+    def read(self, path: str) -> ReadResult:
+        from dryad_tpu.columnar.schema import hash64_str, string_prefix_rank
+
+        with open(path, "r", encoding="utf-8", errors="replace") as fh:
+            lines = [ln.rstrip("\n") for ln in fh]
+        arr = np.array(lines, object)
+        schema = Schema([("line", ColumnType.STRING)])
+        dictionary = StringDictionary()
+        h = np.array([hash64_str(s) for s in lines], np.uint64)
+        for hv, s in zip(h, lines):
+            dictionary._map[int(hv)] = s
+        cols = {
+            "line#h0": (h & np.uint64(0xFFFFFFFF)).astype(np.uint32),
+            "line#h1": (h >> np.uint64(32)).astype(np.uint32),
+            "line#r0": string_prefix_rank(arr),
+        }
+        return schema, [cols], dictionary
+
+
+class MemProvider(DataProvider):
+    """In-process named stores (testing / LocalDebug analog)."""
+
+    def __init__(self) -> None:
+        self._tables: Dict[str, Tuple] = {}
+
+    def read(self, name: str) -> ReadResult:
+        if name not in self._tables:
+            raise FileNotFoundError(f"mem://{name}")
+        schema, parts, dictionary = self._tables[name]
+        return schema, [dict(p) for p in parts], dictionary
+
+    def write(self, name, partitions, schema, dictionary, compression):
+        self._tables[name] = (
+            schema,
+            [dict(p) for p in partitions],
+            dictionary or StringDictionary(),
+        )
+
+
+class HttpStoreProvider(DataProvider):
+    """Read a partitioned store served by a remote ProcessService
+    FileServer: ``http://host:port/<relative store dir>``."""
+
+    def read(self, rest: str) -> ReadResult:
+        from dryad_tpu.cluster.service import ServiceClient
+
+        netloc, _, rel = rest.partition("/")
+        host, _, port = netloc.partition(":")
+        client = ServiceClient(host, int(port or 80))
+        prefix = rel.strip("/")
+
+        def fetch(name: str) -> bytes:
+            return client.read_whole_file(
+                f"{prefix}/{name}" if prefix else name
+            )
+
+        manifest = json.loads(fetch(CIO.MANIFEST).decode("utf-8"))
+        schema = Schema(
+            [(n, ColumnType(t)) for n, t in manifest["schema"]]
+        )
+        dictionary = StringDictionary()
+        try:
+            dmap = json.loads(fetch(CIO.DICTFILE).decode("utf-8"))
+            for h, s in dmap.items():
+                dictionary._map[int(h, 16)] = s
+        except FileNotFoundError:
+            pass
+        parts = [
+            CIO.parse_partition_bytes(fetch(f"part-{i:05d}.dpf"))
+            for i in range(manifest["partitions"])
+        ]
+        return schema, parts, dictionary
+
+
+register_provider("partfile", PartfileProvider())
+register_provider("file", TextFileProvider())
+register_provider("mem", MemProvider())
+register_provider("http", HttpStoreProvider())
