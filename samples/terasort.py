@@ -1,0 +1,47 @@
+"""TeraSort shape — the reference's range-partitioned sort
+(``RangePartitionAPICoverageTests.cs``; dynamic range sizing
+``DrDynamicRangeDistributor.cpp:23-110``), TPU-native: on-device
+sampling elects splitters, rows range-exchange over the mesh in one
+all_to_all, each partition sorts locally — globally sorted output.
+
+Run (CPU mesh):
+    JAX_PLATFORMS=cpu python samples/terasort.py [n_rows]
+"""
+
+import sys
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+# The CPU-mesh demo path: switch platform before the first backend
+# query (env alone can be too late when jax is pre-imported).
+if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+
+import numpy as np
+
+from dryad_tpu import DryadContext
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    rng = np.random.default_rng(0)
+    ctx = DryadContext()
+
+    table = {
+        "key": rng.integers(-(2**31), 2**31 - 1, n).astype(np.int32),
+        "payload": rng.standard_normal(n).astype(np.float32),
+    }
+    out = ctx.from_arrays(table).order_by([("key", False)]).collect()
+
+    assert np.array_equal(out["key"], np.sort(table["key"])), "not sorted!"
+    print(f"sorted {n} rows; head={out['key'][:5].tolist()}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+"""WordCount — the reference's canonical sample
+(``samples/WordCount.cs.pp``, ``DryadLinqTests/WordCount.cs:58-61``),
+TPU-native: tokenize at the ingest edge (native tokenizer), hash-shuffle
+by word over the mesh, segmented-reduce counts on device.
+
+Run (CPU mesh):
+    JAX_PLATFORMS=cpu python samples/wordcount.py [textfile]
+"""
+
+import sys
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+# The CPU-mesh demo path: switch platform before the first backend
+# query (env alone can be too late when jax is pre-imported).
+if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+
+from dryad_tpu import DryadContext
+
+TEXT = """the quick brown fox jumps over the lazy dog
+the dog barks and the fox runs away over the hill"""
+
+
+def main() -> None:
+    ctx = DryadContext()
+    source = sys.argv[1] if len(sys.argv) > 1 else TEXT
+
+    counts = (
+        ctx.from_text(source)
+        .group_by("word", {"n": ("count", None)})
+        .order_by([("n", True)])  # descending by count
+        .take(10)
+        .collect()
+    )
+    for w, n in zip(counts["word"], counts["n"]):
+        print(f"{n:6d}  {w}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,28 @@
+"""Samples must keep running (the reference ships runnable samples,
+``samples/WordCount.cs.pp``)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.parametrize(
+    "script,args,expect",
+    [
+        ("wordcount.py", [], "the"),
+        ("terasort.py", ["20000"], "sorted 20000 rows"),
+        ("join_groupby.py", [], "region 0:"),
+    ],
+)
+def test_sample_runs(script, args, expect):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "samples", script), *args],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert expect in out.stdout
