@@ -22,6 +22,7 @@ Fault tolerance keeps the reference *semantics* in TPU form:
 
 from __future__ import annotations
 
+import contextlib
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -127,16 +128,22 @@ class GraphExecutor:
         """
         self.events.emit("job_start", stages=len(graph.stages))
         results: Dict[Tuple[int, int], ColumnBatch] = {}
+        profile = (
+            jax.profiler.trace(self.config.profile_dir)
+            if self.config.profile_dir
+            else contextlib.nullcontext()
+        )
         # stage id -> Merkle fingerprint (None = not checkpointable)
         stage_fps: Dict[int, Optional[str]] = {}
-        for stage in graph.stages:
-            if stage.ops and stage.ops[0].kind == "do_while":
-                stage_fps[stage.id] = None  # loop state is data-dependent
-                self._run_do_while(stage, graph, bindings, results)
-                continue
-            self._run_stage(
-                stage, graph, bindings, results, binding_fps or {}, stage_fps
-            )
+        with profile:
+            for stage in graph.stages:
+                if stage.ops and stage.ops[0].kind == "do_while":
+                    stage_fps[stage.id] = None  # loop state is data-dependent
+                    self._run_do_while(stage, graph, bindings, results)
+                    continue
+                self._run_stage(
+                    stage, graph, bindings, results, binding_fps or {}, stage_fps
+                )
         self.events.emit("job_complete")
         return results
 
@@ -204,8 +211,13 @@ class GraphExecutor:
             try:
                 faults.registry.maybe_fail(stage.name)
                 fn = self._get_compiled(stage, boost, shape_key)
-                outs, (overflow,) = fn(inputs, ())
-                overflow = bool(overflow)
+                # Per-stage step marker: stages show up as named steps in
+                # the XLA profiler timeline (SURVEY 5.1).
+                with jax.profiler.StepTraceAnnotation(
+                    stage.name, step_num=version
+                ):
+                    outs, (overflow,) = fn(inputs, ())
+                    overflow = bool(overflow)
             except faults.InjectedStageFailure as e:
                 failures += 1
                 self.events.emit(

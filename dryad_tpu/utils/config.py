@@ -79,6 +79,9 @@ class DryadConfig:
     materialize_at_shuffle: bool = False
     # Event log directory (Calypso analog); None disables.
     event_log_dir: Optional[str] = None
+    # XLA/JAX profiler output directory (SURVEY 5.1: profiler traces +
+    # per-stage step markers); None disables tracing.
+    profile_dir: Optional[str] = None
     # Stage-output checkpoint directory (durable DCT_File channel
     # analog, SURVEY §5.4); None disables checkpoint/resume.
     checkpoint_dir: Optional[str] = None

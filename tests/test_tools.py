@@ -121,3 +121,18 @@ def test_jobview_cli_roundtrip(mesh8, tmp_path):
     (log_path,) = glob.glob(os.path.join(str(tmp_path), "*.jsonl"))
     assert main([log_path]) == 0
     assert main([]) == 2
+
+
+def test_profiler_trace_written(tmp_path, rng):
+    import os
+    import numpy as np
+    from dryad_tpu import DryadConfig, DryadContext
+
+    pdir = str(tmp_path / "prof")
+    ctx = DryadContext(num_partitions_=8, config=DryadConfig(profile_dir=pdir))
+    tbl = {"k": rng.integers(0, 8, 256).astype(np.int32)}
+    ctx.from_arrays(tbl).group_by("k", {"c": ("count", None)}).collect()
+    found = []
+    for root, _dirs, files in os.walk(pdir):
+        found += files
+    assert found, "profiler produced no trace files"
