@@ -137,11 +137,19 @@ class Query:
         aggs: Optional[Dict[str, Tuple[str, Optional[str]]]] = None,
         decomposable: Optional[Decomposable] = None,
         dense: Optional[int] = None,
+        salt: Optional[int] = None,
     ) -> "Query":
         """GroupBy with builtin aggregates or a Decomposable.
 
         ``aggs``: out_name -> (op, col) with op in
         sum|count|min|max|mean|first|any|all (col None for count).
+
+        ``salt=S`` spreads each key over S shuffle destinations
+        (partial-reduce on (key, salt), exchange, reduce, then exchange
+        on the key alone) — the skew escape hatch for heavy-hitter keys,
+        the analog of the reference's data-size-driven hash
+        redistribution (``DrDynamicDistributor.h:26,79``).  Costs a
+        second shuffle; use when one key dominates.
 
         ``dense=K`` declares the single INT32 key lies in [0, K): the
         engine then skips the sort+shuffle pipeline and reduces on the
@@ -151,6 +159,11 @@ class Query:
         dropped.  Output is range-partitioned and ordered by the key.
         """
         keys = _keys(keys)
+        if salt is not None:
+            if salt < 2:
+                raise ValueError("salt must be >= 2")
+            if dense is not None or decomposable is not None:
+                raise ValueError("salt applies to builtin-agg group_by only")
         if dense is not None:
             if decomposable is not None:
                 raise ValueError("dense group_by takes builtin aggs only")
@@ -202,6 +215,7 @@ class Query:
             node = Node(
                 "group_by", [self.node], Schema(fields),
                 PartitionInfo.hashed(keys), keys=keys, aggs=agg_list,
+                salt=salt,
             )
         return Query(self.ctx, node)
 
@@ -355,6 +369,20 @@ class Query:
         node = Node(
             "order_by", [self.node], self.schema,
             PartitionInfo.ranged(ks, ks), keys=ks,
+        )
+        return Query(self.ctx, node)
+
+    def with_rank(self, out: str = "rank") -> "Query":
+        """Attach each row's global engine-order position as an INT32
+        column — the indexed-operator primitive (reference LongSelect /
+        indexed Select/Where overloads): ``q.with_rank().select(...)``
+        gives every row its index."""
+        if out in self.schema.names:
+            raise ValueError(f"column {out!r} already exists")
+        node = Node(
+            "with_rank", [self.node],
+            self.schema.with_field(out, ColumnType.INT32),
+            self.node.partition, out=out,
         )
         return Query(self.ctx, node)
 

@@ -63,6 +63,7 @@ class GraphExecutor:
         self.stats: Dict[str, StageStatistics] = {}
         # Callback used by do_while stages to run body/cond subplans.
         self.subquery_runner = subquery_runner
+        self._profiling = False
         self.checkpoints = (
             CheckpointStore(self.config.checkpoint_dir)
             if self.config.checkpoint_dir
@@ -128,24 +129,34 @@ class GraphExecutor:
         """
         self.events.emit("job_start", stages=len(graph.stages))
         results: Dict[Tuple[int, int], ColumnBatch] = {}
+        # do_while re-enters execute() through subquery_runner; only the
+        # top-level call may own the profiler session.
         profile = (
             jax.profiler.trace(self.config.profile_dir)
-            if self.config.profile_dir
+            if self.config.profile_dir and not self._profiling
             else contextlib.nullcontext()
         )
+        self._profiling = bool(self.config.profile_dir)
         # stage id -> Merkle fingerprint (None = not checkpointable)
         stage_fps: Dict[int, Optional[str]] = {}
-        with profile:
-            for stage in graph.stages:
-                if stage.ops and stage.ops[0].kind == "do_while":
-                    stage_fps[stage.id] = None  # loop state is data-dependent
-                    self._run_do_while(stage, graph, bindings, results)
-                    continue
-                self._run_stage(
-                    stage, graph, bindings, results, binding_fps or {}, stage_fps
-                )
+        try:
+            with profile:
+                self._execute_stages(graph, bindings, results, binding_fps, stage_fps)
+        finally:
+            if not isinstance(profile, contextlib.nullcontext):
+                self._profiling = False
         self.events.emit("job_complete")
         return results
+
+    def _execute_stages(self, graph, bindings, results, binding_fps, stage_fps):
+        for stage in graph.stages:
+            if stage.ops and stage.ops[0].kind == "do_while":
+                stage_fps[stage.id] = None  # loop state is data-dependent
+                self._run_do_while(stage, graph, bindings, results)
+                continue
+            self._run_stage(
+                stage, graph, bindings, results, binding_fps or {}, stage_fps
+            )
 
     def _resolve_inputs(
         self,

@@ -488,6 +488,18 @@ def _strip_rank(b: ColumnBatch, keep: jax.Array) -> ColumnBatch:
     )
 
 
+def _k_with_rank(ctx: StageContext, p) -> None:
+    """Attach each row's global engine-order rank as an int32 column
+    (the indexed-operator analog: reference LongSelect / indexed
+    Select/Where overloads, ``DryadLinqQueryGen.cs`` LongSelect
+    dispatch)."""
+    b, _total = _rank_column(ctx.slots[p["slot"]], ctx.P, ctx.axes)
+    rank = b.data["#rank"].astype(jnp.int32)
+    out = {n: c for n, c in b.data.items() if n != "#rank"}
+    out[p["out"]] = jnp.where(b.valid, rank, 0)
+    ctx.slots[p["slot"]] = ColumnBatch(out, b.valid)
+
+
 def _k_take(ctx: StageContext, p) -> None:
     b, _total = _rank_column(ctx.slots[p["slot"]], ctx.P, ctx.axes)
     rank = b.data["#rank"]
@@ -657,6 +669,7 @@ _KERNELS = {
     "semi": _k_semi,
     "concat": _k_concat,
     "take": _k_take,
+    "with_rank": _k_with_rank,
     "skip": _k_skip,
     "tail": _k_tail,
     "take_while": _k_take_while,

@@ -129,6 +129,13 @@ class LocalDebugInterpreter:
             flat[k] = v.reshape((v.shape[0] * v.shape[1],) + tuple(v.shape[2:]))
         return {k: v[valid] for k, v in flat.items()}
 
+    def _n_with_rank(self, node: Node) -> Table:
+        t = self._in(node)
+        n = len(next(iter(t.values()), []))
+        out = dict(t)
+        out[node.params["out"]] = np.arange(n, dtype=np.int32)
+        return out
+
     def _n_assume_partition(self, node: Node) -> Table:
         return self._in(node)
 

@@ -128,7 +128,7 @@ class _Builder:
         elif k in (
             "select", "where", "select_many", "apply", "take",
             "skip", "tail", "take_while", "skip_while", "reverse",
-            "default_if_empty",
+            "default_if_empty", "with_rank",
         ):
             stage, slot = self._continue_or_start(node, fanout.get(node.inputs[0].id, 1))
             if k == "select":
@@ -156,6 +156,10 @@ class _Builder:
                     )
                 )
                 stage.growth *= node.params.get("cap_factor", 1.0)
+            elif k == "with_rank":
+                stage.ops.append(
+                    StageOp("with_rank", dict(slot=slot, out=node.params["out"]))
+                )
             elif k in ("take", "skip", "tail"):
                 # Global rank is partition-major, so take() after order_by
                 # yields the first n in sort order; on unordered input it
@@ -407,9 +411,40 @@ class _Builder:
             partial, final = _decompose_aggs(aggs)
             from dryad_tpu.ops.segmented import AggSpec
 
-            stage.ops.append(
-                StageOp("group_reduce", dict(slot=slot, keys=carry_cols, aggs=partial))
-            )
+            salt = node.params.get("salt")
+            if salt and need_exchange:
+                # Skew path (DrDynamicDistributor analog): spread each
+                # key over `salt` destinations — partial-reduce on
+                # (key, salt), exchange on (key, salt), re-reduce, then
+                # collapse with the normal key-only exchange below.
+                def _add_salt(cols, _s=int(salt)):
+                    import jax.numpy as jnp
+
+                    n = next(iter(cols.values())).shape[0]
+                    out = dict(cols)
+                    out["#salt"] = (
+                        jnp.arange(n, dtype=jnp.int32) % jnp.int32(_s)
+                    )
+                    return out
+
+                salted = carry_cols + ["#salt"]
+                stage.ops.append(StageOp("select", dict(slot=slot, fn=_add_salt)))
+                stage.ops.append(
+                    StageOp("group_reduce", dict(slot=slot, keys=salted, aggs=partial))
+                )
+                stage.ops.append(StageOp(
+                    "exchange_hash",
+                    dict(slot=slot, keys=eq_cols + ["#salt"],
+                         tree=dict(keys=salted, aggs=final)),
+                ))
+                stage.ops.append(StageOp("resize", dict(slot=slot, factor=stage.growth)))
+                stage.ops.append(
+                    StageOp("group_reduce", dict(slot=slot, keys=salted, aggs=final))
+                )
+            else:
+                stage.ops.append(
+                    StageOp("group_reduce", dict(slot=slot, keys=carry_cols, aggs=partial))
+                )
             if need_exchange:
                 stage.ops.append(StageOp(
                     "exchange_hash",

@@ -341,3 +341,88 @@ def test_default_if_empty_then_join_repartitions(ctx, dbg):
 
     check(q(ctx), q(dbg))
     assert q(ctx)["rv"].tolist() == [1.5]
+
+
+def test_with_rank_global_order(mesh8, rng):
+    from dryad_tpu import DryadContext
+
+    ctx = DryadContext(num_partitions_=8)
+    v = rng.standard_normal(500).astype(np.float32)
+    out = (
+        ctx.from_arrays({"v": v})
+        .order_by([("v", False)])
+        .with_rank("idx")
+        .collect()
+    )
+    order = np.argsort(out["idx"])
+    np.testing.assert_allclose(out["v"][order], np.sort(v), rtol=1e-6)
+    assert sorted(out["idx"].tolist()) == list(range(500))
+
+
+def test_with_rank_localdebug_matches(rng):
+    from dryad_tpu import DryadContext
+
+    v = np.arange(40, dtype=np.float32)
+    dev = (
+        DryadContext(num_partitions_=8)
+        .from_arrays({"v": v}).with_rank("i").collect()
+    )
+    dbg = (
+        DryadContext(local_debug=True)
+        .from_arrays({"v": v}).with_rank("i").collect()
+    )
+    assert sorted(dev["i"].tolist()) == sorted(dbg["i"].tolist())
+    # ranks follow engine order: v == i for identity ingest
+    m = {i: vv for i, vv in zip(dbg["i"], dbg["v"])}
+    assert all(m[i] == float(i) for i in m)
+
+
+def test_with_rank_name_collision(rng):
+    from dryad_tpu import DryadContext
+
+    ctx = DryadContext(num_partitions_=8)
+    q = ctx.from_arrays({"v": np.arange(8, dtype=np.float32)})
+    with pytest.raises(ValueError):
+        q.with_rank("v")
+
+
+def test_salted_group_by_matches_oracle(mesh8, rng):
+    from dryad_tpu import DryadContext
+    import collections
+
+    ctx = DryadContext(num_partitions_=8)
+    # 90% of rows share one heavy key.
+    heavy = np.zeros(1800, np.int32)
+    rest = rng.integers(1, 40, 200).astype(np.int32)
+    k = np.concatenate([heavy, rest])
+    v = rng.standard_normal(len(k)).astype(np.float32)
+    out = (
+        ctx.from_arrays({"k": k, "v": v})
+        .group_by("k", {"s": ("sum", "v"), "c": ("count", None),
+                        "m": ("mean", "v")}, salt=4)
+        .order_by([("k", False)])
+        .collect()
+    )
+    sums = collections.defaultdict(float)
+    cnt = collections.Counter()
+    for kk, vv in zip(k, v):
+        sums[int(kk)] += float(vv)
+        cnt[int(kk)] += 1
+    keys = sorted(sums)
+    assert out["k"].tolist() == keys
+    assert out["c"].tolist() == [cnt[x] for x in keys]
+    np.testing.assert_allclose(out["s"], [sums[x] for x in keys], rtol=2e-4)
+    np.testing.assert_allclose(
+        out["m"], [sums[x] / cnt[x] for x in keys], rtol=2e-4
+    )
+
+
+def test_salt_validation():
+    from dryad_tpu import DryadContext
+
+    ctx = DryadContext(num_partitions_=8)
+    q = ctx.from_arrays({"k": np.zeros(8, np.int32)})
+    with pytest.raises(ValueError):
+        q.group_by("k", {"c": ("count", None)}, salt=1)
+    with pytest.raises(ValueError):
+        q.group_by("k", {"c": ("count", None)}, salt=4, dense=8)

@@ -136,3 +136,25 @@ def test_profiler_trace_written(tmp_path, rng):
     for root, _dirs, files in os.walk(pdir):
         found += files
     assert found, "profiler produced no trace files"
+
+
+def test_profiler_with_do_while(tmp_path, rng):
+    import numpy as np
+    from dryad_tpu import DryadConfig, DryadContext
+
+    ctx = DryadContext(
+        num_partitions_=8,
+        config=DryadConfig(profile_dir=str(tmp_path / "p2")),
+    )
+    q = ctx.from_arrays({"v": np.ones(64, np.float32)})
+
+    def body(b):
+        return b.select(lambda c: {"v": c["v"] * 2.0})
+
+    def cond(b):
+        return b.aggregate_as_query({"m": ("max", "v")}).select(
+            lambda cols: {"go": cols["m"] < 8.0}
+        )
+
+    out = q.do_while(body, cond, max_iter=10).collect()
+    assert float(out["v"][0]) == 8.0
