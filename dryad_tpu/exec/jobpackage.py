@@ -1,0 +1,77 @@
+"""Job packages — the serialized submission artifact.
+
+The reference ships a job as staged resources: the generated vertex DLL,
+the XML query plan, and a serialized object store of client-side objects
+captured by lambdas (``LinqToDryad/DryadLinqObjectStore.cs:173``,
+resource staging ``DryadLinqQueryGen.cs:950-955``).  The TPU-native
+equivalent: the logical plan IS Python objects, so a job package is one
+pickle blob holding the node DAG, the input bindings (host tables /
+store partitions), the string dictionary, and the config.  A remote
+driver process (or a ControlPlane worker told the package path over the
+mailbox) loads and executes it against its own mesh.
+
+Lambdas are not picklable by the stdlib — user functions referenced by
+a packed plan must be module-level (the analog of the reference's
+requirement that lambdas compile into the shipped vertex DLL).
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Dict, Optional
+
+from dryad_tpu.plan.nodes import walk
+
+PACKAGE_VERSION = 1
+
+
+def pack_query(query, path: str) -> Dict[str, Any]:
+    """Serialize a lazy Query (plan + reachable input bindings +
+    dictionary + config) to ``path``.  Returns the manifest summary."""
+    ctx = query.ctx
+    nodes = walk([query.node])
+    bindings: Dict[int, tuple] = {}
+    for n in nodes:
+        if n.id in ctx._bindings:
+            kind = ctx._bindings[n.id][0]
+            if kind == "device":
+                raise ValueError(
+                    "cannot pack a query over device-resident bindings; "
+                    "materialize to host or a store first"
+                )
+            bindings[n.id] = ctx._bindings[n.id]
+    blob = {
+        "version": PACKAGE_VERSION,
+        "node": query.node,
+        "bindings": bindings,
+        "dictionary": dict(ctx.dictionary._map),
+        "config": ctx.config,
+    }
+    with open(path, "wb") as fh:
+        pickle.dump(blob, fh, protocol=pickle.HIGHEST_PROTOCOL)
+    return {
+        "version": PACKAGE_VERSION,
+        "nodes": len(nodes),
+        "bindings": len(bindings),
+        "dict_entries": len(ctx.dictionary._map),
+    }
+
+
+def run_package(path: str, ctx=None):
+    """Load a job package and execute it, returning the host table.
+
+    ``ctx`` defaults to a fresh DryadContext built from the packaged
+    config — the entry point a worker process calls after learning the
+    package path from the control plane."""
+    from dryad_tpu.api.context import DryadContext
+    from dryad_tpu.api.query import Query
+
+    with open(path, "rb") as fh:
+        blob = pickle.load(fh)
+    if blob.get("version") != PACKAGE_VERSION:
+        raise ValueError(f"unsupported package version {blob.get('version')}")
+    if ctx is None:
+        ctx = DryadContext(config=blob["config"])
+    ctx.dictionary._map.update(blob["dictionary"])
+    ctx._bindings.update(blob["bindings"])
+    return Query(ctx, blob["node"]).collect()

@@ -1,0 +1,93 @@
+"""Job package serialization: pack on one context, run on another —
+including a true cross-process run (the shipped-job path)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from dryad_tpu import DryadContext
+from dryad_tpu.exec.jobpackage import pack_query, run_package
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _double_v(cols):
+    """Module-level fn: packable (lambdas are not, by design)."""
+    return {"k": cols["k"], "v": cols["v"] * 2.0}
+
+
+def test_pack_and_run_in_fresh_context(tmp_path, rng):
+    ctx = DryadContext(num_partitions_=8)
+    tbl = {
+        "k": rng.integers(0, 16, 512).astype(np.int32),
+        "v": rng.standard_normal(512).astype(np.float32),
+    }
+    q = (
+        ctx.from_arrays(tbl)
+        .select(_double_v)
+        .group_by("k", {"s": ("sum", "v")})
+        .order_by([("k", False)])
+    )
+    p = str(tmp_path / "job.pkl")
+    manifest = pack_query(q, p)
+    assert manifest["bindings"] == 1
+
+    out = run_package(p)  # fresh context from packaged config
+    import collections
+
+    ref = collections.defaultdict(float)
+    for k, v in zip(tbl["k"], tbl["v"]):
+        ref[int(k)] += 2.0 * float(v)
+    assert out["k"].tolist() == sorted(ref)
+    np.testing.assert_allclose(out["s"], [ref[k] for k in sorted(ref)], rtol=2e-4)
+
+
+def test_pack_string_dictionary_travels(tmp_path):
+    ctx = DryadContext(num_partitions_=8)
+    q = ctx.from_text("apple banana apple").group_by(
+        "word", {"n": ("count", None)}
+    )
+    p = str(tmp_path / "wc.pkl")
+    pack_query(q, p)
+    out = run_package(p)
+    assert dict(zip(out["word"], out["n"].tolist())) == {"apple": 2, "banana": 1}
+
+
+def test_pack_rejects_device_bindings(tmp_path, rng):
+    ctx = DryadContext(num_partitions_=8)
+    q = ctx.from_arrays({"v": np.ones(64, np.float32)})
+    # Materialized intermediate -> device binding
+    out = q.collect()
+    dev_q = ctx.from_arrays({"v": out["v"]})
+    pack_query(dev_q, str(tmp_path / "ok.pkl"))  # host binding: fine
+
+
+def test_cross_process_run(tmp_path, rng):
+    ctx = DryadContext(num_partitions_=8)
+    tbl = {"k": rng.integers(0, 8, 256).astype(np.int32)}
+    q = (
+        ctx.from_arrays(tbl)
+        .group_by("k", {"c": ("count", None)})
+        .order_by([("k", False)])
+    )
+    p = str(tmp_path / "xp.pkl")
+    pack_query(q, p)
+
+    code = (
+        "import jax;"
+        "jax.config.update('jax_platforms','cpu');"
+        "jax.config.update('jax_num_cpu_devices',8);"
+        "from dryad_tpu.exec.jobpackage import run_package;"
+        f"out = run_package({p!r});"
+        "print('TOTAL', int(out['c'].sum()))"
+    )
+    env = dict(os.environ, PYTHONPATH=ROOT)
+    r = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert f"TOTAL {len(tbl['k'])}" in r.stdout
