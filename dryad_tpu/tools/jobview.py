@@ -223,12 +223,80 @@ def render(job: JobInfo) -> str:
     return "\n".join(lines)
 
 
+def render_html(job: JobInfo) -> str:
+    """Standalone HTML report (the JobBrowser GUI analog): stage table
+    with duration bars, status badges, and the diagnosis list."""
+    import html as H
+
+    status = "FAILED" if job.failed else ("OK" if job.completed else "INCOMPLETE")
+    color = {"FAILED": "#c0392b", "OK": "#1e8449", "INCOMPLETE": "#b9770e"}[status]
+    max_s = max((s.seconds for s in job.stages.values()), default=0.0) or 1.0
+    rows = []
+    for s in sorted(job.stages.values(), key=lambda s: s.id):
+        state = "not done"
+        if s.completed:
+            state = "checkpoint" if s.from_checkpoint else "done"
+        bar = int(100 * s.seconds / max_s)
+        flags = []
+        if s.failures:
+            flags.append(f"{s.failures} fail")
+        if s.overflows:
+            flags.append(f"{s.overflows} ovfl (boost {s.max_boost}x)")
+        if s.stragglers:
+            flags.append(f"{s.stragglers} slow")
+        rows.append(
+            f"<tr><td>{s.id}</td><td><code>{H.escape(s.name)}</code></td>"
+            f"<td>{s.versions}</td>"
+            f"<td><div style='background:#d6eaf8;width:{bar}%;"
+            f"min-width:2px;padding:1px 3px'>{s.seconds:.3f}s</div></td>"
+            f"<td>{H.escape(', '.join(flags) or '—')}</td>"
+            f"<td>{H.escape(state)}</td></tr>"
+        )
+    diag = "".join(f"<li>{H.escape(d)}</li>" for d in diagnose(job))
+    return f"""<!doctype html><html><head><meta charset="utf-8">
+<title>dryad_tpu job report</title>
+<style>
+body{{font-family:system-ui,sans-serif;margin:2em;max-width:70em}}
+table{{border-collapse:collapse;width:100%}}
+td,th{{border:1px solid #ccc;padding:4px 8px;text-align:left;font-size:14px}}
+th{{background:#f2f3f4}}
+.badge{{display:inline-block;padding:2px 10px;border-radius:4px;
+color:#fff;background:{color};font-weight:600}}
+</style></head><body>
+<h1>Job report <span class="badge">{status}</span></h1>
+<p>stages {len(job.stages)}/{job.n_stages_declared or "?"}
+ · wall {job.wall_seconds:.3f}s
+{f" · do_while iterations {job.do_while_iters}" if job.do_while_iters else ""}</p>
+<table><tr><th>id</th><th>stage</th><th>versions</th><th>duration</th>
+<th>flags</th><th>state</th></tr>
+{"".join(rows)}
+</table>
+<h2>Diagnosis</h2><ul>{diag}</ul>
+</body></html>"""
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = argv if argv is not None else sys.argv[1:]
+    html_out: Optional[str] = None
+    if "--html" in argv:
+        i = argv.index("--html")
+        try:
+            html_out = argv[i + 1]
+        except IndexError:
+            print("--html requires an output path")
+            return 2
+        argv = argv[:i] + argv[i + 2 :]
     if len(argv) != 1:
-        print("usage: python -m dryad_tpu.tools.jobview <events.jsonl>")
+        print(
+            "usage: python -m dryad_tpu.tools.jobview [--html out.html] "
+            "<events.jsonl>"
+        )
         return 2
     job = build_job(EventLog.load(argv[0]))
+    if html_out:
+        with open(html_out, "w") as fh:
+            fh.write(render_html(job))
+        print(f"wrote {html_out}")
     print(render(job))
     return 0 if job.ok else 1
 

@@ -158,3 +158,27 @@ def test_profiler_with_do_while(tmp_path, rng):
 
     out = q.do_while(body, cond, max_iter=10).collect()
     assert float(out["v"][0]) == 8.0
+
+
+def test_jobview_html_report(tmp_path, rng):
+    import numpy as np
+    from dryad_tpu import DryadConfig, DryadContext
+    from dryad_tpu.exec.events import EventLog
+    from dryad_tpu.tools.jobview import build_job, render_html, main
+
+    ldir = str(tmp_path / "logs")
+    ctx = DryadContext(
+        num_partitions_=8, config=DryadConfig(event_log_dir=ldir)
+    )
+    tbl = {"k": rng.integers(0, 8, 128).astype(np.int32)}
+    ctx.from_arrays(tbl).group_by("k", {"c": ("count", None)}).collect()
+
+    import os
+    logs = [os.path.join(ldir, f) for f in os.listdir(ldir)]
+    job = build_job(EventLog.load(logs[0]))
+    html = render_html(job)
+    assert "<html>" in html and "Diagnosis" in html and "OK" in html
+
+    out = str(tmp_path / "report.html")
+    assert main(["--html", out, logs[0]]) == 0
+    assert os.path.exists(out)
