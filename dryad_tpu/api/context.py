@@ -83,6 +83,9 @@ class DryadContext:
         self.platform = platform
         self.dictionary = StringDictionary()
         self._bindings: Dict[int, tuple] = {}
+        # Column-name -> TypeCodec for custom user types (the
+        # IDryadLinqSerializer hook, columnar/codecs.py).
+        self._codecs: Dict[str, object] = {}
         self._binding_fp_cache: Dict[int, Optional[str]] = {}
         if local_debug:
             self.mesh = None
@@ -129,8 +132,18 @@ class DryadContext:
         arrays: Dict[str, np.ndarray],
         schema: Optional[Schema] = None,
         partition_capacity: Optional[int] = None,
+        codecs: Optional[Dict[str, object]] = None,
     ) -> Query:
-        """Create a table from host arrays (reference FromEnumerable)."""
+        """Create a table from host arrays (reference FromEnumerable).
+
+        ``codecs``: column name -> ``columnar.codecs.TypeCodec`` for
+        custom user types; each coded column expands into typed device
+        columns at ingest and folds back at egress."""
+        if codecs:
+            from dryad_tpu.columnar.codecs import expand_arrays
+
+            arrays = expand_arrays(arrays, codecs)
+            self._codecs.update(codecs)
         schema = schema or _infer_schema(arrays)
         node = Node(
             "input", [], schema, PartitionInfo.roundrobin(),
@@ -289,7 +302,12 @@ class DryadContext:
             interp = LocalDebugInterpreter(self)
             return interp.run_to_logical(query.node)
         batch = self._execute_device(query)
-        return batch.to_numpy(query.schema, self.dictionary)
+        table = batch.to_numpy(query.schema, self.dictionary)
+        if self._codecs:
+            from dryad_tpu.columnar.codecs import collapse_table
+
+            table = collapse_table(table, self._codecs)
+        return table
 
     def submit(self, query: Query) -> JobHandle:
         return JobHandle(self.run_to_host(query))
