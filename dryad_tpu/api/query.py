@@ -844,6 +844,16 @@ class Query:
         Submit+enumerate path, ``DryadLinqQuery.cs:608``)."""
         return self.ctx.run_to_host(self)
 
+    def __iter__(self):
+        """Enumerating a query triggers execution and yields row dicts
+        (reference TableEnumerator, ``DryadLinqQuery.cs:608-647``:
+        foreach on a query submits the job and streams the output)."""
+        table = self.collect()
+        names = list(table.keys())
+        n = len(table[names[0]]) if names else 0
+        for i in range(n):
+            yield {c: table[c][i] for c in names}
+
     def submit(self) -> "JobHandle":
         return self.ctx.submit(self)
 

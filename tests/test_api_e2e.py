@@ -286,3 +286,9 @@ def test_hash_partition_elides_second_shuffle(ctx):
     assert kinds.count("exchange_hash") == 1  # only the explicit partition
     got = q.collect()
     assert got["n"].sum() == 50
+
+
+def test_query_iteration_triggers_job(ctx):
+    tbl = {"k": np.arange(10, dtype=np.int32)}
+    rows = list(ctx.from_arrays(tbl).where(lambda c: c["k"] < 3))
+    assert sorted(r["k"] for r in rows) == [0, 1, 2]
