@@ -14,9 +14,15 @@ Prints ONE JSON line:
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import numpy as np
+
+
+def log(msg: str) -> None:
+    """Progress to stderr; stdout stays reserved for the ONE JSON line."""
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
 def device_rows_per_sec(n: int = 1 << 22, keys: int = 1 << 12, iters: int = 8) -> float:
@@ -46,12 +52,16 @@ def device_rows_per_sec(n: int = 1 << 22, keys: int = 1 << 12, iters: int = 8) -
 
         return jax.lax.fori_loop(0, iters_arr, body, jnp.float32(0.0))
 
+    log(f"device={jax.devices()[0]} n={n} keys={keys}")
     fn = jax.jit(run, static_argnums=2)
     data = {"k": jnp.asarray(k), "v": jnp.asarray(v)}
     valid = jnp.ones((n,), jnp.bool_)
+    t0 = time.perf_counter()
     float(fn(data, valid, 1))  # compile + warm
-
+    log(f"compiled short variant in {time.perf_counter()-t0:.1f}s")
+    t0 = time.perf_counter()
     float(fn(data, valid, iters + 1))  # compile the long variant too
+    log(f"compiled long variant in {time.perf_counter()-t0:.1f}s")
 
     t0 = time.perf_counter()
     float(fn(data, valid, 1))
@@ -81,7 +91,9 @@ def host_baseline_rows_per_sec(n: int = 1 << 20, keys: int = 1 << 12) -> float:
 
 def main() -> None:
     value = device_rows_per_sec()
+    log(f"device: {value:.3e} rows/s")
     baseline = host_baseline_rows_per_sec()
+    log(f"host baseline: {baseline:.3e} rows/s")
     print(
         json.dumps(
             {
