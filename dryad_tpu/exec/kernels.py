@@ -443,37 +443,66 @@ def _k_zip(ctx: StageContext, p) -> None:
 
 
 def _k_sliding_window(ctx: StageContext, p) -> None:
-    """Windows over the global row sequence with a cross-partition halo:
-    each partition receives the first (size-1) rows of its successor via
-    ppermute and places them right after its own dense prefix."""
+    """Windows over the global row sequence with a cross-partition halo.
+
+    Ring pass (the sequence-parallel halo-exchange pattern): each
+    partition's (size-1)-row prefix rotates backward one step per hop
+    for P-1 hops, so every partition observes the prefixes of ALL its
+    successors — windows may span any number of (possibly empty)
+    partitions.  Arrived rows are compacted valid-first in arrival
+    order (= global row order) and the first size-1 fill the halo."""
     b = ctx.slots[p["slot"]].compact()
     w = int(p["size"])
     cap = b.capacity
     n_loc = jnp.sum(b.valid.astype(jnp.int32))
-    perm = [(i, i - 1) for i in range(1, ctx.P)]
 
-    ext_len = cap + w - 1
+    need = w - 1
+    halo_v = None
+    halo_cols: Dict[str, jax.Array] = {}
+    if need > 0 and ctx.P > 1:
+        perm = [(i, i - 1) for i in range(1, ctx.P)]  # no wrap: sequence ends
+        work_v = b.valid[:need]
+        work_cols = {c: b.data[c][:need] for c in p["cols"]}
+        arrived_v: List[jax.Array] = []
+        arrived_cols: Dict[str, List[jax.Array]] = {c: [] for c in p["cols"]}
+        for _hop in range(ctx.P - 1):
+            work_v = jax.lax.ppermute(work_v, ctx.axes, perm)
+            work_cols = {
+                c: jax.lax.ppermute(col, ctx.axes, perm)
+                for c, col in work_cols.items()
+            }
+            arrived_v.append(work_v)
+            for c in p["cols"]:
+                arrived_cols[c].append(work_cols[c])
+        all_v = jnp.concatenate(arrived_v)
+        # Stable sort by invalid flag keeps arrival (= global row) order
+        # among valid rows; take the first `need` as the halo.
+        operands = [all_v.astype(jnp.uint32) ^ jnp.uint32(1)] + [
+            jnp.concatenate(arrived_cols[c]) for c in p["cols"]
+        ] + [all_v]
+        sorted_ops = jax.lax.sort(
+            tuple(operands), num_keys=1, is_stable=True
+        )
+        halo_v = sorted_ops[-1][:need]
+        for i, c in enumerate(p["cols"]):
+            halo_cols[c] = sorted_ops[1 + i][:need]
+
+    ext_len = cap + max(need, 0)
     out_cols: Dict[str, jax.Array] = {}
-    # Halo of validity first (same construction as data columns).
-    halo_v = jax.lax.ppermute(b.valid[: w - 1], ctx.axes, perm) if w > 1 else None
     ext_v = jnp.zeros((ext_len,), jnp.bool_)
     ext_v = jax.lax.dynamic_update_slice(ext_v, b.valid, (0,))
-    if w > 1:
+    if halo_v is not None:
         ext_v = jax.lax.dynamic_update_slice(ext_v, halo_v, (n_loc,))
-    # A window is valid when all its rows are; windows needing rows from
-    # beyond the immediate successor partition (successor holding fewer
-    # than size-1 rows) are dropped — documented engine limitation.
     win_valid = jnp.ones((cap,), jnp.bool_)
     for j in range(w):
         win_valid = win_valid & ext_v[j : j + cap]
 
     for c in p["cols"]:
         col = b.data[c]
-        halo = jax.lax.ppermute(col[: w - 1], ctx.axes, perm) if w > 1 else None
         ext = jnp.zeros((ext_len,), col.dtype)
         ext = jax.lax.dynamic_update_slice(ext, col, (0,))
-        if w > 1:
-            ext = jax.lax.dynamic_update_slice(ext, halo, (n_loc,))
+        if halo_v is not None:
+            ext = jax.lax.dynamic_update_slice(ext, halo_cols[c], (n_loc,))
         for j in range(w):
             out_cols[f"{c}_w{j}"] = ext[j : j + cap]
 

@@ -161,3 +161,12 @@ def test_hybrid_decomposable_tree(hctx, rng):
         ref[int(k)] += float(v) ** 2
     assert out["k"].tolist() == sorted(ref)
     np.testing.assert_allclose(out["ss"], [ref[k] for k in sorted(ref)], rtol=2e-4)
+
+
+def test_hybrid_sliding_window_ring(hctx):
+    tbl = {"x": np.arange(24, dtype=np.int32)}
+    got = hctx.from_arrays(tbl).sliding_window(10, "x").collect()
+    rows = sorted(zip(*[got[f"x_w{j}"] for j in range(10)]))
+    assert [tuple(int(v) for v in r) for r in rows] == [
+        tuple(range(i, i + 10)) for i in range(15)
+    ]

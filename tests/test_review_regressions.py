@@ -223,3 +223,42 @@ def test_take_negative_is_empty(ctx, dbg):
     assert len(got["x"]) == 0
     got0 = ctx.from_arrays(tbl).take(0).collect()
     assert len(got0["x"]) == 0
+
+
+def test_sliding_window_spans_multiple_partitions(ctx, dbg):
+    # w-1 = 5 > rows per partition (40/8 = 5 dense, but filtering leaves
+    # sparse partitions) -> windows must cross several partitions.
+    tbl = {"x": np.arange(40, dtype=np.int32)}
+
+    def q(c):
+        return (
+            c.from_arrays(tbl)
+            .where(lambda cols: cols["x"] % 3 != 1)  # ragged partitions
+            .sliding_window(6, "x")
+            .collect()
+        )
+
+    got = q(ctx)
+    xs = [x for x in range(40) if x % 3 != 1]
+    expect = sorted(
+        tuple(xs[i + j] for j in range(6)) for i in range(len(xs) - 5)
+    )
+    rows = sorted(zip(*[got[f"x_w{j}"] for j in range(6)]))
+    assert [tuple(int(v) for v in r) for r in rows] == expect
+    check(q(ctx), q(dbg))
+
+
+def test_sliding_window_wider_than_partition(ctx, dbg):
+    # Window of 12 over 8 partitions of ~3 rows each: halo needs 11 rows
+    # from up to 4 successor partitions.
+    tbl = {"x": np.arange(24, dtype=np.int32)}
+
+    def q(c):
+        return c.from_arrays(tbl).sliding_window(12, "x").collect()
+
+    got = q(ctx)
+    rows = sorted(zip(*[got[f"x_w{j}"] for j in range(12)]))
+    assert [tuple(int(v) for v in r) for r in rows] == [
+        tuple(range(i, i + 12)) for i in range(13)
+    ]
+    check(q(ctx), q(dbg))
