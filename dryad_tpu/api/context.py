@@ -126,6 +126,23 @@ class DryadContext:
                 subquery_runner=self._run_subquery,
             )
 
+    def rebuild_mesh(self, exclude_device_ids) -> None:
+        """Elastic recovery: shrink the mesh past failed devices and
+        rebuild the executor (reference: dynamic computer set +
+        requeue-with-exclusion, ``Interfaces.cs:336-343``).  Device-
+        resident bindings are dropped — re-ingest or resume stages from
+        the checkpoint store; host/store bindings survive."""
+        from dryad_tpu.parallel.mesh import exclude_devices
+
+        self.mesh = exclude_devices(self.mesh, exclude_device_ids)
+        self._bindings = {
+            nid: b for nid, b in self._bindings.items() if b[0] != "device"
+        }
+        self.executor = GraphExecutor(
+            self.mesh, self.config, self.events,
+            subquery_runner=self._run_subquery,
+        )
+
     # -- ingestion ----------------------------------------------------------
     def from_arrays(
         self,

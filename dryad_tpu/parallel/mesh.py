@@ -84,6 +84,19 @@ def make_hybrid_mesh(
     return Mesh(arr, (DCN_AXIS, AXIS))
 
 
+def exclude_devices(mesh: Mesh, bad_ids) -> Mesh:
+    """Rebuild a flat mesh without the excluded device ids — the elastic
+    recovery step (reference: the computer set "may change as failures
+    occur", ``Interfaces.cs:336-343``; failed-process requeue with
+    exclusion).  The caller re-runs affected stages from checkpoints on
+    the smaller mesh."""
+    bad = set(bad_ids)
+    keep = [d for d in mesh.devices.flat if d.id not in bad]
+    if not keep:
+        raise ValueError("excluding all devices leaves an empty mesh")
+    return Mesh(np.array(keep), (AXIS,))
+
+
 def mesh_axes(mesh: Mesh) -> tuple:
     """The mesh's partition axes, outermost first — ("p",) for a flat
     mesh, (DCN_AXIS, AXIS) for a hybrid one.  Collectives over this

@@ -134,3 +134,32 @@ def test_do_while_compiles_body_once(mesh8):
     n_after = len(ctx.executor._compiled)
     # body+cond compile once each (plus ingestion/egress stages), not per-iteration
     assert n_after <= 6, f"do_while recompiled per iteration: {n_after} programs"
+
+
+def test_elastic_mesh_rebuild_after_exclusion(rng):
+    """Failed-device exclusion: shrink the mesh, re-run on survivors
+    (the requeue-with-exclusion recovery flow)."""
+    import jax
+    from dryad_tpu import DryadContext
+    from dryad_tpu.parallel.mesh import num_partitions
+
+    ctx = DryadContext(num_partitions_=8)
+    tbl = {"k": rng.integers(0, 16, 512).astype(np.int32)}
+    before = ctx.from_arrays(tbl).group_by("k", {"c": ("count", None)}).collect()
+
+    bad = [d.id for d in jax.devices()[:2]]
+    ctx.rebuild_mesh(bad)
+    assert num_partitions(ctx.mesh) == 6
+    after = ctx.from_arrays(tbl).group_by("k", {"c": ("count", None)}).collect()
+    assert sorted(zip(before["k"], before["c"])) == sorted(
+        zip(after["k"], after["c"])
+    )
+
+
+def test_exclude_all_devices_rejected():
+    import jax
+    from dryad_tpu import DryadContext
+
+    ctx = DryadContext(num_partitions_=8)
+    with pytest.raises(ValueError):
+        ctx.rebuild_mesh([d.id for d in jax.devices()])
