@@ -76,6 +76,37 @@ def explain_stages(graph: StageGraph) -> str:
     return "\n".join(lines)
 
 
+def explain_dot(query) -> str:
+    """Graphviz DOT of the fused stage graph (the JobBrowser DAG-drawing
+    analog, ``JobBrowser/Tools/drawingSurface.cs`` — emitted as DOT so
+    any renderer can draw it; exchanges are marked on the node)."""
+    from dryad_tpu.plan.lower import lower
+
+    graph = lower([query.node], query.ctx.config)
+    lines = [
+        "digraph stages {",
+        "  rankdir=TB; node [shape=box, fontname=\"monospace\", fontsize=10];",
+    ]
+    inputs = set()
+    for s in graph.stages:
+        n_ex = sum(1 for op in s.ops if op.kind in _EXCHANGE_OPS)
+        label = s.name + (f"\\n{n_ex} exchange(s)" if n_ex else "")
+        style = ', style=filled, fillcolor="#d6eaf8"' if n_ex else ""
+        lines.append(f'  s{s.id} [label="{label}"{style}];')
+        for ref, idx in s.input_refs:
+            if ref == "plan_input":
+                if idx not in inputs:
+                    inputs.add(idx)
+                    lines.append(
+                        f'  in{idx} [label="input#{idx}", shape=ellipse];'
+                    )
+                lines.append(f"  in{idx} -> s{s.id};")
+            else:
+                lines.append(f'  s{ref} -> s{s.id} [label="out{idx}"];')
+    lines.append("}")
+    return "\n".join(lines)
+
+
 def explain(query) -> str:
     """Full explain text for an API ``Query`` (logical + fused stages)."""
     from dryad_tpu.plan.lower import lower

@@ -182,3 +182,19 @@ def test_jobview_html_report(tmp_path, rng):
     out = str(tmp_path / "report.html")
     assert main(["--html", out, logs[0]]) == 0
     assert os.path.exists(out)
+
+
+def test_explain_dot(rng):
+    import numpy as np
+    from dryad_tpu import DryadContext
+    from dryad_tpu.tools.explain import explain_dot
+
+    ctx = DryadContext(num_partitions_=8)
+    q = (
+        ctx.from_arrays({"k": rng.integers(0, 8, 64).astype(np.int32)})
+        .group_by("k", {"c": ("count", None)})
+        .order_by([("k", False)])
+    )
+    dot = explain_dot(q)
+    assert dot.startswith("digraph stages {") and dot.endswith("}")
+    assert "exchange(s)" in dot and "in" in dot
