@@ -79,6 +79,7 @@ def write_store(
     schema: Schema,
     dictionary: Optional[StringDictionary] = None,
     compression: Optional[str] = None,
+    threads: int = 4,
 ) -> None:
     os.makedirs(path, exist_ok=True)
     manifest = {
@@ -93,11 +94,29 @@ def write_store(
         with open(os.path.join(path, DICTFILE), "w") as fh:
             json.dump({format(h, "016x"): s for h, s in dictionary.items()}, fh)
     # Native writer compresses columns on a thread pool when available
-    # (falls back to write_partition_file).
+    # (falls back to write_partition_file); partitions additionally
+    # write concurrently — the async channel-writer analog
+    # (channelbuffernativewriter.cpp), GIL released inside ctypes.
+    from concurrent.futures import ThreadPoolExecutor
+
     from dryad_tpu.runtime.bindings import write_partition
 
-    for i, cols in enumerate(partitions):
-        write_partition(os.path.join(path, _part_name(i)), cols, compression)
+    if threads <= 1 or len(partitions) <= 1:
+        for i, cols in enumerate(partitions):
+            write_partition(
+                os.path.join(path, _part_name(i)), cols, compression
+            )
+        return
+    with ThreadPoolExecutor(max_workers=min(threads, len(partitions))) as ex:
+        futs = [
+            ex.submit(
+                write_partition,
+                os.path.join(path, _part_name(i)), cols, compression,
+            )
+            for i, cols in enumerate(partitions)
+        ]
+        for f in futs:
+            f.result()
 
 
 def read_store(

@@ -99,8 +99,11 @@ class PartfileProvider(DataProvider):
     def read(self, path: str) -> ReadResult:
         return CIO.read_store(path)
 
-    def write(self, path, partitions, schema, dictionary, compression):
-        CIO.write_store(path, partitions, schema, dictionary, compression)
+    def write(self, path, partitions, schema, dictionary, compression,
+              threads: int = 4):
+        CIO.write_store(
+            path, partitions, schema, dictionary, compression, threads
+        )
 
 
 class TextFileProvider(DataProvider):
