@@ -124,6 +124,7 @@ class DryadContext:
             self.executor = GraphExecutor(
                 self.mesh, self.config, self.events,
                 subquery_runner=self._run_subquery,
+                loop_lowerer=self._lower_loop_stage,
             )
 
     def rebuild_mesh(self, exclude_device_ids) -> None:
@@ -141,6 +142,7 @@ class DryadContext:
         self.executor = GraphExecutor(
             self.mesh, self.config, self.events,
             subquery_runner=self._run_subquery,
+            loop_lowerer=self._lower_loop_stage,
         )
 
     # -- ingestion ----------------------------------------------------------
@@ -367,6 +369,26 @@ class DryadContext:
         return JobHandle(batch.to_numpy(query.schema, self.dictionary), path)
 
     # -- do_while support ----------------------------------------------------
+    def _lower_loop_stage(self, plan_fn, schema: Schema, example: ColumnBatch):
+        """Lower a do_while body/cond subplan to ONE fused stage for the
+        on-device loop path.  Raises ValueError when the subplan needs
+        more than one stage (multi-consumer / join shapes) — the caller
+        falls back to the driver loop."""
+        q0 = self._from_device_batch(example, schema)
+        out_q = plan_fn(q0)
+        graph = lower([out_q.node], self.config)
+        if len(graph.stages) != 1:
+            raise ValueError(
+                f"subplan lowers to {len(graph.stages)} stages; device "
+                f"loop needs exactly one"
+            )
+        stage = graph.stages[0]
+        if stage.input_refs != [("plan_input", q0.node.id)] or len(
+            stage.out_slots
+        ) != 1:
+            raise ValueError("subplan stage shape unsupported for device loop")
+        return stage, out_q.schema
+
     def _run_subquery(self, plan_fn, schema: Schema, current: ColumnBatch, scalar: bool = False):
         # Build each body/cond plan ONCE per do_while and rebind the input
         # batch on later iterations — re-building would create fresh

@@ -774,13 +774,20 @@ class Query:
         body: Callable[["Query"], "Query"],
         cond: Callable[["Query"], "Query"],
         max_iter: int = 100,
+        device: bool = False,
     ) -> "Query":
         """Iterate body until cond yields False (reference DoWhile,
         ``DryadLinqQueryable.cs:1281``). ``cond`` maps the current
-        dataset to a 1-row bool query (e.g. via count_as_query + select)."""
+        dataset to a 1-row bool query (e.g. via count_as_query + select).
+
+        ``device=True`` compiles the WHOLE loop as one on-device
+        ``lax.while_loop`` (no host round-trip per iteration) when body
+        and cond each lower to a single fused stage and the body
+        preserves batch structure; otherwise it falls back to the
+        driver loop (a ``do_while_device_fallback`` event is logged)."""
         node = Node(
             "do_while", [self.node], self.schema, PartitionInfo(),
-            body=body, cond=cond, max_iter=max_iter,
+            body=body, cond=cond, max_iter=max_iter, device=device,
         )
         return Query(self.ctx, node)
 

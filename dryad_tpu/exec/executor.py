@@ -54,6 +54,7 @@ class GraphExecutor:
         config: Optional[DryadConfig] = None,
         events: Optional[EventLog] = None,
         subquery_runner: Optional[Callable] = None,
+        loop_lowerer: Optional[Callable] = None,
     ):
         self.mesh = mesh
         self.config = config or DryadConfig()
@@ -63,6 +64,7 @@ class GraphExecutor:
         self.stats: Dict[str, StageStatistics] = {}
         # Callback used by do_while stages to run body/cond subplans.
         self.subquery_runner = subquery_runner
+        self.loop_lowerer = loop_lowerer
         self._profiling = False
         self.checkpoints = (
             CheckpointStore(self.config.checkpoint_dir)
@@ -304,6 +306,16 @@ class GraphExecutor:
             raise RuntimeError("do_while requires a subquery_runner (use DryadContext)")
         p = stage.ops[0].params
         (current,) = self._resolve_inputs(stage, bindings, results)
+        if p.get("device") and self.loop_lowerer is not None:
+            try:
+                results[(stage.id, 0)] = self._run_do_while_device(
+                    stage, p, current
+                )
+                return
+            except ValueError as e:
+                self.events.emit(
+                    "do_while_device_fallback", stage=stage.id, reason=str(e)
+                )
         max_iter = p["max_iter"]
         it = 0
         while True:
@@ -317,3 +329,84 @@ class GraphExecutor:
             if not bool(cont):
                 break
         results[(stage.id, 0)] = current
+
+    def _run_do_while_device(self, stage, p, current: ColumnBatch) -> ColumnBatch:
+        """On-device DoWhile: the WHOLE loop compiles as one
+        ``lax.while_loop`` inside one shard_map program — no host
+        round-trip per iteration (the TPU-first upgrade over the
+        reference's GM-evaluated loop, ``DryadLinqQueryNode.cs:4555``).
+
+        Requirements (else ValueError -> driver-loop fallback): body and
+        cond each lower to one fused stage; the body preserves the batch
+        pytree structure (same columns, same capacity).
+        """
+        import jax.numpy as jnp
+
+        body_stage, body_schema = self.loop_lowerer(
+            p["body"], p["schema"], current
+        )
+        cond_stage, cond_schema = self.loop_lowerer(
+            p["cond"], body_schema, current
+        )
+        cond_col = cond_schema.device_names()[0]
+        max_iter = int(p["max_iter"])
+        axes = mesh_axes(self.mesh)
+        axis_sizes = tuple(self.mesh.shape[a] for a in axes)
+
+        boost = 1
+        while True:
+            body_fn = build_stage_fn(
+                body_stage, self.P, self.config.shuffle_slack, boost,
+                axes, axis_sizes,
+            )
+            cond_fn = build_stage_fn(
+                cond_stage, self.P, self.config.shuffle_slack, boost,
+                axes, axis_sizes,
+            )
+
+            def outer(sharded_inputs, _rep):
+                (b0,) = sharded_inputs
+
+                def cond(state):
+                    i, b, ovf = state
+                    couts, (covf,) = cond_fn((b,), ())
+                    go = couts[0].data[cond_col][0].astype(jnp.bool_)
+                    return (i < max_iter) & go & ~(ovf | covf)
+
+                def body(state):
+                    i, b, ovf = state
+                    bouts, (bovf,) = body_fn((b,), ())
+                    return (i + jnp.int32(1), bouts[0], ovf | bovf)
+
+                it, bout, ovf = jax.lax.while_loop(
+                    cond, body, (jnp.int32(0), b0, jnp.zeros((), jnp.bool_))
+                )
+                return (bout,), (ovf, it)
+
+            key = (
+                "do_while_device", self._stage_key(body_stage),
+                self._stage_key(cond_stage), self._shape_key((current,)),
+                max_iter, boost,
+            )
+            fn = self._compiled.get(key)
+            if fn is None:
+                fn = compile_stage(self.mesh, outer)
+                self._compiled[key] = fn
+            self.events.emit(
+                "do_while_device_start", stage=stage.id, boost=boost
+            )
+            (out,), (overflow, iters) = fn((current,), ())
+            if not bool(overflow):
+                self.events.emit(
+                    "do_while_device_done", stage=stage.id, iters=int(iters)
+                )
+                return out
+            self.events.emit(
+                "stage_overflow", stage=stage.id, name=stage.name,
+                version=1, boost=boost,
+            )
+            if boost >= 2 ** self.config.max_shuffle_retries:
+                raise StageFailedError(
+                    f"device do_while still overflowing at boost {boost}"
+                )
+            boost *= 2

@@ -267,6 +267,7 @@ class _Builder:
                         body=node.params["body"],
                         cond=node.params["cond"],
                         max_iter=node.params.get("max_iter", 100),
+                        device=node.params.get("device", False),
                         schema=node.schema,
                     ),
                 )

@@ -163,3 +163,97 @@ def test_exclude_all_devices_rejected():
     ctx = DryadContext(num_partitions_=8)
     with pytest.raises(ValueError):
         ctx.rebuild_mesh([d.id for d in jax.devices()])
+
+
+def _dw_body(q):
+    return q.select(lambda c: {"v": c["v"] * 2.0})
+
+
+def _dw_cond(q):
+    return q.aggregate_as_query({"m": ("max", "v")}).select(
+        lambda cols: {"go": cols["m"] < 100.0}
+    )
+
+
+def test_device_do_while_matches_driver_loop(rng):
+    from dryad_tpu import DryadConfig, DryadContext
+
+    tbl = {"v": np.array([1.0, 2.0, 3.0], np.float32)}
+
+    def run(device):
+        ctx = DryadContext(num_partitions_=8)
+        return ctx.from_arrays(tbl).do_while(
+            _dw_body, _dw_cond, max_iter=20, device=device
+        ).collect()
+
+    a = run(False)
+    b = run(True)
+    assert sorted(a["v"].tolist()) == sorted(b["v"].tolist())
+    # loop semantics: doubles until max >= 100 -> 3*2^6 = 192
+    assert max(b["v"]) == 192.0
+
+
+def test_device_do_while_emits_done_event(tmp_path, rng):
+    import json
+    import os
+    from dryad_tpu import DryadConfig, DryadContext
+
+    ldir = str(tmp_path / "ev")
+    ctx = DryadContext(
+        num_partitions_=8, config=DryadConfig(event_log_dir=ldir)
+    )
+    tbl = {"v": np.array([1.0], np.float32)}
+    ctx.from_arrays(tbl).do_while(
+        _dw_body, _dw_cond, max_iter=20, device=True
+    ).collect()
+    events = []
+    for f in os.listdir(ldir):
+        with open(os.path.join(ldir, f)) as fh:
+            events += [json.loads(l) for l in fh]
+    kinds = {e["kind"] for e in events}
+    assert "do_while_device_done" in kinds, kinds
+    done = [e for e in events if e["kind"] == "do_while_device_done"]
+    assert done[0]["iters"] == 7  # 1 -> 128
+
+
+def _dw_body_multistage(q):
+    # group_by forces a tee-free but... order_by after group_by lowers to
+    # two stages -> must fall back to the driver loop.
+    return (
+        q.group_by("k", {"v": ("sum", "v"), "k2": ("first", "k")})
+        .select(lambda c: {"k": c["k"], "v": c["v"]})
+    )
+
+
+def test_device_do_while_fallback_on_unsupported(tmp_path, rng):
+    import json
+    import os
+    from dryad_tpu import DryadConfig, DryadContext
+
+    ldir = str(tmp_path / "ev2")
+    ctx = DryadContext(
+        num_partitions_=8, config=DryadConfig(event_log_dir=ldir)
+    )
+    tbl = {
+        "k": np.arange(8, dtype=np.int32),
+        "v": np.ones(8, np.float32),
+    }
+
+    def body(q):
+        # zip with itself -> multi-stage subplan
+        return q.zip_(q.select(lambda c: dict(c)))
+
+    def cond(q):
+        return q.aggregate_as_query({"c": ("count", None)}).select(
+            lambda cols: {"go": cols["c"] < 0}
+        )
+
+    out = ctx.from_arrays(tbl).do_while(
+        body, cond, max_iter=3, device=True
+    ).collect()
+    events = []
+    for f in os.listdir(ldir):
+        with open(os.path.join(ldir, f)) as fh:
+            events += [json.loads(l) for l in fh]
+    kinds = {e["kind"] for e in events}
+    assert "do_while_device_fallback" in kinds
