@@ -116,6 +116,31 @@ class CheckpointStore:
         os.replace(tmp, d)
         return d
 
+    def gc(self, retain_seconds: float) -> int:
+        """Drop checkpoints older than the retention lease — the analog
+        of the reference's channel-file retain/lease grace times
+        (``DrProcessTemplate``, ``DrProcess.h:80-89``).  A loaded
+        checkpoint's mtime refreshes on save only; returns the number
+        of entries removed."""
+        import shutil
+        import time as _time
+
+        cutoff = _time.time() - retain_seconds
+        removed = 0
+        for name in os.listdir(self.root):
+            d = os.path.join(self.root, name)
+            meta = os.path.join(d, "meta.json")
+            if not os.path.isdir(d):
+                continue
+            try:
+                ts = os.path.getmtime(meta if os.path.exists(meta) else d)
+                if ts < cutoff:
+                    shutil.rmtree(d)
+                    removed += 1
+            except OSError:  # concurrent removal: fine
+                pass
+        return removed
+
     def load(
         self, stage: Stage, fp: str, mesh
     ) -> Optional[Tuple[ColumnBatch, ...]]:

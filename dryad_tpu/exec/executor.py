@@ -273,6 +273,13 @@ class GraphExecutor:
             )
             for i, out_idx in enumerate(range(len(stage.out_slots))):
                 results[(stage.id, out_idx)] = outs[i]
+            if (
+                self.checkpoints is not None
+                and self.config.checkpoint_retain_seconds is not None
+            ):
+                n = self.checkpoints.gc(self.config.checkpoint_retain_seconds)
+                if n:
+                    self.events.emit("checkpoint_gc", removed=n)
             if self.checkpoints is not None and fp is not None:
                 try:
                     path = self.checkpoints.save(

@@ -85,6 +85,9 @@ class DryadConfig:
     # Stage-output checkpoint directory (durable DCT_File channel
     # analog, SURVEY §5.4); None disables checkpoint/resume.
     checkpoint_dir: Optional[str] = None
+    # Checkpoint retention lease in seconds (channel-file
+    # retain/lease-grace analog, DrProcess.h:80-89); None keeps forever.
+    checkpoint_retain_seconds: Optional[float] = None
     # Thread count for host-side IO (DRYAD_THREADS_PER_WORKER analog).
     io_threads: int = _env_int("DRYAD_TPU_IO_THREADS", 4)
     # Outlier threshold in sigmas for speculative duplication

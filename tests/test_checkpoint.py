@@ -153,3 +153,25 @@ def test_multi_output_fork_checkpoint(mesh8, tmp_path):
     assert [e for e in ctx2.events.events() if e["kind"] == "stage_checkpoint_hit"]
     np.testing.assert_array_equal(sorted(e1["x"]), sorted(e2["x"]))
     np.testing.assert_array_equal(sorted(o1["x"]), sorted(o2["x"]))
+
+
+def test_checkpoint_gc_lease(tmp_path, rng):
+    import os
+    import time
+    from dryad_tpu import DryadConfig, DryadContext
+
+    cdir = str(tmp_path / "ck")
+    cfg = DryadConfig(checkpoint_dir=cdir, checkpoint_retain_seconds=0.2)
+    ctx = DryadContext(num_partitions_=8, config=cfg)
+    tbl = {"k": rng.integers(0, 8, 128).astype(np.int32)}
+    ctx.from_arrays(tbl).group_by("k", {"c": ("count", None)}).collect()
+    n0 = len([d for d in os.listdir(cdir) if os.path.isdir(os.path.join(cdir, d))])
+    assert n0 >= 1
+    time.sleep(0.3)
+    # A fresh query triggers GC of the stale entries before saving.
+    ctx2 = DryadContext(num_partitions_=8, config=cfg)
+    ctx2.from_arrays({"v": np.arange(64, dtype=np.float32)}).where(
+        lambda c: c["v"] > 10
+    ).collect()
+    names = [d for d in os.listdir(cdir) if os.path.isdir(os.path.join(cdir, d))]
+    assert all("group_by" not in n for n in names), names

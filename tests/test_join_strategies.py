@@ -160,3 +160,31 @@ def test_group_join_broadcast_strategy(ctx, dbg):
         )
 
     check(q(ctx, "broadcast"), q(dbg, "shuffle"))
+
+
+def test_group_join_aggregates(ctx, rng):
+    import collections
+
+    left = {"k": np.array([0, 1, 2, 3], np.int32)}
+    right = {
+        "k": rng.integers(0, 3, 50).astype(np.int32),
+        "v": rng.standard_normal(50).astype(np.float32),
+    }
+    out = (
+        ctx.from_arrays(left)
+        .group_join(
+            ctx.from_arrays(right), "k",
+            {"n": ("count", None), "s": ("sum", "v")},
+        )
+        .order_by([("k", False)])
+        .collect()
+    )
+    cnt = collections.Counter(right["k"].tolist())
+    sums = collections.defaultdict(float)
+    for k, v in zip(right["k"], right["v"]):
+        sums[int(k)] += float(v)
+    assert out["k"].tolist() == [0, 1, 2, 3]
+    assert out["n"].tolist() == [cnt[i] for i in range(4)]
+    np.testing.assert_allclose(
+        out["s"], [sums[i] for i in range(4)], rtol=1e-4, atol=1e-5
+    )
