@@ -185,7 +185,7 @@ class DryadContext:
             buf = data.encode("utf-8")
         else:
             buf = bytes(data)
-        h0, h1, r0, starts, lens = RB.tokenize(buf)
+        h0, h1, r0, r1, starts, lens = RB.tokenize(buf)
         hashes = (h1.astype(np.uint64) << np.uint64(32)) | h0.astype(np.uint64)
         uniq, first_idx = np.unique(hashes, return_index=True)
         for h, i in zip(uniq, first_idx):
@@ -201,7 +201,8 @@ class DryadContext:
         )
         self._bindings[node.id] = (
             "host_physical",
-            {f"{column}#h0": h0, f"{column}#h1": h1, f"{column}#r0": r0},
+            {f"{column}#h0": h0, f"{column}#h1": h1,
+             f"{column}#r0": r0, f"{column}#r1": r1},
         )
         return Query(self, node)
 

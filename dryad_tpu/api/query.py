@@ -672,29 +672,6 @@ class Query:
         table = g.project(out_names).collect()
         return {k: (v[0] if len(v) else None) for k, v in table.items()}
 
-    def group_join(
-        self,
-        other: "Query",
-        left_keys: KeyArg,
-        aggs: Dict[str, Tuple[str, Optional[str]]],
-        right_keys: Optional[KeyArg] = None,
-        strategy: str = "shuffle",
-        defaults: Optional[Dict[str, Any]] = None,
-    ) -> "Query":
-        """GroupJoin (reference ``DryadLinqQueryable`` GroupJoin): per
-        left row, aggregates over its matching right rows as new
-        columns.  The result selector over IEnumerable<inner> becomes a
-        dict of builtin aggregates (count/sum/min/max/mean/...) —
-        composed as right.group_by(keys, aggs) then left-outer join, so
-        left rows without matches keep ``defaults`` (0 per dtype).
-        """
-        lk = _keys(left_keys)
-        rk = _keys(right_keys) if right_keys is not None else lk
-        grouped = other.group_by(rk, aggs)
-        return self.left_join(
-            grouped, lk, rk, right_defaults=defaults, strategy=strategy
-        )
-
     def group_join_count(
         self,
         other: "Query",

@@ -175,10 +175,12 @@ class ColumnBatch:
                 strs = [str(s) for s in a]
                 hashes = dictionary.add_all(strs)
                 lo, hi = split64(hashes)
+                sarr = np.array(strs, object)
                 phys = {
                     f"{f.name}#h0": lo,
                     f"{f.name}#h1": hi,
-                    f"{f.name}#r0": string_prefix_rank(np.array(strs, object)),
+                    f"{f.name}#r0": string_prefix_rank(sarr),
+                    f"{f.name}#r1": string_prefix_rank(sarr, offset=4),
                 }
             elif f.ctype == ColumnType.INT64:
                 lo, hi = split64(a.astype(np.int64))

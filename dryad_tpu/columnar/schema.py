@@ -52,23 +52,25 @@ def device_column_names(name: str, ctype: ColumnType) -> List[str]:
     """Physical device-column names backing one logical column.
 
     INT64  -> ``#h0`` (low word), ``#h1`` (high word).
-    STRING -> ``#h0``/``#h1`` (Hash64 words, the identity) plus ``#r0``,
+    STRING -> ``#h0``/``#h1`` (Hash64 words, the identity) plus ``#r0``/``#r1``,
     an order-preserving uint32 rank of the first 4 UTF-8 bytes
     (big-endian), so range partitioning / OrderBy on strings is exact on
     4-byte prefixes with hash-order tie-breaking beyond that.
     """
     if ctype == ColumnType.STRING:
-        return [f"{name}#h0", f"{name}#h1", f"{name}#r0"]
+        return [f"{name}#h0", f"{name}#h1", f"{name}#r0", f"{name}#r1"]
     if ctype == ColumnType.INT64:
         return [f"{name}#h0", f"{name}#h1"]
     return [name]
 
 
-def string_prefix_rank(strings: "np.ndarray") -> "np.ndarray":
-    """uint32 big-endian rank of the first 4 UTF-8 bytes of each string."""
+def string_prefix_rank(strings: "np.ndarray", offset: int = 0) -> "np.ndarray":
+    """uint32 big-endian rank of UTF-8 bytes [offset, offset+4) of each
+    string — memcomparable prefix words (``#r0`` offset 0, ``#r1``
+    offset 4: exact ordering for 8-byte prefixes, hash-order beyond)."""
     out = np.zeros(len(strings), np.uint32)
     for i, s in enumerate(strings):
-        b = str(s).encode("utf-8")[:4]
+        b = str(s).encode("utf-8")[offset : offset + 4]
         r = 0
         for j in range(4):
             r = (r << 8) | (b[j] if j < len(b) else 0)

@@ -124,6 +124,7 @@ class TextFileProvider(DataProvider):
             "line#h0": (h & np.uint64(0xFFFFFFFF)).astype(np.uint32),
             "line#h1": (h >> np.uint64(32)).astype(np.uint32),
             "line#r0": string_prefix_rank(arr),
+            "line#r1": string_prefix_rank(arr, offset=4),
         }
         return schema, [cols], dictionary
 

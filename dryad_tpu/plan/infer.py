@@ -3,7 +3,7 @@
 ``select``/``select_many`` take a function over *physical* columns; when
 the caller doesn't declare the output schema we trace it with
 ``jax.eval_shape`` on dummy columns and reconstruct logical fields from
-the physical names: ``x#h0``/``x#h1``/``x#r0`` triples are STRING,
+the physical names: ``x#h0``/``x#h1``/``x#r0``/``x#r1`` quads are STRING,
 ``x#h0``/``x#h1`` pairs are INT64, everything else maps by dtype.
 """
 
@@ -53,8 +53,8 @@ def schema_from_physical(cols: Dict[str, jax.ShapeDtypeStruct]) -> Schema:
             if base in seen:
                 continue
             seen.add(base)
-            has = {f"{base}#{s}" for s in ("h0", "h1", "r0")} & names
-            if has == {f"{base}#h0", f"{base}#h1", f"{base}#r0"}:
+            has = {f"{base}#{s}" for s in ("h0", "h1", "r0", "r1")} & names
+            if has == {f"{base}#h0", f"{base}#h1", f"{base}#r0", f"{base}#r1"}:
                 fields.append((base, ColumnType.STRING))
             elif has == {f"{base}#h0", f"{base}#h1"}:
                 fields.append((base, ColumnType.INT64))

@@ -49,9 +49,9 @@ def ordering_operands(
     """Build a fn: batch -> uint32 operand list, lexicographic order ==
     logical (column, descending) chain order.
 
-    INT64: (sign-flipped high word, low word).  STRING: (4-byte prefix
-    rank, hash words) — exact for 4-byte prefixes, hash-order beyond
-    (documented engine semantic for string ordering).
+    INT64: (sign-flipped high word, low word).  STRING: (8-byte prefix
+    rank words, hash words) — exact for 8-byte prefixes, hash-order
+    beyond (documented engine semantic for string ordering).
     """
     fields = [(schema.field(n), bool(d)) for n, d in keys]
 
@@ -60,9 +60,10 @@ def ordering_operands(
         for f, desc in fields:
             if f.ctype == ColumnType.STRING:
                 r0 = batch.data[f"{f.name}#r0"]
+                r1 = batch.data[f"{f.name}#r1"]
                 h0 = batch.data[f"{f.name}#h0"]
                 h1 = batch.data[f"{f.name}#h1"]
-                triple = [r0, h1, h0]
+                triple = [r0, r1, h1, h0]
                 ops.extend(~t if desc else t for t in triple)
             elif f.ctype == ColumnType.INT64:
                 hi = batch.data[f"{f.name}#h1"] ^ jnp.uint32(0x80000000)

@@ -58,8 +58,8 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.dn_tokenize.argtypes = [
             ctypes.c_char_p, ctypes.c_size_t, ctypes.c_size_t,
             ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint32),
-            ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint64),
-            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint32),
         ]
         lib.dn_channel_open.restype = ctypes.c_void_p
         lib.dn_channel_open.argtypes = [
@@ -130,8 +130,9 @@ def tokenize(
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """Whitespace-tokenize a byte buffer into columnar token arrays.
 
-    Returns (h0, h1, r0, starts, lens): Hash64 word pairs, 4-byte prefix
-    ranks, and byte offsets/lengths for dictionary construction.
+    Returns (h0, h1, r0, r1, starts, lens): Hash64 word pairs, 8-byte
+    prefix rank words, and byte offsets/lengths for dictionary
+    construction.
     """
     lib = _load()
     if lib is not None:
@@ -139,6 +140,7 @@ def tokenize(
         h0 = np.empty(n, np.uint32)
         h1 = np.empty(n, np.uint32)
         r0 = np.empty(n, np.uint32)
+        r1 = np.empty(n, np.uint32)
         starts = np.empty(n, np.uint64)
         lens = np.empty(n, np.uint32)
         got = lib.dn_tokenize(
@@ -146,11 +148,12 @@ def tokenize(
             h0.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
             h1.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
             r0.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            r1.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
             starts.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
             lens.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
         )
         assert got == n
-        return h0, h1, r0, starts, lens
+        return h0, h1, r0, r1, starts, lens
 
     # Python fallback
     from dryad_tpu.columnar.schema import string_prefix_rank
@@ -171,9 +174,11 @@ def tokenize(
     hashes = np.array([hash64_bytes(t) for t in tokens], np.uint64)
     h0 = (hashes & np.uint64(0xFFFFFFFF)).astype(np.uint32)
     h1 = (hashes >> np.uint64(32)).astype(np.uint32)
-    r0 = string_prefix_rank(np.array([t.decode("utf-8", "replace") for t in tokens], object))
+    sarr = np.array([t.decode("utf-8", "replace") for t in tokens], object)
+    r0 = string_prefix_rank(sarr)
+    r1 = string_prefix_rank(sarr, offset=4)
     return (
-        h0, h1, r0,
+        h0, h1, r0, r1,
         np.array(starts_l, np.uint64),
         np.array([len(t) for t in tokens], np.uint32),
     )

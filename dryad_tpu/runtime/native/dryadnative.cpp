@@ -62,11 +62,12 @@ size_t dn_token_count(const uint8_t* buf, size_t len) {
   return n;
 }
 
-// Tokenize: fill per-token hash (lo/hi u32 words), 4-byte prefix rank,
-// and byte offsets/lengths (for host-side dictionary construction).
+// Tokenize: fill per-token hash (lo/hi u32 words), 8-byte prefix rank
+// words (r0 bytes 0-4, r1 bytes 4-8), and byte offsets/lengths (for
+// host-side dictionary construction).
 // Returns the number of tokens written (<= max_tokens).
 size_t dn_tokenize(const uint8_t* buf, size_t len, size_t max_tokens,
-                   uint32_t* h0, uint32_t* h1, uint32_t* r0,
+                   uint32_t* h0, uint32_t* h1, uint32_t* r0, uint32_t* r1,
                    uint64_t* starts, uint32_t* lens) {
   size_t n = 0;
   size_t i = 0;
@@ -75,18 +76,22 @@ size_t dn_tokenize(const uint8_t* buf, size_t len, size_t max_tokens,
     if (i >= len) break;
     size_t s = i;
     uint64_t h = FNV_OFFSET;
-    uint32_t rank = 0;
+    uint32_t rank0 = 0, rank1 = 0;
     while (i < len && !is_space(buf[i])) {
       uint8_t c = buf[i];
       h ^= (uint64_t)c;
       h *= FNV_PRIME;
       size_t pos = i - s;
-      if (pos < 4) rank |= ((uint32_t)c) << (8 * (3 - pos));
+      if (pos < 4)
+        rank0 |= ((uint32_t)c) << (8 * (3 - pos));
+      else if (pos < 8)
+        rank1 |= ((uint32_t)c) << (8 * (7 - pos));
       ++i;
     }
     h0[n] = (uint32_t)(h & 0xFFFFFFFFULL);
     h1[n] = (uint32_t)(h >> 32);
-    r0[n] = rank;
+    r0[n] = rank0;
+    r1[n] = rank1;
     starts[n] = (uint64_t)s;
     lens[n] = (uint32_t)(i - s);
     ++n;

@@ -17,7 +17,7 @@ from dryad_tpu.columnar.schema import (
 
 def test_schema_device_names():
     s = Schema([("a", ColumnType.INT32), ("w", ColumnType.STRING), ("n", ColumnType.INT64)])
-    assert s.device_names() == ["a", "w#h0", "w#h1", "w#r0", "n#h0", "n#h1"]
+    assert s.device_names() == ["a", "w#h0", "w#h1", "w#r0", "w#r1", "n#h0", "n#h1"]
     assert s.field("w").ctype.is_split
 
 

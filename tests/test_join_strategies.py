@@ -174,7 +174,7 @@ def test_group_join_aggregates(ctx, rng):
         ctx.from_arrays(left)
         .group_join(
             ctx.from_arrays(right), "k",
-            {"n": ("count", None), "s": ("sum", "v")},
+            aggs={"n": ("count", None), "s": ("sum", "v")},
         )
         .order_by([("k", False)])
         .collect()

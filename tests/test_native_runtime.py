@@ -18,7 +18,7 @@ def test_hash64_native_matches_python():
 
 def test_tokenizer_native_matches_python():
     text = "  the quick\t brown\nfox  jumps over\r\nthe lazy dog "
-    h0, h1, r0, starts, lens = B.tokenize(text.encode())
+    h0, h1, r0, r1, starts, lens = B.tokenize(text.encode())
     words = [
         text.encode()[int(s) : int(s) + int(l)].decode()
         for s, l in zip(starts, lens)
@@ -27,6 +27,9 @@ def test_tokenizer_native_matches_python():
     hashes = (h1.astype(np.uint64) << np.uint64(32)) | h0.astype(np.uint64)
     assert all(hash64_str(w) == int(h) for w, h in zip(words, hashes))
     assert np.array_equal(r0, string_prefix_rank(np.array(words, object)))
+    assert np.array_equal(
+        r1, string_prefix_rank(np.array(words, object), offset=4)
+    )
 
 
 def test_prefetch_channel_order(tmp_path):

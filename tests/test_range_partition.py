@@ -121,3 +121,15 @@ def test_range_partition_unknown_column(ctx):
     q = ctx.from_arrays({"k": np.zeros(8, np.int32)})
     with pytest.raises(ValueError):
         q.range_partition("nope")
+
+
+def test_string_order_beyond_four_byte_prefix(ctx):
+    """8-byte memcomparable prefix: strings sharing a 4-byte prefix now
+    sort correctly (previously hash-ordered beyond 4 bytes)."""
+    words = np.array(
+        ["prefix_a", "prefix_c", "prefix_b", "prefix_d", "pref",
+         "prefix_aa"] * 20,
+        object,
+    )
+    out = ctx.from_arrays({"w": words}).order_by([("w", False)]).collect()
+    assert out["w"].tolist() == sorted(words.tolist())
