@@ -754,6 +754,24 @@ class Query:
         )
         return Query(self.ctx, node)
 
+    def apply_host(
+        self,
+        fn: Callable,
+        schema: Optional[Schema] = None,
+        cap_factor: float = 1.0,
+    ) -> "Query":
+        """Per-partition HOST callback: fn(cols: dict[str, np.ndarray],
+        partition_index) -> dict of equal-length arrays — the arbitrary
+        user-code escape hatch (reference Apply runs arbitrary .NET
+        lambdas; jittable fns should use ``apply``).  Each call costs a
+        device->host->device round-trip per partition: the documented
+        perf cliff (SURVEY 7.3)."""
+        node = Node(
+            "apply_host", [self.node], schema or self.schema,
+            PartitionInfo(), fn=fn, cap_factor=float(cap_factor),
+        )
+        return Query(self.ctx, node)
+
     def fork(self, fn: Callable, out_schemas: Sequence[Schema]) -> Tuple["Query", ...]:
         """Multi-output per-partition function (reference Fork,
         ``DryadLinqQueryable.cs:3717``): fn(batch) -> tuple of batches."""

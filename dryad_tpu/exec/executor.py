@@ -156,6 +156,10 @@ class GraphExecutor:
                 stage_fps[stage.id] = None  # loop state is data-dependent
                 self._run_do_while(stage, graph, bindings, results)
                 continue
+            if stage.ops and stage.ops[0].kind == "apply_host":
+                stage_fps[stage.id] = None  # host fn is opaque
+                self._run_apply_host(stage, bindings, results)
+                continue
             self._run_stage(
                 stage, graph, bindings, results, binding_fps or {}, stage_fps
             )
@@ -336,6 +340,61 @@ class GraphExecutor:
             if not bool(cont):
                 break
         results[(stage.id, 0)] = current
+
+    def _run_apply_host(self, stage, bindings, results) -> None:
+        """Host-callback Apply: pull each partition to host, run the
+        user fn, push back sharded (the arbitrary-user-code escape
+        hatch; device->host->device round trip per job — the documented
+        perf cliff, SURVEY 7.3)."""
+        import math
+
+        import numpy as np
+        from dryad_tpu.parallel.mesh import partition_sharding
+
+        p = stage.ops[0].params
+        (b,) = self._resolve_inputs(stage, bindings, results)
+        self.events.emit("apply_host_start", stage=stage.id)
+        P = self.P
+        cap = b.capacity // P
+        valid = np.asarray(b.valid)
+        host_cols = {n: np.asarray(v) for n, v in b.data.items()}
+        out_parts = []
+        for i in range(P):
+            sl = slice(i * cap, (i + 1) * cap)
+            m = valid[sl]
+            part = {n: v[sl][m] for n, v in host_cols.items()}
+            out = p["fn"](part, i)
+            lens = {len(v) for v in out.values()} or {0}
+            if len(lens) != 1:
+                raise ValueError(
+                    f"apply_host fn returned ragged columns: { {n: len(v) for n, v in out.items()} }"
+                )
+            out_parts.append(out)
+        phys = sorted(out_parts[0].keys()) if out_parts else []
+        new_cap = max(
+            8,
+            int(
+                math.ceil(
+                    max((len(next(iter(op.values()), [])) for op in out_parts),
+                        default=1) / 8.0
+                )
+            ) * 8,
+        )
+        sh = partition_sharding(self.mesh)
+        data = {}
+        for n in phys:
+            buf = np.zeros((P * new_cap,), out_parts[0][n].dtype)
+            for i, op in enumerate(out_parts):
+                v = np.asarray(op[n])
+                buf[i * new_cap : i * new_cap + len(v)] = v
+            data[n] = jax.device_put(buf, sh)
+        vbuf = np.zeros((P * new_cap,), np.bool_)
+        for i, op in enumerate(out_parts):
+            nrows = len(next(iter(op.values()), []))
+            vbuf[i * new_cap : i * new_cap + nrows] = True
+        out_batch = ColumnBatch(data, jax.device_put(vbuf, sh))
+        self.events.emit("apply_host_done", stage=stage.id)
+        results[(stage.id, 0)] = out_batch
 
     def _run_do_while_device(self, stage, p, current: ColumnBatch) -> ColumnBatch:
         """On-device DoWhile: the WHOLE loop compiles as one

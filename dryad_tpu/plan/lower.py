@@ -254,6 +254,24 @@ class _Builder:
             ref = self._materialize(node.inputs[0])
             self.cursor[node.id] = ("closed", ref[0], ref[1])
 
+        elif k == "apply_host":
+            # Host-callback stage: driver-evaluated (device->host->device),
+            # the arbitrary-user-code escape hatch.
+            ref = self._materialize(node.inputs[0])
+            stage = self._new_stage("apply_host", [ref])
+            stage.ops.append(
+                StageOp(
+                    "apply_host",
+                    dict(
+                        fn=node.params["fn"],
+                        cap_factor=node.params.get("cap_factor", 1.0),
+                        schema=node.schema,
+                    ),
+                )
+            )
+            self._close(stage, [0])
+            self.cursor[node.id] = ("closed", stage.id, 0)
+
         elif k == "do_while":
             # Driver-loop node: body/cond are plan-producing callables the
             # executor re-lowers per iteration (reference GM evaluates

@@ -426,3 +426,46 @@ def test_salt_validation():
         q.group_by("k", {"c": ("count", None)}, salt=1)
     with pytest.raises(ValueError):
         q.group_by("k", {"c": ("count", None)}, salt=4, dense=8)
+
+
+def _host_fn(cols, pidx):
+    # Arbitrary Python: numpy string-free processing + partition tag.
+    import numpy as np
+
+    keep = cols["v"] > np.median(cols["v"]) if len(cols["v"]) else cols["v"] > 0
+    return {
+        "v": cols["v"][keep],
+        "pid": np.full(int(keep.sum()), pidx, np.int32),
+    }
+
+
+def test_apply_host_escape_hatch(mesh8, rng):
+    from dryad_tpu import DryadContext, Schema
+    from dryad_tpu.columnar.schema import ColumnType
+
+    ctx = DryadContext(num_partitions_=8)
+    v = rng.standard_normal(800).astype(np.float32)
+    out = (
+        ctx.from_arrays({"v": v})
+        .apply_host(
+            _host_fn,
+            schema=Schema([("v", ColumnType.FLOAT32),
+                           ("pid", ColumnType.INT32)]),
+        )
+        .collect()
+    )
+    # each partition kept ~half its rows, pid tags present
+    assert 300 <= len(out["v"]) <= 500
+    assert set(out["pid"].tolist()) <= set(range(8))
+    # composes with further device ops
+    n = (
+        ctx.from_arrays({"v": v})
+        .apply_host(
+            _host_fn,
+            schema=Schema([("v", ColumnType.FLOAT32),
+                           ("pid", ColumnType.INT32)]),
+        )
+        .where(lambda c: c["pid"] == 0)
+        .count()
+    )
+    assert 0 < n < 200
