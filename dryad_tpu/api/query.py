@@ -758,17 +758,21 @@ class Query:
         self,
         fn: Callable,
         schema: Optional[Schema] = None,
-        cap_factor: float = 1.0,
     ) -> "Query":
         """Per-partition HOST callback: fn(cols: dict[str, np.ndarray],
         partition_index) -> dict of equal-length arrays — the arbitrary
         user-code escape hatch (reference Apply runs arbitrary .NET
-        lambdas; jittable fns should use ``apply``).  Each call costs a
-        device->host->device round-trip per partition: the documented
-        perf cliff (SURVEY 7.3)."""
+        lambdas; jittable fns should use ``apply``).  Each job costs a
+        device->host->device round-trip: the documented perf cliff
+        (SURVEY 7.3).
+
+        The fn sees *physical* columns: STRING columns arrive as their
+        encoded hash/prefix word columns (``s#h0``..``s#r1``), and a
+        STRING output column must be produced the same way.  Output is
+        validated against ``schema`` (names + dtypes) and cast."""
         node = Node(
             "apply_host", [self.node], schema or self.schema,
-            PartitionInfo(), fn=fn, cap_factor=float(cap_factor),
+            PartitionInfo(), fn=fn,
         )
         return Query(self.ctx, node)
 

@@ -47,6 +47,22 @@ class StageFailedError(RuntimeError):
     pass
 
 
+def _phys_np_dtype(col: str, schema):
+    """numpy dtype of one physical device column."""
+    import numpy as np
+
+    from dryad_tpu.columnar.schema import ColumnType
+
+    if "#" in col:
+        return np.dtype(np.uint32)
+    return {
+        ColumnType.INT32: np.dtype(np.int32),
+        ColumnType.FLOAT32: np.dtype(np.float32),
+        ColumnType.BOOL: np.dtype(np.bool_),
+        ColumnType.UINT32: np.dtype(np.uint32),
+    }[schema.field(col).ctype]
+
+
 class GraphExecutor:
     def __init__(
         self,
@@ -358,19 +374,29 @@ class GraphExecutor:
         cap = b.capacity // P
         valid = np.asarray(b.valid)
         host_cols = {n: np.asarray(v) for n, v in b.data.items()}
+        schema = p["schema"]
+        phys = schema.device_names()
+        expected = {n: _phys_np_dtype(n, schema) for n in phys}
         out_parts = []
         for i in range(P):
             sl = slice(i * cap, (i + 1) * cap)
             m = valid[sl]
             part = {n: v[sl][m] for n, v in host_cols.items()}
             out = p["fn"](part, i)
+            if set(out.keys()) != set(phys):
+                raise ValueError(
+                    f"apply_host fn output columns {sorted(out)} != "
+                    f"schema physical columns {phys} (partition {i})"
+                )
+            # Validate + cast against the declared schema up front so a
+            # dtype drift fails here, not in a downstream compile.
+            out = {n: np.asarray(v, expected[n]) for n, v in out.items()}
             lens = {len(v) for v in out.values()} or {0}
             if len(lens) != 1:
                 raise ValueError(
                     f"apply_host fn returned ragged columns: { {n: len(v) for n, v in out.items()} }"
                 )
             out_parts.append(out)
-        phys = sorted(out_parts[0].keys()) if out_parts else []
         new_cap = max(
             8,
             int(
@@ -383,9 +409,9 @@ class GraphExecutor:
         sh = partition_sharding(self.mesh)
         data = {}
         for n in phys:
-            buf = np.zeros((P * new_cap,), out_parts[0][n].dtype)
+            buf = np.zeros((P * new_cap,), expected[n])
             for i, op in enumerate(out_parts):
-                v = np.asarray(op[n])
+                v = op[n]
                 buf[i * new_cap : i * new_cap + len(v)] = v
             data[n] = jax.device_put(buf, sh)
         vbuf = np.zeros((P * new_cap,), np.bool_)

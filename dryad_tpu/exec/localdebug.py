@@ -129,6 +129,17 @@ class LocalDebugInterpreter:
             flat[k] = v.reshape((v.shape[0] * v.shape[1],) + tuple(v.shape[2:]))
         return {k: v[valid] for k, v in flat.items()}
 
+    def _n_apply_host(self, node: Node) -> Table:
+        t = self._in(node)
+        out = node.params["fn"](dict(t), 0)
+        phys = node.schema.device_names()
+        if set(out.keys()) != set(phys):
+            raise ValueError(
+                f"apply_host fn output columns {sorted(out)} != "
+                f"schema physical columns {phys}"
+            )
+        return {n: np.asarray(v) for n, v in out.items()}
+
     def _n_with_rank(self, node: Node) -> Table:
         t = self._in(node)
         n = len(next(iter(t.values()), []))

@@ -262,11 +262,7 @@ class _Builder:
             stage.ops.append(
                 StageOp(
                     "apply_host",
-                    dict(
-                        fn=node.params["fn"],
-                        cap_factor=node.params.get("cap_factor", 1.0),
-                        schema=node.schema,
-                    ),
+                    dict(fn=node.params["fn"], schema=node.schema),
                 )
             )
             self._close(stage, [0])

@@ -469,3 +469,31 @@ def test_apply_host_escape_hatch(mesh8, rng):
         .count()
     )
     assert 0 < n < 200
+
+
+def test_apply_host_localdebug_and_validation(rng):
+    from dryad_tpu import DryadContext, Schema
+    from dryad_tpu.columnar.schema import ColumnType
+
+    v = rng.standard_normal(100).astype(np.float32)
+    sch = Schema([("v", ColumnType.FLOAT32), ("pid", ColumnType.INT32)])
+    dbg = (
+        DryadContext(local_debug=True)
+        .from_arrays({"v": v})
+        .apply_host(_host_fn, schema=sch)
+        .collect()
+    )
+    assert set(dbg.keys()) == {"v", "pid"}
+
+    def bad_fn(cols, i):
+        return {"wrong": cols["v"]}
+
+    ctx = DryadContext(num_partitions_=8)
+    with pytest.raises(ValueError, match="schema physical columns"):
+        ctx.from_arrays({"v": v}).apply_host(bad_fn, schema=sch).collect()
+
+    def listy_fn(cols, i):
+        return {"v": list(cols["v"][:2]), "pid": [i, i]}
+
+    out = ctx.from_arrays({"v": v}).apply_host(listy_fn, schema=sch).collect()
+    assert out["v"].dtype == np.float32 and len(out["v"]) == 16
