@@ -171,20 +171,11 @@ class DryadContext:
         self._bindings[node.id] = ("host", arrays, partition_capacity)
         return Query(self, node)
 
-    def from_text(self, data, column: str = "word") -> Query:
-        """Tokenize raw text into a one-STRING-column table using the
-        native tokenizer (reference WordCount ingest; tokenization
-        happens in generated vertex code there, at the ingest edge
-        here).  ``data`` is a filesystem path, str, or bytes."""
+    def _tokenize_buf(self, buf: bytes):
+        """Tokenize one byte buffer, registering tokens in the context
+        dictionary; returns the (h0, h1, r0, r1) physical columns."""
         from dryad_tpu.runtime import bindings as RB
 
-        if isinstance(data, str) and os.path.exists(data):
-            with open(data, "rb") as fh:
-                buf = fh.read()
-        elif isinstance(data, str):
-            buf = data.encode("utf-8")
-        else:
-            buf = bytes(data)
         h0, h1, r0, r1, starts, lens = RB.tokenize(buf)
         hashes = (h1.astype(np.uint64) << np.uint64(32)) | h0.astype(np.uint64)
         uniq, first_idx = np.unique(hashes, return_index=True)
@@ -195,6 +186,52 @@ class DryadContext:
             if existing is not None and existing != tok:
                 raise ValueError(f"hash64 collision: {existing!r} vs {tok!r}")
             self.dictionary._map[int(h)] = tok
+        return h0, h1, r0, r1
+
+    def from_text(self, data, column: str = "word") -> Query:
+        """Tokenize raw text into a one-STRING-column table using the
+        native tokenizer (reference WordCount ingest; tokenization
+        happens in generated vertex code there, at the ingest edge
+        here).  ``data`` is a filesystem path, a list of paths (read
+        with background prefetch, the async channel-reader path), a
+        str, or bytes."""
+        from dryad_tpu.runtime import bindings as RB
+
+        if isinstance(data, (list, tuple)):
+            # Multi-file ingest: the native prefetch channel reads file
+            # i+1 while file i tokenizes (reference async channel
+            # buffer readers, channelbuffernativereader.cpp).
+            parts = []
+            with RB.PrefetchChannel(list(data), depth=4, threads=2) as ch:
+                for fbuf in ch:
+                    parts.append(self._tokenize_buf(fbuf))
+            if not parts:
+                cols = [np.zeros(0, np.uint32)] * 4
+            else:
+                cols = [
+                    np.concatenate([p[i] for p in parts]) for i in range(4)
+                ]
+            h0, h1, r0, r1 = cols
+            schema = Schema([(column, ColumnType.STRING)])
+            node = Node(
+                "input", [], schema, PartitionInfo.roundrobin(),
+                source="host_physical",
+            )
+            self._bindings[node.id] = (
+                "host_physical",
+                {f"{column}#h0": h0, f"{column}#h1": h1,
+                 f"{column}#r0": r0, f"{column}#r1": r1},
+            )
+            return Query(self, node)
+
+        if isinstance(data, str) and os.path.exists(data):
+            with open(data, "rb") as fh:
+                buf = fh.read()
+        elif isinstance(data, str):
+            buf = data.encode("utf-8")
+        else:
+            buf = bytes(data)
+        h0, h1, r0, r1 = self._tokenize_buf(buf)
         schema = Schema([(column, ColumnType.STRING)])
         node = Node(
             "input", [], schema, PartitionInfo.roundrobin(), source="host_physical",

@@ -167,3 +167,22 @@ def test_tlv_tag_range_checked():
         B.tlv_encode([(0x10000, b"x")])
     with pytest.raises(ValueError):
         B.tlv_encode([(-1, b"x")])
+
+
+def test_from_text_multiple_files(tmp_path, mesh8):
+    from dryad_tpu import DryadContext
+
+    paths = []
+    for i, content in enumerate(["alpha beta", "beta gamma", "alpha alpha"]):
+        p = tmp_path / f"f{i}.txt"
+        p.write_text(content)
+        paths.append(str(p))
+    ctx = DryadContext(num_partitions_=8)
+    wc = (
+        ctx.from_text(paths)
+        .group_by("word", {"n": ("count", None)})
+        .collect()
+    )
+    assert dict(zip(wc["word"], wc["n"].tolist())) == {
+        "alpha": 3, "beta": 2, "gamma": 1
+    }
