@@ -1,0 +1,30 @@
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+import sys
+sys.path.insert(0, "tests")
+import numpy as np
+from dryad_tpu import DryadContext
+import importlib
+m = importlib.import_module("test_fuzz_differential")
+
+fails = []
+for seed in range(20, 120):
+    rng = np.random.default_rng(seed)
+    tbl = m._rand_table(rng, int(rng.integers(50, 400)))
+    steps = m._build_pipeline(rng, int(rng.integers(1, 6)))
+    def run(ctx):
+        q = ctx.from_arrays(tbl)
+        for name in steps:
+            q = m._STEPS[name](q)
+        return q.collect()
+    try:
+        dev = run(DryadContext(num_partitions_=8))
+        dbg = run(DryadContext(local_debug=True))
+        m.check(dev, dbg)
+    except Exception as e:
+        fails.append((seed, steps, str(e)[:200]))
+        print("FAIL", seed, steps, str(e)[:200], flush=True)
+    if seed % 20 == 0:
+        print("...", seed, flush=True)
+print("done", len(fails), "failures", flush=True)

@@ -116,6 +116,44 @@ def main():
     assert (np.diff(r["v"][order]) >= 0).all()
     print("salted group_by + with_rank: OK")
 
+    # 9. newest surfaces: device do_while, group_join, apply_host.
+    def _body(q):
+        return q.select(lambda c: {"v": c["v"] * 2.0})
+
+    def _cond(q):
+        return q.aggregate_as_query({"m": ("max", "v")}).select(
+            lambda cols: {"go": cols["m"] < 50.0}
+        )
+
+    dw = (
+        ctx.from_arrays({"v": np.ones(64, np.float32)})
+        .do_while(_body, _cond, max_iter=10, device=True)
+        .collect()
+    )
+    assert float(dw["v"][0]) == 64.0
+
+    gj = (
+        ctx.from_arrays({"k": np.arange(4, dtype=np.int32)})
+        .group_join(
+            ctx.from_arrays(tbl), "k",
+            aggs={"n": ("count", None)},
+        )
+        .order_by([("k", False)])
+        .collect()
+    )
+    assert len(gj["k"]) == 4
+
+    def _hostfn(cols, i):
+        return {"v": cols["v"][:1]}
+
+    ah = (
+        ctx.from_arrays({"v": np.arange(80, dtype=np.float32)})
+        .apply_host(_hostfn)
+        .count()
+    )
+    assert ah == 8  # one row per partition
+    print("device do_while + group_join + apply_host: OK")
+
     print("VERIFY PASS")
 
 

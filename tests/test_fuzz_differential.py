@@ -1,0 +1,101 @@
+"""Differential fuzzing: random operator pipelines, device engine vs
+LocalDebug NumPy interpreter — the reference's differential-validation
+pattern (``Validate.Check`` + LocalDebug) applied at scale.
+
+Each seed builds a random table and a random chain from a small op
+grammar; both execution paths must agree (order-insensitive).
+"""
+
+import numpy as np
+import pytest
+
+from dryad_tpu import DryadContext
+
+from oracle import check
+
+
+def _rand_table(rng, n):
+    return {
+        "k": rng.integers(0, 9, n).astype(np.int32),
+        "g": rng.integers(0, 4, n).astype(np.int32),
+        "v": (rng.standard_normal(n) * 4).round(2).astype(np.float32),
+    }
+
+
+def _sel_double(cols):
+    return {"k": cols["k"], "g": cols["g"], "v": cols["v"] * 2.0}
+
+
+def _sel_shift(cols):
+    return {"k": cols["k"] + 1, "g": cols["g"], "v": cols["v"]}
+
+
+def _where_pos(cols):
+    return cols["v"] > 0
+
+
+def _where_kmod(cols):
+    return cols["k"] % 2 == 0
+
+
+_STEPS = {
+    # name -> (applicable if schema has all of cols, fn(q) -> q)
+    "select_double": (lambda q: q.select(_sel_double)),
+    "select_shift": (lambda q: q.select(_sel_shift)),
+    "where_pos": (lambda q: q.where(_where_pos)),
+    "where_kmod": (lambda q: q.where(_where_kmod)),
+    "distinct_k": (lambda q: q.project(["k", "g"]).distinct()),
+    "group_by": (
+        lambda q: q.group_by(
+            ["k"], {"s": ("sum", "v"), "c": ("count", None),
+                    "mn": ("min", "v"), "g": ("max", "g")}
+        ).select(lambda c: {"k": c["k"], "g": c["g"],
+                            "v": c["s"] + c["mn"] + c["c"]})
+    ),
+    "order_take": (lambda q: q.order_by([("v", True), ("k", False)]).take(17)),
+    "skip": (lambda q: q.order_by([("k", False), ("v", False)]).skip(5)),
+    "hash_partition": (lambda q: q.hash_partition("g")),
+    "range_partition": (lambda q: q.range_partition("v")),
+    "reverse": (lambda q: q.order_by([("v", False)]).reverse()),
+    "tail": (lambda q: q.order_by([("v", False)]).tail(13)),
+}
+
+# group_by collapses the row space; cap how often it may appear so
+# pipelines keep data flowing.
+_MAX_GROUPS = 2
+
+
+def _build_pipeline(rng, depth):
+    names = sorted(_STEPS)
+    steps = []
+    n_groups = 0
+    for _ in range(depth):
+        name = names[int(rng.integers(0, len(names)))]
+        if name in ("group_by", "distinct_k"):
+            if n_groups >= _MAX_GROUPS:
+                continue
+            n_groups += 1
+        steps.append(name)
+        if name == "distinct_k":
+            break  # schema narrows to (k, g); stop to keep grammar simple
+    return steps
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_random_pipeline_device_matches_localdebug(seed):
+    rng = np.random.default_rng(seed)
+    tbl = _rand_table(rng, int(rng.integers(50, 400)))
+    steps = _build_pipeline(rng, int(rng.integers(1, 6)))
+
+    def run(ctx):
+        q = ctx.from_arrays(tbl)
+        for name in steps:
+            q = _STEPS[name](q)
+        return q.collect()
+
+    dev = run(DryadContext(num_partitions_=8))
+    dbg = run(DryadContext(local_debug=True))
+    try:
+        check(dev, dbg)
+    except AssertionError as e:
+        raise AssertionError(f"seed={seed} steps={steps}: {e}") from e
