@@ -89,21 +89,83 @@ def host_baseline_rows_per_sec(n: int = 1 << 20, keys: int = 1 << 12) -> float:
     return n / dt
 
 
-def main() -> None:
-    value = device_rows_per_sec()
-    log(f"device: {value:.3e} rows/s")
-    baseline = host_baseline_rows_per_sec()
-    log(f"host baseline: {baseline:.3e} rows/s")
-    print(
-        json.dumps(
-            {
-                "metric": "group_reduce_rows_per_sec",
-                "value": round(value, 1),
-                "unit": "rows/s",
-                "vs_baseline": round(value / baseline, 3),
-            }
-        )
+def init_backend(max_tries: int = 2, probe_timeout: float = 90.0) -> str:
+    """Initialize a JAX backend, always terminating: the accelerator backend
+    is probed in a SUBPROCESS with a hard timeout (remote-TPU init can hang
+    indefinitely, round-1 artifact; an in-process retry can't recover from
+    that), and on probe failure we pin this process to CPU before jax is
+    ever imported, so the benchmark always produces a number (tagged with
+    the platform it actually ran on)."""
+    import subprocess
+
+    probe = (
+        "import jax; d = jax.devices()[0]; print('PLATFORM=' + d.platform)"
     )
+    for attempt in range(max_tries):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", probe],
+                capture_output=True,
+                text=True,
+                timeout=probe_timeout,
+            )
+            for line in out.stdout.splitlines():
+                if line.startswith("PLATFORM="):
+                    platform = line.split("=", 1)[1]
+                    log(f"backend probe ok: {platform}")
+                    import jax  # noqa: F401  (same env as the probe)
+
+                    return platform
+            detail = (
+                out.stderr.strip().splitlines()[-1][:200]
+                if out.stderr.strip()
+                else "no output"
+            )
+            log(
+                f"backend probe attempt {attempt + 1}/{max_tries} "
+                f"rc={out.returncode}: {detail}"
+            )
+        except subprocess.TimeoutExpired:
+            log(
+                f"backend probe attempt {attempt + 1}/{max_tries} hung "
+                f">{probe_timeout}s (remote backend unreachable)"
+            )
+        if attempt + 1 < max_tries:
+            time.sleep(5.0)
+    log("falling back to CPU")
+    from dryad_tpu.parallel.mesh import force_cpu_backend
+
+    force_cpu_backend(1)
+    import jax
+
+    return jax.devices()[0].platform
+
+
+def main() -> None:
+    result: dict = {
+        "metric": "group_reduce_rows_per_sec",
+        "value": 0.0,
+        "unit": "rows/s",
+        "vs_baseline": 0.0,
+    }
+    try:
+        platform = init_backend()
+        result["platform"] = platform
+        # Smaller shape on the CPU fallback so the run stays fast.
+        n = 1 << 22 if platform != "cpu" else 1 << 20
+        value = device_rows_per_sec(n=n)
+        log(f"device: {value:.3e} rows/s")
+        baseline = host_baseline_rows_per_sec()
+        log(f"host baseline: {baseline:.3e} rows/s")
+        result["value"] = round(value, 1)
+        result["vs_baseline"] = round(value / baseline, 3)
+    except Exception as e:  # always emit the JSON line, even on failure
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        result["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(result), flush=True)
+    sys.exit(0)
 
 
 if __name__ == "__main__":

@@ -157,6 +157,12 @@ class Query:
         by one ``psum_scatter`` — the aggregation-tree fast path.  Only
         sum/count/mean aggregates; rows with keys outside [0, K) are
         dropped.  Output is range-partitioned and ordered by the key.
+
+        Dense-path precision: counts are exact (int32 across the mesh;
+        per-partition capacity is guarded at 2^24).  SUM columns
+        accumulate in f32, so an integer sum silently loses exactness
+        once a per-bucket total exceeds 2^24 — use the default
+        sort-based path when exact large integer sums matter.
         """
         keys = _keys(keys)
         if salt is not None:

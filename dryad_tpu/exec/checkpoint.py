@@ -89,6 +89,11 @@ class CheckpointStore:
 
     def __init__(self, root: str):
         self.root = root
+        # Checkpoints touched (saved or loaded) by THIS run: exempt from
+        # gc, so a retention lease shorter than the job's wall time can't
+        # delete earlier stages of the running job out from under a
+        # later resume-after-failure.
+        self._active: set = set()
         os.makedirs(root, exist_ok=True)
 
     def _dir(self, stage: Stage, fp: str) -> str:
@@ -114,6 +119,7 @@ class CheckpointStore:
 
             shutil.rmtree(d)
         os.replace(tmp, d)
+        self._active.add(d)
         return d
 
     def gc(self, retain_seconds: float) -> int:
@@ -130,7 +136,7 @@ class CheckpointStore:
         for name in os.listdir(self.root):
             d = os.path.join(self.root, name)
             meta = os.path.join(d, "meta.json")
-            if not os.path.isdir(d):
+            if not os.path.isdir(d) or d in self._active:
                 continue
             try:
                 ts = os.path.getmtime(meta if os.path.exists(meta) else d)
@@ -162,6 +168,7 @@ class CheckpointStore:
                 valid = cols.pop(_VALID)
                 data = {n: jax.device_put(v, sh) for n, v in cols.items()}
                 outs.append(ColumnBatch(data, jax.device_put(valid, sh)))
+            self._active.add(d)
             return tuple(outs)
         except Exception as e:  # noqa: BLE001 — treat as cache miss
             log.warning("checkpoint %s unreadable (%s); recomputing", d, e)

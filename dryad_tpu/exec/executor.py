@@ -339,7 +339,12 @@ class GraphExecutor:
                     stage, p, current
                 )
                 return
-            except ValueError as e:
+            except (ValueError, TypeError) as e:
+                # ValueError: the lowerer rejected the subplan (multi-stage
+                # body/cond).  TypeError: the body lowers to one stage but
+                # changes the carry pytree shape (e.g. capacity resize with
+                # slack), which lax.while_loop rejects at trace time.
+                # Either way the driver loop below handles it.
                 self.events.emit(
                     "do_while_device_fallback", stage=stage.id, reason=str(e)
                 )
@@ -470,10 +475,22 @@ class GraphExecutor:
                     bouts, (bovf,) = body_fn((b,), ())
                     return (i + jnp.int32(1), bouts[0], ovf | bovf)
 
+                # DoWhile runs the body BEFORE checking cond (reference
+                # semantics, DryadLinqQueryNode.cs:4555; driver fallback
+                # below mirrors it) — so seed the loop state with one body
+                # application rather than letting lax.while_loop evaluate
+                # cond on the un-iterated input.
+                bouts0, (bovf0,) = body_fn((b0,), ())
                 it, bout, ovf = jax.lax.while_loop(
-                    cond, body, (jnp.int32(0), b0, jnp.zeros((), jnp.bool_))
+                    cond, body, (jnp.int32(1), bouts0[0], bovf0)
                 )
-                return (bout,), (ovf, it)
+                # A cond-stage overflow terminates the loop (its `go` bit
+                # is garbage) but lives only inside cond's trace; recover
+                # it by re-evaluating cond on the final state so the host
+                # retries with a larger boost instead of accepting a
+                # result whose termination decision overflowed.
+                _, (covf,) = cond_fn((bout,), ())
+                return (bout,), (ovf | covf, it)
 
             key = (
                 "do_while_device", self._stage_key(body_stage),

@@ -224,12 +224,28 @@ def _k_group_reduce_dense(ctx: StageContext, p) -> None:
     TPU, ``ops/pallas_bucket.py``) + one ``psum_scatter`` over the mesh.
 
     Output partition i holds buckets [i*per, (i+1)*per); rows for keys
-    outside [0, K) are dropped (API contract).  Count accumulates in
-    f32 — exact up to 2^24 rows per bucket per partition.
+    outside [0, K) are dropped (API contract).  Per-partition counts
+    accumulate in f32 on the MXU (exact below 2^24 rows/bucket/partition
+    — statically guaranteed by the capacity guard below) and cross the
+    mesh as int32, so the global count is exact.  SUM columns accumulate
+    in f32 end-to-end: integer sums silently lose exactness once a
+    per-bucket total exceeds 2^24 (documented at the API, query.py
+    ``dense=``); the sort-based path is the exact alternative.
     """
     from dryad_tpu.ops.pallas_bucket import bucket_sum_count
 
     b = ctx.slots[p["slot"]]
+    if b.capacity > (1 << 24):
+        raise ValueError(
+            f"dense group_by: partition capacity {b.capacity} exceeds the "
+            "f32-exact accumulation range (2^24 rows/partition); use the "
+            "sort-based group_by path"
+        )
+    if ctx.P * b.capacity > 0x7FFFFFFF:
+        raise ValueError(
+            f"dense group_by: global capacity {ctx.P * b.capacity} exceeds "
+            "the int32 count range; use the sort-based group_by path"
+        )
     K = int(p["num_buckets"])
     per = max(1, -(-K // ctx.P))  # ceil
     Kp = per * ctx.P
@@ -249,7 +265,10 @@ def _k_group_reduce_dense(ctx: StageContext, p) -> None:
     scat = lambda x: jax.lax.psum_scatter(
         x, ctx.axes, scatter_dimension=0, tiled=True
     )
-    cnt = scat(cnt)
+    # Counts cross the mesh as int32: each per-partition partial is f32-
+    # exact (capacity guard above), and integer reduce-scatter keeps the
+    # global total exact past 2^24.
+    cnt = scat(jnp.round(cnt).astype(jnp.int32))
     by_col = {c: scat(s) for c, s in by_col.items()}
 
     me = jax.lax.axis_index(ctx.axes)
@@ -257,7 +276,7 @@ def _k_group_reduce_dense(ctx: StageContext, p) -> None:
     out: Dict[str, jax.Array] = {p["key"]: kcol}
     for a in p["aggs"]:
         if a.op == "count":
-            out[a.out] = cnt.astype(jnp.int32)
+            out[a.out] = cnt
         elif a.op == "sum":
             s = by_col[a.col]
             dt = b.data[a.col].dtype
@@ -266,7 +285,9 @@ def _k_group_reduce_dense(ctx: StageContext, p) -> None:
                 else s.astype(dt)
             )
         elif a.op == "mean":
-            out[a.out] = by_col[a.col] / jnp.maximum(cnt, 1.0)
+            out[a.out] = by_col[a.col] / jnp.maximum(cnt, 1).astype(
+                jnp.float32
+            )
         else:  # guarded at the API layer
             raise ValueError(f"dense group_by cannot compute {a.op!r}")
     valid = (cnt > 0) & (kcol < K)
@@ -396,6 +417,14 @@ def _k_group_join_count(ctx: StageContext, p) -> None:
 def _rank_column(b: ColumnBatch, P: int, axes: Tuple[str, ...]) -> Tuple[ColumnBatch, jax.Array]:
     """Compact and attach each valid row's global rank (partition-major)."""
     c = b.compact()
+    # Ranks are uint32 with 0xFFFFFFFF as the invalid sentinel; the max
+    # possible rank is the static global capacity, so guard at trace
+    # time rather than silently wrapping past 4.29B rows.
+    if P * c.capacity >= 0xFFFFFFFF:
+        raise ValueError(
+            f"rank-based operator: global capacity {P * c.capacity} "
+            "exceeds the uint32 rank range (4.29e9 rows)"
+        )
     local = jnp.sum(c.valid.astype(jnp.int32))
     counts = jax.lax.all_gather(local, axes)
     me = jax.lax.axis_index(axes)

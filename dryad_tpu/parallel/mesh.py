@@ -25,6 +25,30 @@ AXIS = "p"
 DCN_AXIS = "d"
 
 
+def force_cpu_backend(n_devices: int) -> None:
+    """Pin this process to the host-CPU backend with ``n_devices`` virtual
+    devices.  Must run before the first backend query — remote-TPU (axon)
+    initialization can hang indefinitely, so every standalone driver entry
+    (tests, ``__graft_entry__``, bench fallback) forces CPU through this
+    one helper.  Env vars cover a fresh interpreter; the config updates
+    cover jax already imported (site hooks) but no backend initialized.
+    """
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    except RuntimeError:
+        # Backend already initialized; callers assert on the resulting
+        # device count.
+        pass
+
+
 def make_mesh(num_partitions: Optional[int] = None) -> Mesh:
     """1-D partition mesh over available devices.
 
@@ -85,12 +109,28 @@ def make_hybrid_mesh(
 
 
 def exclude_devices(mesh: Mesh, bad_ids) -> Mesh:
-    """Rebuild a flat mesh without the excluded device ids — the elastic
+    """Rebuild the mesh without the excluded device ids — the elastic
     recovery step (reference: the computer set "may change as failures
     occur", ``Interfaces.cs:336-343``; failed-process requeue with
     exclusion).  The caller re-runs affected stages from checkpoints on
-    the smaller mesh."""
+    the smaller mesh.
+
+    A hybrid (DCN x ICI) mesh keeps its 2-D structure: each slice row
+    sheds its bad devices, the ICI axis shrinks to the smallest surviving
+    slice (rows must stay rectangular), and slices that lost every device
+    are dropped — so cross-slice exchanges still ride the tree/DCN path
+    instead of silently treating DCN links as ICI."""
     bad = set(bad_ids)
+    if mesh.devices.ndim == 2:
+        rows = [
+            [d for d in row if d.id not in bad] for row in mesh.devices
+        ]
+        rows = [r for r in rows if r]
+        if not rows:
+            raise ValueError("excluding all devices leaves an empty mesh")
+        k = min(len(r) for r in rows)
+        arr = np.array([r[:k] for r in rows])
+        return Mesh(arr, mesh.axis_names)
     keep = [d for d in mesh.devices.flat if d.id not in bad]
     if not keep:
         raise ValueError("excluding all devices leaves an empty mesh")

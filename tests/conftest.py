@@ -10,8 +10,9 @@ before the first backend query).
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+from dryad_tpu.parallel.mesh import force_cpu_backend
+
+force_cpu_backend(8)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -193,6 +193,47 @@ def test_device_do_while_matches_driver_loop(rng):
     assert max(b["v"]) == 192.0
 
 
+def test_device_do_while_body_runs_once_when_cond_initially_false(rng):
+    """DoWhile runs the body BEFORE checking cond (reference semantics);
+    with cond false on the un-iterated input, both paths must still run
+    the body exactly once (round-2 regression: the device path's
+    lax.while_loop previously checked cond first and ran it zero times)."""
+    from dryad_tpu import DryadContext
+
+    tbl = {"v": np.array([150.0], np.float32)}  # cond (max < 100) false
+
+    def run(device):
+        ctx = DryadContext(num_partitions_=8)
+        return ctx.from_arrays(tbl).do_while(
+            _dw_body, _dw_cond, max_iter=20, device=device
+        ).collect()
+
+    a = run(False)
+    b = run(True)
+    assert a["v"].tolist() == [300.0]
+    assert b["v"].tolist() == [300.0]
+
+
+def test_hybrid_mesh_exclusion_preserves_dcn_axis():
+    """exclude_devices on a 2-D (DCN x ICI) mesh keeps the 2-D structure
+    (round-2 regression: it used to flatten to 1-D, losing the
+    tree-exchange path after elastic recovery)."""
+    from dryad_tpu.parallel.mesh import (
+        exclude_devices,
+        make_hybrid_mesh,
+        num_partitions,
+    )
+
+    m = make_hybrid_mesh(2, 4)
+    bad = [m.devices[0][0].id]
+    m2 = exclude_devices(m, bad)
+    assert m2.devices.ndim == 2
+    assert m2.axis_names == m.axis_names
+    # rows stay rectangular: both slices shrink to the smaller survivor
+    assert m2.devices.shape == (2, 3)
+    assert num_partitions(m2) == 6
+
+
 def test_device_do_while_emits_done_event(tmp_path, rng):
     import json
     import os
