@@ -78,8 +78,12 @@ def _make_kernel(n_vals: int, K: int):
 
 
 def _on_tpu() -> bool:
+    # "axon" is a tunneled-TPU PJRT plugin whose backend keeps its own
+    # name; its MLIR lowerings alias to TPU, so Pallas compiles for it.
     try:
-        return jax.default_backend() == "tpu"
+        if jax.default_backend() in ("tpu", "axon"):
+            return True
+        return getattr(jax.devices()[0], "platform", "") in ("tpu", "axon")
     except Exception:  # pragma: no cover
         return False
 
