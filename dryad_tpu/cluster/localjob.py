@@ -1,0 +1,320 @@
+"""LocalJobSubmission — an N-process local job, end to end.
+
+The reference's minimum distributed bar (``LinqToDryad/
+LocalJobSubmission.cs:97-147``): one job-manager process plus N worker
+processes on one machine, composed from the same parts a real cluster
+uses.  This module is that composition for the TPU framework — it turns
+the cluster layer's pieces into one working subsystem:
+
+- ``ProcessService`` (mailbox + file server + block cache) is the
+  control/data plane, hosted in the driver (C15 analog);
+- ``LocalScheduler`` places the per-worker command round-trips on the
+  workers' computer slots with hard affinities (C14);
+- N ``cluster.worker`` OS processes join one JAX multi-controller
+  runtime (``init_distributed``) so their devices form a single global
+  mesh and each submitted plan executes as ONE gang-scheduled SPMD
+  program spanning processes (cross-process collectives over gloo/ICI);
+- ``ControlPlane`` barriers gate stage boundaries (start / durable-
+  output) and carry membership, heartbeats, and failure reports;
+- job packages ship the plan (``exec.jobpackage``), result partitions
+  come back as partition files read through the file server's HTTP
+  range reads (the managed-channel path, ``HttpReader.cs:78-110``).
+
+Usage::
+
+    with LocalJobSubmission(num_workers=2, devices_per_worker=4) as sub:
+        table = sub.submit(query)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from dryad_tpu.cluster.interfaces import (
+    Affinity,
+    ClusterProcess,
+    Computer,
+    ProcessState,
+)
+from dryad_tpu.cluster.scheduler import LocalScheduler
+from dryad_tpu.cluster.service import ProcessService, ServiceClient
+from dryad_tpu.columnar.io import parse_partition_bytes
+from dryad_tpu.columnar.schema import StringDictionary
+from dryad_tpu.exec.jobpackage import pack_query
+from dryad_tpu.utils.logging import get_logger
+
+log = get_logger("dryad_tpu.cluster.localjob")
+
+
+def _free_port() -> int:
+    """Pick a coordinator port from a pid-derived candidate sequence so
+    concurrent LocalJobSubmissions on one machine probe DIFFERENT ports
+    (the bind-check-close window lasts until worker 0 rebinds it — a
+    kernel-assigned port 0 can't be reserved across processes)."""
+    base = 21000 + (os.getpid() * 131) % 20000
+    for off in range(64):
+        port = base + off
+        try:
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", port))
+                return port
+        except OSError:
+            continue
+    with socket.socket() as s:  # fall back to a kernel-assigned port
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class LocalJobSubmission:
+    """Driver for N worker processes jointly executing submitted queries."""
+
+    def __init__(
+        self,
+        num_workers: int = 2,
+        devices_per_worker: int = 2,
+        root: Optional[str] = None,
+        worker_timeout: float = 300.0,
+    ):
+        self.n = num_workers
+        self.k = devices_per_worker
+        self.timeout = worker_timeout
+        self.root = root or tempfile.mkdtemp(prefix="dryad-localjob-")
+        self.job_id = f"job-{os.getpid()}-{int(time.time() * 1000)}"
+        self.service = ProcessService(self.root)
+        self.scheduler = LocalScheduler(
+            [Computer(f"worker{i}", slots=1) for i in range(num_workers)]
+        )
+        self._client = ServiceClient("127.0.0.1", self.service.port)
+        self._status_ver: Dict[int, int] = {}
+        self._seq = 0
+        self._cseq = 0  # unique per driver command; echoed in statuses
+        self._procs: List[subprocess.Popen] = []
+        self._logs: List[str] = []
+        self._spawn()
+
+    # -- worker process group (the Peloponnese "Worker" group) ---------------
+    def _spawn(self) -> None:
+        coord = f"127.0.0.1:{_free_port()}"
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("XLA_FLAGS", None)  # workers set their own device count
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        for i in range(self.n):
+            log_path = os.path.join(self.root, f"worker{i}.log")
+            self._logs.append(log_path)
+            lf = open(log_path, "w")
+            p = subprocess.Popen(
+                [
+                    sys.executable, "-m", "dryad_tpu.cluster.worker",
+                    "--service-port", str(self.service.port),
+                    "--job", self.job_id,
+                    "--pid", str(i),
+                    "--nproc", str(self.n),
+                    "--devices-per-proc", str(self.k),
+                    "--coordinator", coord,
+                    "--root", self.root,
+                ],
+                stdout=lf, stderr=subprocess.STDOUT, env=env,
+            )
+            lf.close()
+            self._procs.append(p)
+        log.info(
+            "spawned %d workers x %d devices (job %s, psvc :%d)",
+            self.n, self.k, self.job_id, self.service.port,
+        )
+
+    def _worker_log_tail(self, i: int, nbytes: int = 2000) -> str:
+        try:
+            with open(self._logs[i], "rb") as fh:
+                fh.seek(0, os.SEEK_END)
+                size = fh.tell()
+                fh.seek(max(0, size - nbytes))
+                return fh.read().decode("utf-8", "replace")
+        except OSError:
+            return "<no log>"
+
+    def _check_workers_alive(self) -> None:
+        for i, p in enumerate(self._procs):
+            rc = p.poll()
+            if rc is not None:
+                raise RuntimeError(
+                    f"worker {i} exited rc={rc}; log tail:\n"
+                    + self._worker_log_tail(i)
+                )
+
+    # -- submission ----------------------------------------------------------
+    def _next_cseq(self) -> int:
+        self._cseq += 1
+        return self._cseq
+
+    def _command_round_trip(self, i: int, cmd: Dict):
+        """The GM->worker command protocol as a schedulable process fn:
+        set ``cmd/<i>``, long-poll ``status/<i>`` (DVertexCommand /
+        DVertexStatus, ``dvertexcommand.cpp:29-30``).  ``cmd`` must
+        carry a unique ``cseq``; statuses echoing an older cseq (a run
+        the driver already timed out on) are consumed and discarded so
+        they can't be misattributed to this command."""
+
+        def fn(proc: ClusterProcess) -> Dict:
+            mb = self.service.mailbox
+            mb.set_prop(self.job_id, f"cmd/{i}", json.dumps(cmd).encode())
+            deadline = time.monotonic() + self.timeout
+            while not proc.cancelled:
+                after = self._status_ver.get(i, 0)
+                got = mb.get_prop(self.job_id, f"status/{i}", after, timeout=1.0)
+                if got is not None:
+                    self._status_ver[i] = got[0]
+                    st = json.loads(got[1])
+                    if st.get("cseq") != cmd["cseq"]:
+                        continue  # stale status from an abandoned command
+                    if st.get("state") == "failed":
+                        raise RuntimeError(
+                            f"worker {i} failed: {st.get('error')}"
+                        )
+                    return st
+                self._check_workers_alive()
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"worker {i}: no status after {self.timeout}s; "
+                        f"log tail:\n" + self._worker_log_tail(i)
+                    )
+            return {"state": "canceled"}
+
+        return fn
+
+    def submit(self, query) -> Dict[str, np.ndarray]:
+        """Pack the query, run it across the worker gang, assemble the
+        result table (reference SubmitAndWait)."""
+        self._check_workers_alive()
+        self._seq += 1
+        seq = self._seq
+        job_dir = os.path.join(self.root, self.job_id, f"r{seq}")
+        os.makedirs(job_dir, exist_ok=True)
+        pkg_rel = f"{self.job_id}/r{seq}/job.pkg"
+        pack_query(query, os.path.join(self.root, pkg_rel))
+        result_rel = f"{self.job_id}/r{seq}/result"
+
+        cmd = {
+            "kind": "run", "package": pkg_rel,
+            "result_dir": result_rel, "seq": seq, "cseq": self._next_cseq(),
+        }
+        procs = []
+        for i in range(self.n):
+            p = ClusterProcess(
+                self._command_round_trip(i, cmd),
+                name=f"run{seq}-w{i}",
+                affinities=[Affinity(f"worker{i}", hard=True)],
+            )
+            self.scheduler.schedule(p)
+            procs.append(p)
+        for i, p in enumerate(procs):
+            if not p.wait(self.timeout + 30.0):
+                self.scheduler.cancel(p)
+                raise TimeoutError(f"worker {i} command round-trip hung")
+        failed = [p for p in procs if p.state is not ProcessState.COMPLETED]
+        if failed:
+            errs = "; ".join(f"{p.name}: {p.error}" for p in failed)
+            raise RuntimeError(f"local job failed: {errs}")
+
+        part_ids = sorted(
+            {g for p in procs for g in p.result.get("parts", [])}
+        )
+        return self._assemble(query, result_rel, part_ids)
+
+    def _assemble(
+        self, query, result_rel: str, part_ids: List[int]
+    ) -> Dict[str, np.ndarray]:
+        """Fetch result partitions through the file server (HTTP range
+        reads via the block cache) and decode to a host table."""
+        import jax.numpy as jnp
+
+        from dryad_tpu.columnar.batch import ColumnBatch
+
+        cols_parts = [
+            parse_partition_bytes(
+                self._client.read_whole_file(f"{result_rel}/part{g}.dpf")
+            )
+            for g in part_ids
+        ]
+        dictionary = StringDictionary()
+        dictionary._map.update(
+            pickle.loads(
+                self._client.read_whole_file(f"{result_rel}/dictionary.pkl")
+            )
+        )
+        phys = query.schema.device_names()
+        if not cols_parts:
+            return {n: np.zeros(0) for n in query.schema.names}
+        cols = {
+            c: np.concatenate([p[c] for p in cols_parts]) for c in phys
+        }
+        nrows = len(next(iter(cols.values()), []))
+        batch = ColumnBatch(
+            {c: jnp.asarray(v) for c, v in cols.items()},
+            jnp.ones((nrows,), jnp.bool_),  # workers wrote valid rows only
+        )
+        return batch.to_numpy(query.schema, dictionary)
+
+    def inject_fault(self, stage: Optional[str], count: int = 1) -> None:
+        """Broadcast a fault-injection command to every worker (remote
+        SetFakeVertexFailure; ``stage=None`` clears).  All gang members
+        must fault together — a partial fault would strand the rest in a
+        collective."""
+        cmd = {
+            "kind": "set_fault", "stage": stage, "count": count,
+            "cseq": self._next_cseq(),
+        }
+        procs = []
+        for i in range(self.n):
+            p = ClusterProcess(
+                self._command_round_trip(i, cmd),
+                name=f"fault-w{i}",
+                affinities=[Affinity(f"worker{i}", hard=True)],
+            )
+            self.scheduler.schedule(p)
+            procs.append(p)
+        for i, p in enumerate(procs):
+            if not p.wait(30.0) or p.state is not ProcessState.COMPLETED:
+                raise RuntimeError(f"fault injection on worker {i} failed: {p.error}")
+
+    # -- teardown ------------------------------------------------------------
+    def shutdown(self, graceful_timeout: float = 15.0) -> None:
+        try:
+            for i in range(self.n):
+                if self._procs[i].poll() is None:
+                    self.service.mailbox.set_prop(
+                        self.job_id, f"cmd/{i}",
+                        json.dumps(
+                            {"kind": "exit", "cseq": self._next_cseq()}
+                        ).encode(),
+                    )
+            deadline = time.monotonic() + graceful_timeout
+            for p in self._procs:
+                left = max(0.1, deadline - time.monotonic())
+                try:
+                    p.wait(timeout=left)
+                except subprocess.TimeoutExpired:
+                    p.terminate()
+                    try:
+                        p.wait(timeout=5)
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+        finally:
+            self.scheduler.shutdown()
+            self.service.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()

@@ -1,0 +1,190 @@
+"""Worker process for N-process local jobs — the VertexHost analog.
+
+The reference's worker node runs a long-lived daemon whose children poll
+a versioned property mailbox for a ``DVertexCommand``, execute the
+vertex, and post ``DVertexStatus`` back (``dvertexpncontrol.h:38-70``;
+mailbox ``ProcessService.cs:42-126``).  This module is the TPU-native
+worker: one OS process per mesh *slice* that
+
+1. joins the JAX multi-controller runtime (``jax.distributed``) so the
+   N workers' devices form ONE global mesh and compiled programs
+   gang-launch across processes (cross-process collectives ride gloo on
+   CPU, ICI/DCN on TPU),
+2. announces itself on the driver's ProcessService control plane
+   (membership + heartbeats, ``ControlPlane``),
+3. loops on its ``cmd/<pid>`` mailbox property: a ``run`` command names
+   a job package on the driver's file server; every worker executes the
+   SAME SPMD plan jointly, then writes the partitions it *owns* (its
+   addressable shards) as partition files for the driver to assemble —
+   the persisted-channel-file egress of the reference
+   (``DrPartitionFile.h:50``), and posts ``status/<pid>``.
+
+Run as ``python -m dryad_tpu.cluster.worker --service-port P --job J
+--pid I --nproc N --devices-per-proc K --coordinator H:P --root DIR``
+(spawned by ``cluster.localjob.LocalJobSubmission``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pickle
+import sys
+import tempfile
+import traceback
+from typing import Dict, List
+
+
+def _run_command(cmd: Dict, args, client, cp) -> Dict:
+    """Execute one ``run`` command: fetch the package, run the plan SPMD
+    over the global mesh, write owned result partitions."""
+    import numpy as np
+
+    from dryad_tpu.columnar.io import write_partition_file
+    from dryad_tpu.exec.jobpackage import load_query
+    from dryad_tpu.parallel.mesh import make_mesh, num_partitions
+
+    # Fetch the package through the driver's file server (HTTP range
+    # reads via the block cache — the managed-channel read path).
+    blob = client.read_whole_file(cmd["package"])
+    with tempfile.NamedTemporaryFile(suffix=".pkg", delete=False) as fh:
+        fh.write(blob)
+        pkg_path = fh.name
+    try:
+        mesh = make_mesh(args.nproc * args.devices_per_proc)
+        q = load_query(pkg_path, mesh=mesh)
+        ctx = q.ctx
+        # Everyone present before tracing/ingest: a straggler joining
+        # mid-collective would deadlock the gang, so gate here where the
+        # failure is a clean timeout instead (DrStartClique semantics).
+        cp.barrier(f"start/{cmd['seq']}", args.nproc)
+        batch = ctx._execute_device(q)
+        P = num_partitions(mesh)
+        cap = batch.capacity // P
+
+        out_dir = os.path.join(args.root, cmd["result_dir"])
+        os.makedirs(out_dir, exist_ok=True)
+        # Each addressable shard of the result IS one owned partition;
+        # write its valid rows as a partition file.
+        vshards = {
+            int(s.index[0].start or 0): np.asarray(s.data)
+            for s in batch.valid.addressable_shards
+        }
+        col_shards = {
+            c: {
+                int(s.index[0].start or 0): np.asarray(s.data)
+                for s in arr.addressable_shards
+            }
+            for c, arr in batch.data.items()
+        }
+        parts: List[int] = []
+        for start in sorted(vshards):
+            gid = start // cap
+            mask = vshards[start]
+            cols = {c: col_shards[c][start][mask] for c in col_shards}
+            write_partition_file(
+                os.path.join(out_dir, f"part{gid}.dpf"), cols
+            )
+            parts.append(gid)
+        if args.pid == 0:
+            # The dictionary is built at ingest (identically in every
+            # worker); ship one copy so the driver can decode strings.
+            with open(os.path.join(out_dir, "dictionary.pkl"), "wb") as fh:
+                pickle.dump(dict(ctx.dictionary._map), fh)
+        # All partitions durable before anyone reports success — the
+        # driver may start reading as soon as one status arrives.
+        cp.barrier(f"done/{cmd['seq']}", args.nproc)
+        return {"state": "completed", "parts": parts}
+    finally:
+        os.unlink(pkg_path)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--service-port", type=int, required=True)
+    ap.add_argument("--job", required=True)
+    ap.add_argument("--pid", type=int, required=True)
+    ap.add_argument("--nproc", type=int, required=True)
+    ap.add_argument("--devices-per-proc", type=int, default=1)
+    ap.add_argument("--coordinator", required=True)
+    ap.add_argument("--root", required=True)
+    args = ap.parse_args(argv)
+
+    # Backend setup MUST precede any backend query: pin CPU with K local
+    # devices, select gloo for cross-process CPU collectives, then join
+    # the multi-controller runtime.  (On real TPU pods the distributed
+    # runtime is joined the same way with the default backend.)
+    from dryad_tpu.parallel.mesh import force_cpu_backend
+
+    force_cpu_backend(args.devices_per_proc)
+    import jax
+
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass  # older jaxlib: single CPU collective impl
+
+    from dryad_tpu.parallel.multihost import ControlPlane, init_distributed
+
+    init_distributed(args.coordinator, args.nproc, args.pid)
+
+    from dryad_tpu.cluster.service import ServiceClient
+
+    client = ServiceClient("127.0.0.1", args.service_port)
+    cp = ControlPlane(args.job, args.pid, client=client)
+    cp.announce({"devices": args.devices_per_proc, "ospid": os.getpid()})
+    cp.start_heartbeat()
+
+    after = 0
+    while True:
+        got = client.get_prop(args.job, f"cmd/{args.pid}", after, timeout=2.0)
+        if got is None:
+            continue
+        after, body = got
+        cmd = json.loads(body)
+        # Every status echoes the command's unique id ("cseq") so the
+        # driver can discard stale statuses from a command it already
+        # gave up on (e.g. a run that outlived its timeout).
+        cseq = cmd.get("cseq")
+        if cmd["kind"] == "exit":
+            client.set_prop(
+                args.job, f"status/{args.pid}",
+                json.dumps({"state": "exited", "cseq": cseq}).encode(),
+            )
+            cp.stop_heartbeat()
+            return 0
+        if cmd["kind"] == "set_fault":
+            # Remote fault injection (SetFakeVertexFailure over the
+            # command mailbox): must reach EVERY worker — a fault raised
+            # in only some gang members would strand the others in a
+            # collective, so the driver broadcasts this to all.
+            from dryad_tpu.exec import faults
+
+            if cmd.get("stage"):
+                faults.set_fake_stage_failure(
+                    cmd["stage"], int(cmd.get("count", 1))
+                )
+            else:
+                faults.clear_faults()
+            client.set_prop(
+                args.job, f"status/{args.pid}",
+                json.dumps({"state": "fault_set", "cseq": cseq}).encode(),
+            )
+            continue
+        if cmd["kind"] == "run":
+            try:
+                status = _run_command(cmd, args, client, cp)
+            except Exception as e:  # noqa: BLE001 — report, keep serving
+                traceback.print_exc()
+                info = {"error": f"{type(e).__name__}: {e}", "cmd": cmd}
+                cp.report_failure(info)
+                status = {"state": "failed", "error": info["error"]}
+            status["cseq"] = cseq
+            client.set_prop(
+                args.job, f"status/{args.pid}", json.dumps(status).encode()
+            )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -57,21 +57,33 @@ def pack_query(query, path: str) -> Dict[str, Any]:
     }
 
 
-def run_package(path: str, ctx=None):
-    """Load a job package and execute it, returning the host table.
-
+def load_query(path: str, ctx=None, mesh=None):
+    """Load a job package into a (possibly provided) context and return
+    the lazy Query, NOT yet executed.  ``mesh`` lets a worker process run
+    the plan over a specific (e.g. global multi-process) device mesh;
     ``ctx`` defaults to a fresh DryadContext built from the packaged
-    config — the entry point a worker process calls after learning the
-    package path from the control plane."""
+    config."""
     from dryad_tpu.api.context import DryadContext
     from dryad_tpu.api.query import Query
 
+    if ctx is not None and mesh is not None:
+        raise ValueError(
+            "pass either ctx or mesh, not both (a provided ctx already "
+            "owns its mesh)"
+        )
     with open(path, "rb") as fh:
         blob = pickle.load(fh)
     if blob.get("version") != PACKAGE_VERSION:
         raise ValueError(f"unsupported package version {blob.get('version')}")
     if ctx is None:
-        ctx = DryadContext(config=blob["config"])
+        ctx = DryadContext(config=blob["config"], mesh=mesh)
     ctx.dictionary._map.update(blob["dictionary"])
     ctx._bindings.update(blob["bindings"])
-    return Query(ctx, blob["node"]).collect()
+    return Query(ctx, blob["node"])
+
+
+def run_package(path: str, ctx=None):
+    """Load a job package and execute it, returning the host table —
+    the entry point a worker process calls after learning the package
+    path from the control plane."""
+    return load_query(path, ctx=ctx).collect()
