@@ -195,7 +195,27 @@ class LocalDebugInterpreter:
             want = K.group_carry_cols(node.schema, node.schema.names)
             return {c: out[c] for c in want}
 
+        from dryad_tpu.columnar.schema import ColumnType, join64, split64
+
         for op, col, name in node.params["aggs"]:
+            if (
+                col is not None
+                and col not in t
+                and in_schema.field(col).ctype is ColumnType.INT64
+                and op in ("sum", "min", "max")
+            ):
+                # split int64 column: independent numpy-int64 oracle for
+                # the engine's paired-word arithmetic (wrapping sum)
+                full = join64(
+                    np.asarray(t[f"{col}#h0"]), np.asarray(t[f"{col}#h1"]),
+                    signed=True,
+                )
+                with np.errstate(over="ignore"):
+                    vals64 = np.array(
+                        [getattr(full[idx], op)() for idx in order], np.int64
+                    )
+                out[f"{name}#h0"], out[f"{name}#h1"] = split64(vals64)
+                continue
             vals = []
             for idx in order:
                 a = np.asarray(t[col])[idx] if col is not None else None

@@ -65,6 +65,68 @@ def _first_scatter(
     return jnp.zeros((cap + 1,) + val.shape[1:], val.dtype).at[idx].set(val)[:cap]
 
 
+PAIR_OPS = ("sum64", "min64", "max64")
+
+
+def _segmented_pair_reduce(
+    op: str,
+    lo: jax.Array,
+    hi: jax.Array,
+    v: jax.Array,
+    start: jax.Array,
+    seg: jax.Array,
+    cap: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-segment 64-bit reduce over a split (low, high) uint32 column.
+
+    jax x64 stays off (int64 lives as two u32 device words,
+    ``columnar/schema.py``), so the reduction is a flagged segmented
+    ``associative_scan`` whose combine does the 64-bit arithmetic on
+    word pairs: carry-propagating add for ``sum64``, signed-lexicographic
+    (high word signed, low word unsigned) select for ``min64``/``max64``.
+    The reference's full numeric aggregate surface is
+    ``DryadLinqQueryGen.cs:3439ff``.
+    """
+    flags = start
+
+    if op == "sum64":
+        def combine(a, b):
+            fa, alo, ahi = a
+            fb, blo, bhi = b
+            slo = alo + blo  # uint32 wraps mod 2^32
+            carry = (slo < blo).astype(jnp.uint32)
+            shi = ahi + bhi + carry
+            return (
+                fa | fb,
+                jnp.where(fb, blo, slo),
+                jnp.where(fb, bhi, shi),
+            )
+    else:
+        def combine(a, b):
+            fa, alo, ahi = a
+            fb, blo, bhi = b
+            ahs, bhs = ahi.astype(jnp.int32), bhi.astype(jnp.int32)
+            a_less = (ahs < bhs) | ((ahs == bhs) & (alo < blo))
+            take_a = a_less if op == "min64" else ~a_less
+            return (
+                fa | fb,
+                jnp.where(fb, blo, jnp.where(take_a, alo, blo)),
+                jnp.where(fb, bhi, jnp.where(take_a, ahi, bhi)),
+            )
+
+    _, slo, shi = jax.lax.associative_scan(combine, (flags, lo, hi))
+
+    # Segment results live at each segment's LAST valid row (invalid
+    # rows sort to the tail, so they never contaminate gathered rows).
+    nxt_start = jnp.concatenate([start[1:], jnp.array([True])])
+    nxt_valid = jnp.concatenate([v[1:], jnp.array([False])])
+    last = v & (nxt_start | ~nxt_valid)
+    idx = jnp.where(last, seg, cap)
+    out_lo = jnp.zeros((cap + 1,), lo.dtype).at[idx].set(slo)[:cap]
+    out_hi = jnp.zeros((cap + 1,), hi.dtype).at[idx].set(shi)[:cap]
+    return out_lo, out_hi
+
+
 def group_reduce(
     batch: ColumnBatch,
     key_cols: Sequence[str],
@@ -87,6 +149,17 @@ def group_reduce(
         if a.op == "count":
             data = jnp.ones((cap,), jnp.int32)
             out[a.out] = jax.ops.segment_sum(data, seg, nsegments)[:cap]
+            continue
+        if a.op in PAIR_OPS:
+            # a.col names the LOW word of a split 64-bit column; the
+            # high word lives alongside it and the output writes both.
+            lo_col = a.col
+            hi_col = lo_col[: -len("#h0")] + "#h1"
+            out_lo, out_hi = _segmented_pair_reduce(
+                a.op, sb.data[lo_col], sb.data[hi_col], v, start, seg, cap
+            )
+            out[f"{a.out}#h0"] = out_lo
+            out[f"{a.out}#h1"] = out_hi
             continue
         col = sb.data[a.col]
         if a.op == "sum":

@@ -301,9 +301,18 @@ class _Builder:
         from dryad_tpu.ops.segmented import AggSpec
 
         out = []
+        from dryad_tpu.columnar.schema import ColumnType
+
         for op, col, name in aggs:
             if col is not None:
                 f = schema.field(col)
+                if f.ctype is ColumnType.INT64 and op in ("sum", "min", "max"):
+                    # exact 64-bit arithmetic over the split (#h0, #h1)
+                    # word pair (carry-propagating add / signed-lex
+                    # compare, ops/segmented.py; the reference's numeric
+                    # aggregate surface is DryadLinqQueryGen.cs:3439ff)
+                    out.append(AggSpec(f"{op}64", f"{col}#h0", name))
+                    continue
                 if f.ctype.is_split:
                     if op != "first":
                         raise ValueError(
@@ -490,16 +499,32 @@ class _Builder:
         # and descending ranges are different partitionings.  Bucketing
         # uses the primary operand only and equal primaries colocate, so
         # a matching primary (name, desc) suffices.
+        # A spread input (skew-proof order_by) keeps global ORDER but
+        # not equal-key colocation, so neither a range_partition (which
+        # promises colocation) nor an order_by with different secondary
+        # keys (whose local re-sort could not fix a straddling run) may
+        # elide its exchange over it.
+        spread_ok = (
+            node.kind == "order_by" and src_p.ordered_by == tuple(keys)
+        )
         already_ranged = (
             src_p.scheme == "range"
             and len(src_p.range_by) > 0
             and src_p.range_by[0] == keys[0]
+            and (not src_p.spread or spread_ok)
         )
         if not already_ranged:
+            # order_by only needs global ORDER, so its exchange spreads
+            # equal keys across partitions (skew-proof, kernels.py
+            # _k_exchange_range); range_partition promises equal-key
+            # COLOCATION and keeps strict splitters.
             stage.ops.append(
                 StageOp(
                     "exchange_range",
-                    dict(slot=slot, operands_fn=operands_fn),
+                    dict(
+                        slot=slot, operands_fn=operands_fn,
+                        spread=node.kind == "order_by",
+                    ),
                 )
             )
             stage.ops.append(StageOp("resize", dict(slot=slot, factor=stage.growth)))
@@ -618,6 +643,10 @@ def _decompose_aggs(aggs):
         elif a.op in ("min", "max", "first", "any", "all"):
             partial.append(AggSpec(a.op, a.col, a.out))
             final.append(AggSpec(a.op, a.out, a.out))
+        elif a.op in ("sum64", "min64", "max64"):
+            # partial writes out#h0/out#h1; final re-reduces that pair
+            partial.append(AggSpec(a.op, a.col, a.out))
+            final.append(AggSpec(a.op, f"{a.out}#h0", a.out))
         elif a.op == "mean":
             partial.append(AggSpec("sum", a.col, f"{a.out}#s"))
             partial.append(AggSpec("count", None, f"{a.out}#c"))
