@@ -64,6 +64,16 @@ def _order_keys(keys: Sequence[OrderArg]) -> List[Tuple[str, bool]]:
     return out
 
 
+class _Project:
+    """Name-projection row fn, picklable for job packages."""
+
+    def __init__(self, phys: List[str]):
+        self.phys = list(phys)
+
+    def __call__(self, cols: Dict) -> Dict:
+        return {c: cols[c] for c in self.phys}
+
+
 class Query:
     """Lazy distributed table: a logical plan node plus its context."""
 
@@ -99,11 +109,9 @@ class Query:
         """Column projection by name."""
         names = _keys(names)
         out_schema = self.schema.select(names)
-        phys = out_schema.device_names()
-
-        def fn(cols: Dict) -> Dict:
-            return {c: cols[c] for c in phys}
-
+        # a picklable callable (not a closure): projections must survive
+        # job packaging (exec.jobpackage)
+        fn = _Project(out_schema.device_names())
         keep = self.node.partition
         if keep.keys and not all(k in out_schema for k in keep.keys):
             keep = PartitionInfo()
@@ -251,7 +259,7 @@ class Query:
         right_keys: Optional[KeyArg] = None,
         expansion: float = 4.0,
         suffix: str = "_r",
-        strategy: str = "shuffle",
+        strategy: str = "auto",
     ) -> "Query":
         """Inner equi-join (reference Join): co-hash-partition + local
         join, or replicate a small right side (``strategy`` in
@@ -280,14 +288,14 @@ class Query:
     def semi_join(
         self, other: "Query", left_keys: KeyArg,
         right_keys: Optional[KeyArg] = None, expansion: float = 4.0,
-        strategy: str = "shuffle",
+        strategy: str = "auto",
     ) -> "Query":
         return self._semi(other, left_keys, right_keys, expansion, False, strategy)
 
     def anti_join(
         self, other: "Query", left_keys: KeyArg,
         right_keys: Optional[KeyArg] = None, expansion: float = 4.0,
-        strategy: str = "shuffle",
+        strategy: str = "auto",
     ) -> "Query":
         return self._semi(other, left_keys, right_keys, expansion, True, strategy)
 
@@ -374,7 +382,9 @@ class Query:
         self._require_cols([n for n, _ in ks], "in order_by")
         node = Node(
             "order_by", [self.node], self.schema,
-            PartitionInfo.ranged(ks, ks), keys=ks,
+            # spread: the skew-proof exchange may split equal keys
+            # across a partition boundary (plan/nodes.py PartitionInfo)
+            PartitionInfo.ranged(ks, ks, spread=True), keys=ks,
         )
         return Query(self.ctx, node)
 
@@ -581,7 +591,7 @@ class Query:
         right_defaults: Optional[Dict[str, Any]] = None,
         expansion: float = 4.0,
         suffix: str = "_r",
-        strategy: str = "shuffle",
+        strategy: str = "auto",
     ) -> "Query":
         """Left-outer equi-join: unmatched left rows survive with
         default-valued right columns (the GroupJoin + DefaultIfEmpty
@@ -616,7 +626,7 @@ class Query:
         aggs: Optional[Dict[str, Tuple[str, Optional[str]]]] = None,
         defaults: Optional[Dict[str, Any]] = None,
         expansion: float = 4.0,
-        strategy: str = "shuffle",
+        strategy: str = "auto",
     ) -> "Query":
         """GroupJoin (reference ``DryadLinqQueryable`` GroupJoin): per
         left row, aggregates over the group of matching right rows;
@@ -685,7 +695,7 @@ class Query:
         right_keys: Optional[KeyArg] = None,
         out: str = "match_count",
         expansion: float = 4.0,
-        strategy: str = "shuffle",
+        strategy: str = "auto",
     ) -> "Query":
         """GroupJoin's aggregate shape (reference GroupJoin): per left
         row, the count of matching right rows as a new INT32 column.

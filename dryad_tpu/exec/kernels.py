@@ -182,6 +182,18 @@ def _do_resize(ctx: StageContext, slot: int, factor: float) -> None:
     ctx.overflow = ctx.overflow | ovf
 
 
+# Op kinds whose kernels never set the overflow flag: a stage composed
+# only of these has a statically-False overflow, so the driver skips the
+# host sync on it and lets JAX async dispatch pipeline it with
+# independent stages (the message-pump overlap of the reference GM,
+# DrMessagePump.h:116-180, recovered through XLA's async runtime).
+NON_OVERFLOW_OPS = frozenset({
+    "select", "where", "project", "select_many", "apply", "fork",
+    "group_reduce", "group_combine", "group_reduce_dense", "distinct",
+    "local_sort", "concat", "scalar_agg",
+})
+
+
 def _k_exchange_hash(ctx: StageContext, p) -> None:
     _do_exchange_hash(ctx, p["slot"], p["keys"], p.get("tree"))
 
@@ -190,8 +202,26 @@ def _k_exchange_range(ctx: StageContext, p) -> None:
     b = ctx.slots[p["slot"]]
     operands = p["operands_fn"](b)
     m = min(128, max(16, b.capacity // 8))
-    splitters = SORT.sample_splitters(operands[0], b.valid, ctx.P, m, ctx.axes)
-    dest = SORT.range_dest(operands[0], splitters)
+    if p.get("spread"):
+        # Skew-proof variant for pure ordering (order_by): splitters
+        # elected over ALL sort operands plus a uniform synthetic
+        # tiebreak, so a heavy key's run is cut across partitions in
+        # sampled proportions instead of pinning one partition and
+        # boost-doubling everybody (automatic analog of
+        # DrDynamicDistributor.h:26,79).  Global order still holds —
+        # partition boundaries respect the extended lexicographic key.
+        # Not used for range_partition, which promises key colocation.
+        words = [o.astype(jnp.uint32) for o in operands]
+        words.append(SORT.spread_word(b.capacity))
+        splitters = SORT.sample_splitters_multi(
+            words, b.valid, ctx.P, m, ctx.axes
+        )
+        dest = SORT.range_dest_multi(words, splitters)
+    else:
+        splitters = SORT.sample_splitters(
+            operands[0], b.valid, ctx.P, m, ctx.axes
+        )
+        dest = SORT.range_dest(operands[0], splitters)
     B = SH.bucket_capacity(b.capacity, ctx.P, ctx.slack * ctx.boost)
     out, ovf = SH.exchange(b, dest, ctx.P, B, ctx.axes)
     ctx.slots[p["slot"]] = out

@@ -81,3 +81,71 @@ def range_dest(key_u32: jax.Array, splitters: jax.Array) -> jax.Array:
     always share a partition, keeping secondary ordering purely local.
     """
     return jnp.searchsorted(splitters, key_u32, side="right").astype(jnp.int32)
+
+
+# -- skew-proof multi-word variant (automatic heavy-key mitigation) --------
+
+def sample_splitters_multi(
+    words: Sequence[jax.Array],
+    valid: jax.Array,
+    num_partitions: int,
+    samples_per_partition: int,
+    axis_name: str = "p",
+) -> List[jax.Array]:
+    """Splitter election over a LEXICOGRAPHIC multi-word key.
+
+    The automatic skew mitigation (reference
+    ``DrDynamicDistributor.h:26,79`` redistributes by observed size):
+    callers append a uniform synthetic tiebreak word, so a heavy key —
+    which would pin its entire run to one range partition and force
+    boost-doubling — is split across partitions in sample-estimated
+    proportions.  Returns one ``(P-1,)`` splitter array per word.
+    """
+    P, m = num_partitions, samples_per_partition
+    order = sort_order_by_operands(list(words), valid)
+    count = jnp.sum(valid.astype(jnp.int32))
+    pos = (jnp.arange(m, dtype=jnp.float32) + 0.5) * count.astype(jnp.float32) / m
+    idx = jnp.clip(pos.astype(jnp.int32), 0, jnp.maximum(count - 1, 0))
+    samples = [w[order][idx] for w in words]
+    sample_valid = jnp.full((m,), count > 0)
+
+    gathered = [
+        jax.lax.all_gather(s, axis_name, tiled=True) for s in samples
+    ]
+    all_valid = jax.lax.all_gather(sample_valid, axis_name, tiled=True)
+    total = jnp.sum(all_valid.astype(jnp.int32))
+    # invalid samples sort to +inf in every word
+    ops = tuple(
+        jnp.where(all_valid, g, jnp.uint32(0xFFFFFFFF)).astype(jnp.uint32)
+        for g in gathered
+    )
+    sorted_ops = jax.lax.sort(ops, num_keys=len(ops))
+    ranks = jnp.arange(1, P, dtype=jnp.float32) * total.astype(jnp.float32) / P
+    sidx = jnp.clip(ranks.astype(jnp.int32), 0, jnp.maximum(total - 1, 0))
+    return [so[sidx] for so in sorted_ops]
+
+
+def range_dest_multi(
+    words: Sequence[jax.Array], splitters: Sequence[jax.Array]
+) -> jax.Array:
+    """Destination by lexicographic compare against multi-word splitters
+    (side='right' semantics: a row passes every splitter <= it)."""
+    n = words[0].shape[0]
+    pm1 = splitters[0].shape[0]
+    lt = jnp.zeros((n, pm1), jnp.bool_)  # splitter < row, decided so far
+    eq = jnp.ones((n, pm1), jnp.bool_)
+    for w, s in zip(words, splitters):
+        w2 = w.astype(jnp.uint32)[:, None]
+        s2 = s.astype(jnp.uint32)[None, :]
+        lt = lt | (eq & (s2 < w2))
+        eq = eq & (s2 == w2)
+    return jnp.sum((lt | eq).astype(jnp.int32), axis=1)
+
+
+def spread_word(n: int) -> jax.Array:
+    """Uniform synthetic tiebreak word (Knuth multiplicative hash of the
+    row index): equal keys get distinct, evenly distributed tiebreaks,
+    so splitter election can cut inside a heavy key's run."""
+    return (
+        jnp.arange(n, dtype=jnp.uint32) * jnp.uint32(2654435761)
+    ).astype(jnp.uint32)

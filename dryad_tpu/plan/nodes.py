@@ -30,12 +30,17 @@ class PartitionInfo:
     by (direction matters: ascending vs descending ranges differ).
     ordered_by: (name, descending) chain if each partition is ALSO
     locally sorted (set by order_by, not by bare range_partition).
+    spread: True when the range exchange used skew-spread splitters
+    (order_by): global ORDER holds but equal keys may straddle a
+    partition boundary, so consumers needing equal-key COLOCATION
+    (range_partition elision) must re-exchange.
     """
 
     scheme: str = "any"
     keys: Tuple[str, ...] = ()
     range_by: Tuple[Tuple[str, bool], ...] = ()
     ordered_by: Tuple[Tuple[str, bool], ...] = ()
+    spread: bool = False
 
     @staticmethod
     def roundrobin() -> "PartitionInfo":
@@ -49,12 +54,14 @@ class PartitionInfo:
     def ranged(
         range_by: Sequence[Tuple[str, bool]],
         ordered: Sequence[Tuple[str, bool]] = (),
+        spread: bool = False,
     ) -> "PartitionInfo":
         return PartitionInfo(
             "range",
             tuple(n for n, _ in range_by),
             tuple((n, bool(d)) for n, d in range_by),
             tuple(ordered),
+            spread,
         )
 
 

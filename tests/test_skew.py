@@ -1,0 +1,149 @@
+"""Automatic skew handling.
+
+The reference redistributes data-size-skewed stages at runtime
+(``DrDynamicDistributor.h:26,79``; ``DrDynamicRangeDistributor.cpp``).
+The TPU engine's skew story, verified here:
+
+- builtin group_by is skew-IMMUNE by construction: the pre-shuffle
+  partial combine collapses a heavy key to <=1 row per source partition
+  (``plan/lower.py`` partial/final decomposition) — no ``salt=`` needed;
+- order_by's range exchange is skew-PROOF automatically: splitters are
+  elected over the sort key extended with a uniform tiebreak word
+  (``ops/sort.py`` sample_splitters_multi), cutting a heavy key's run
+  across partitions instead of boost-doubling every partition.
+"""
+
+import numpy as np
+
+from dryad_tpu import DryadContext
+from dryad_tpu.exec.events import EventLog
+
+
+def _run(tbl, build):
+    """Run a query; return (table, overflow event count)."""
+    ctx = DryadContext(num_partitions_=8)
+    ev = EventLog(None)
+    ctx.executor.events = ev
+    out = build(ctx.from_arrays(tbl)).collect()
+    kinds = [e["kind"] for e in ev.events()]
+    return out, kinds.count("stage_overflow")
+
+
+def _tables(rng, n=1 << 13):
+    uniform = rng.integers(0, 1000, n).astype(np.int32)
+    skewed = np.where(
+        rng.random(n) < 0.9, 0, rng.integers(0, 1000, n)
+    ).astype(np.int32)
+    v = rng.standard_normal(n).astype(np.float32)
+    return uniform, skewed, v
+
+
+def test_group_by_heavy_key_no_salt_no_extra_boosts(rng):
+    """90%-one-key group_by without ``salt=``: no more boost retries
+    than the uniform case (both zero — partial combine collapses the
+    heavy key before the shuffle)."""
+    uniform, skewed, v = _tables(rng)
+    build = lambda q: q.group_by("k", {"s": ("sum", "v"), "c": ("count", None)})  # noqa: E731
+    out_u, ovf_u = _run({"k": uniform, "v": v}, build)
+    out_s, ovf_s = _run({"k": skewed, "v": v}, build)
+    assert ovf_s <= ovf_u == 0
+    assert int(out_s["c"].sum()) == len(skewed)
+    heavy = out_s["c"][list(out_s["k"]).index(0)]
+    assert heavy > 0.85 * len(skewed)
+
+
+def test_order_by_heavy_key_no_overflow(rng):
+    """90%-one-key order_by: the spread exchange balances partitions, so
+    no overflow/boost retries occur (pre-spread this measured 2)."""
+    uniform, skewed, v = _tables(rng)
+    out_u, ovf_u = _run({"k": uniform, "v": v}, lambda q: q.order_by(["k"]))
+    out_s, ovf_s = _run({"k": skewed, "v": v}, lambda q: q.order_by(["k"]))
+    assert ovf_u == 0 and ovf_s == 0
+    np.testing.assert_array_equal(out_s["k"], np.sort(skewed))
+    assert len(out_s["v"]) == len(v)
+
+
+def test_order_by_secondary_keys_under_skew(rng):
+    """Spread splitters extend over ALL sort operands: a secondary key
+    stays globally ordered within equal primaries."""
+    n = 1 << 12
+    primary = np.where(
+        rng.random(n) < 0.9, 7, rng.integers(0, 50, n)
+    ).astype(np.int32)
+    secondary = rng.integers(0, 10_000, n).astype(np.int32)
+    out, ovf = _run(
+        {"a": primary, "b": secondary},
+        lambda q: q.order_by(["a", "b"]),
+    )
+    assert ovf == 0
+    got = list(zip(out["a"].tolist(), out["b"].tolist()))
+    assert got == sorted(zip(primary.tolist(), secondary.tolist()))
+
+
+def test_order_by_descending_under_skew(rng):
+    n = 1 << 12
+    k = np.where(
+        rng.random(n) < 0.9, -3, rng.integers(-100, 100, n)
+    ).astype(np.int32)
+    out, ovf = _run({"k": k}, lambda q: q.order_by([("k", True)]))
+    assert ovf == 0
+    np.testing.assert_array_equal(out["k"], np.sort(k)[::-1])
+
+
+def test_range_partition_after_order_by_reexchanges(rng):
+    """A spread order_by output cannot satisfy range_partition's
+    colocation promise: the downstream range_partition must NOT elide
+    its exchange."""
+    from dryad_tpu.plan.lower import lower
+
+    ctx = DryadContext(num_partitions_=8)
+    q = (
+        ctx.from_arrays({"k": rng.integers(0, 50, 512).astype(np.int32)})
+        .order_by(["k"])
+        .range_partition("k")
+    )
+    graph = lower([q.node], ctx.config)
+    ex = [
+        op for st in graph.stages for op in st.ops
+        if op.kind == "exchange_range"
+    ]
+    # two exchanges: order_by's (spread) and range_partition's (strict)
+    assert len(ex) == 2
+    assert ex[0].params.get("spread") and not ex[1].params.get("spread")
+
+
+def test_repeat_order_by_same_keys_elides(rng):
+    """An identical order_by over a spread input IS elidable (the local
+    sort is a no-op; global order already holds)."""
+    from dryad_tpu.plan.lower import lower
+
+    ctx = DryadContext(num_partitions_=8)
+    q = (
+        ctx.from_arrays({"k": rng.integers(0, 50, 512).astype(np.int32)})
+        .order_by(["k"])
+        .order_by(["k"])
+    )
+    graph = lower([q.node], ctx.config)
+    ex = [
+        op for st in graph.stages for op in st.ops
+        if op.kind == "exchange_range"
+    ]
+    assert len(ex) == 1
+
+
+def test_range_partition_keeps_colocation(rng):
+    """range_partition (unlike order_by) still promises equal-key
+    colocation: a heavy key may overflow into boosts, but every key
+    lands whole on one partition."""
+    from dryad_tpu.plan.lower import lower
+
+    ctx = DryadContext(num_partitions_=8)
+    q = ctx.from_arrays(
+        {"k": rng.integers(0, 50, 512).astype(np.int32)}
+    ).range_partition("k")
+    graph = lower([q.node], ctx.config)
+    ex = [
+        op for st in graph.stages for op in st.ops
+        if op.kind == "exchange_range"
+    ]
+    assert ex and not ex[0].params.get("spread")
