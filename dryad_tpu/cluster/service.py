@@ -141,6 +141,18 @@ class BlockCache:
                 self._spilled[old_key] = sp
                 self.spills += 1
 
+    def invalidate(self, rel: str) -> None:
+        """Drop cached blocks of one file (after an overwrite)."""
+        with self._lock:
+            for key in [k for k in self._mem if k[0] == rel]:
+                self._mem_bytes -= len(self._mem.pop(key))
+            for key in [k for k in self._spilled if k[0] == rel]:
+                sp = self._spilled.pop(key)
+                try:
+                    os.unlink(sp)
+                except OSError:
+                    pass
+
     def read(self, rel: str, offset: int, length: int) -> bytes:
         """Range read through the cache."""
         out = bytearray()
@@ -179,6 +191,10 @@ class _Handler(http.server.BaseHTTPRequestHandler):
     GET  /prop/<pid>/<name>?after=V&timeout=T   long-poll property read
     POST /prop/<pid>/<name>                     set property (body=value)
     GET  /file/<relpath>?offset=O&length=L      range read via block cache
+         (&compress=1: zlib-deflate the payload — the channel-boundary
+         compression transform of the reference, ``dryadvertex.h:33-48``)
+    PUT  /file/<relpath>                        write a file under root
+         (X-Encoding: deflate body accepted) — the bulk-store egress
     GET  /status                                service health/stats
     """
 
@@ -217,7 +233,16 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                     q.get("length", [str(self.service.cache.block_size)])[0]
                 )
                 data = self.service.cache.read(rel, offset, length)
-                self._send(200, data, {"X-File-Size": str(self.service.cache.file_size(rel))})
+                headers = {
+                    "X-File-Size": str(self.service.cache.file_size(rel)),
+                    "X-Raw-Length": str(len(data)),
+                }
+                if q.get("compress", ["0"])[0] == "1":
+                    import zlib
+
+                    data = zlib.compress(data, 1)
+                    headers["X-Encoding"] = "deflate"
+                self._send(200, data, headers)
             elif parts[0] == "status":
                 c = self.service.cache
                 body = (
@@ -247,6 +272,26 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         except Exception as e:  # noqa: BLE001
             self._send(500, str(e).encode())
 
+    def do_PUT(self):
+        u = urllib.parse.urlparse(self.path)
+        parts = u.path.strip("/").split("/")
+        try:
+            if parts[0] == "file" and len(parts) >= 2:
+                n = int(self.headers.get("Content-Length", "0"))
+                body = self.rfile.read(n)
+                if self.headers.get("X-Encoding") == "deflate":
+                    import zlib
+
+                    body = zlib.decompress(body)
+                self.service.write_file("/".join(parts[1:]), body)
+                self._send(200, b"")
+            else:
+                self._send(404, b"not found")
+        except PermissionError as e:
+            self._send(403, str(e).encode())
+        except Exception as e:  # noqa: BLE001
+            self._send(500, str(e).encode())
+
 
 class ProcessService:
     """The per-node daemon: mailbox + file server on one HTTP port."""
@@ -273,6 +318,20 @@ class ProcessService:
         self._thread.start()
         log.info("ProcessService on port %d root=%s", self.port, self.root)
 
+    def write_file(self, rel: str, data: bytes) -> None:
+        """Write a file under the served root (bulk-store ingest path);
+        atomic replace, stale cache blocks dropped."""
+        path = os.path.realpath(os.path.join(self.root, rel))
+        root = os.path.realpath(self.root)
+        if not path.startswith(root + os.sep) and path != root:
+            raise PermissionError(f"path escapes root: {rel}")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.put.tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+        os.replace(tmp, path)
+        self.cache.invalidate(rel)
+
     def close(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
@@ -291,6 +350,14 @@ class ServiceClient:
 
     def __init__(self, host: str, port: int):
         self.host, self.port = host, port
+        self.wire_bytes = 0  # bytes on the wire (post-compression)
+        self.raw_bytes = 0  # decoded payload bytes
+        self._acct = threading.Lock()
+
+    def _account(self, wire: int, raw: int) -> None:
+        with self._acct:
+            self.wire_bytes += wire
+            self.raw_bytes += raw
 
     def _conn(self, timeout: float = 30.0) -> http.client.HTTPConnection:
         return http.client.HTTPConnection(self.host, self.port, timeout=timeout)
@@ -327,27 +394,68 @@ class ServiceClient:
         finally:
             c.close()
 
-    def read_file(self, rel: str, offset: int = 0, length: int = DEFAULT_BLOCK) -> bytes:
+    def read_file(
+        self,
+        rel: str,
+        offset: int = 0,
+        length: int = DEFAULT_BLOCK,
+        compress: bool = False,
+    ) -> bytes:
+        """One range read; ``compress`` applies the wire-compression
+        transform (zlib over the DCN hop, ``dryadvertex.h:33-48``).
+        ``self.wire_bytes``/``self.raw_bytes`` accumulate transfer
+        accounting for observability."""
         c = self._conn()
         try:
-            c.request("GET", f"/file/{rel}?offset={offset}&length={length}")
+            url = f"/file/{rel}?offset={offset}&length={length}"
+            if compress:
+                url += "&compress=1"
+            c.request("GET", url)
             r = c.getresponse()
             body = r.read()
             if r.status == 404:
                 raise FileNotFoundError(rel)
             if r.status != 200:
                 raise RuntimeError(f"read_file failed: {r.status} {body!r}")
+            wire = len(body)
+            if r.getheader("X-Encoding") == "deflate":
+                import zlib
+
+                body = zlib.decompress(body)
+            self._account(wire, len(body))
             return body
         finally:
             c.close()
 
-    def read_whole_file(self, rel: str, chunk: int = DEFAULT_BLOCK) -> bytes:
+    def read_whole_file(
+        self, rel: str, chunk: int = DEFAULT_BLOCK, compress: bool = False
+    ) -> bytes:
         """Stream a whole remote file by range reads."""
         out = bytearray()
         offset = 0
         while True:
-            data = self.read_file(rel, offset, chunk)
+            data = self.read_file(rel, offset, chunk, compress=compress)
             out += data
             offset += len(data)
             if len(data) < chunk:
                 return bytes(out)
+
+    def write_file(self, rel: str, data: bytes, compress: bool = True) -> None:
+        """PUT a whole file to the remote store root (bulk egress)."""
+        headers = {}
+        body = data
+        if compress:
+            import zlib
+
+            body = zlib.compress(data, 1)
+            headers["X-Encoding"] = "deflate"
+        c = self._conn()
+        try:
+            c.request("PUT", f"/file/{rel}", body=body, headers=headers)
+            r = c.getresponse()
+            msg = r.read()
+            if r.status != 200:
+                raise RuntimeError(f"write_file failed: {r.status} {msg!r}")
+            self._account(len(body), len(data))
+        finally:
+            c.close()

@@ -150,20 +150,34 @@ class MemProvider(DataProvider):
 
 
 class HttpStoreProvider(DataProvider):
-    """Read a partitioned store served by a remote ProcessService
-    FileServer: ``http://host:port/<relative store dir>``."""
+    """A partitioned store on a remote ProcessService FileServer:
+    ``http://host:port/<relative store dir>`` — the bulk remote-store
+    scheme (the reference's HDFS/Azure stream role,
+    ``GraphManager/filesystem/DrHdfsClient.h:29,63``,
+    ``channelbufferhdfs.cpp``).  Reads are 2MB HTTP range reads with
+    zlib wire compression (``managedchannel/HttpReader.cs:78-110``;
+    transform ``dryadvertex.h:33-48``); writes PUT each store file,
+    compressed, so TB-scale ingest/egress rides the DCN file plane.
+    Partition fetches run on a small thread pool (the async
+    channel-reader analog)."""
 
-    def read(self, rest: str) -> ReadResult:
+    THREADS = 4
+
+    def _client(self, rest: str):
         from dryad_tpu.cluster.service import ServiceClient
 
         netloc, _, rel = rest.partition("/")
         host, _, port = netloc.partition(":")
-        client = ServiceClient(host, int(port or 80))
-        prefix = rel.strip("/")
+        return ServiceClient(host, int(port or 80)), rel.strip("/")
+
+    def read(self, rest: str) -> ReadResult:
+        from concurrent.futures import ThreadPoolExecutor
+
+        client, prefix = self._client(rest)
 
         def fetch(name: str) -> bytes:
             return client.read_whole_file(
-                f"{prefix}/{name}" if prefix else name
+                f"{prefix}/{name}" if prefix else name, compress=True
             )
 
         manifest = json.loads(fetch(CIO.MANIFEST).decode("utf-8"))
@@ -177,11 +191,46 @@ class HttpStoreProvider(DataProvider):
                 dictionary._map[int(h, 16)] = s
         except FileNotFoundError:
             pass
-        parts = [
-            CIO.parse_partition_bytes(fetch(f"part-{i:05d}.dpf"))
-            for i in range(manifest["partitions"])
-        ]
+        n = manifest["partitions"]
+        with ThreadPoolExecutor(max_workers=min(self.THREADS, max(n, 1))) as ex:
+            parts = list(
+                ex.map(
+                    lambda i: CIO.parse_partition_bytes(
+                        fetch(f"part-{i:05d}.dpf")
+                    ),
+                    range(n),
+                )
+            )
         return schema, parts, dictionary
+
+    def write(self, rest, partitions, schema, dictionary, compression):
+        import shutil
+        import tempfile
+        from concurrent.futures import ThreadPoolExecutor
+
+        client, prefix = self._client(rest)
+        tmp = tempfile.mkdtemp(prefix="dryad-httpstore-")
+        try:
+            # identical on-disk layout to a local store, staged then
+            # shipped (the reference stages partitions to the DFS the
+            # same way, DrPartitionFile.h:50)
+            CIO.write_store(tmp, partitions, schema, dictionary, compression)
+            names = sorted(os.listdir(tmp))
+
+            def ship(name: str) -> None:
+                with open(os.path.join(tmp, name), "rb") as fh:
+                    data = fh.read()
+                client.write_file(
+                    f"{prefix}/{name}" if prefix else name, data,
+                    compress=True,
+                )
+
+            with ThreadPoolExecutor(
+                max_workers=min(self.THREADS, max(len(names), 1))
+            ) as ex:
+                list(ex.map(ship, names))
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
 
 
 register_provider("partfile", PartfileProvider())
