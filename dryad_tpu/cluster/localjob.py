@@ -50,7 +50,9 @@ from dryad_tpu.cluster.scheduler import LocalScheduler
 from dryad_tpu.cluster.service import ProcessService, ServiceClient
 from dryad_tpu.columnar.io import parse_partition_bytes
 from dryad_tpu.columnar.schema import StringDictionary
+from dryad_tpu.exec.events import EventLog
 from dryad_tpu.exec.jobpackage import pack_query
+from dryad_tpu.exec.stats import StageStatistics
 from dryad_tpu.utils.logging import get_logger
 
 log = get_logger("dryad_tpu.cluster.localjob")
@@ -75,8 +77,64 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+class WorkerLauncher:
+    """The worker-start seam (reference: composing Peloponnese process
+    groups for LOCAL vs YARN, ``LocalJobSubmission.cs:141-147`` /
+    ``YarnJobSubmission.cs:63-111``).  ``spec`` carries everything
+    needed to start one worker; implementations may exec a subprocess
+    (below), ssh to a host, or exec into a pod."""
+
+    def start(self, spec: Dict):
+        """Launch one worker; returns an opaque handle."""
+        raise NotImplementedError
+
+    def poll(self, handle) -> Optional[int]:
+        """Exit code if the worker died, else None."""
+        raise NotImplementedError
+
+    def stop(self, handle, timeout: float = 5.0) -> None:
+        raise NotImplementedError
+
+    def wait(self, handle, timeout: float) -> None:
+        raise NotImplementedError
+
+
+class SubprocessLauncher(WorkerLauncher):
+    """Local OS-process launcher (the reference's LOCAL platform)."""
+
+    def start(self, spec: Dict) -> subprocess.Popen:
+        lf = open(spec["log_path"], "w")
+        try:
+            return subprocess.Popen(
+                spec["argv"], stdout=lf, stderr=subprocess.STDOUT,
+                env=spec["env"],
+            )
+        finally:
+            lf.close()
+
+    def poll(self, handle) -> Optional[int]:
+        return handle.poll()
+
+    def wait(self, handle, timeout: float) -> None:
+        handle.wait(timeout=timeout)
+
+    def stop(self, handle, timeout: float = 5.0) -> None:
+        handle.terminate()
+        try:
+            handle.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            handle.kill()
+
+
 class LocalJobSubmission:
-    """Driver for N worker processes jointly executing submitted queries."""
+    """Driver for N worker processes jointly executing submitted queries.
+
+    ``defer_workers``: leave that many workers unstarted; they may join
+    LATE via :meth:`start_worker` — submissions block in
+    ``wait_for_members`` until the full gang announced (elastic
+    membership, ``LocalScheduler.cs:88`` WaitForReasonableNumberOf
+    Computers / ``PeloponneseInterface.cs:370``).
+    """
 
     def __init__(
         self,
@@ -84,54 +142,109 @@ class LocalJobSubmission:
         devices_per_worker: int = 2,
         root: Optional[str] = None,
         worker_timeout: float = 300.0,
+        launcher: Optional[WorkerLauncher] = None,
+        defer_workers: int = 0,
     ):
+        from dryad_tpu.parallel.multihost import ControlPlane
+
         self.n = num_workers
         self.k = devices_per_worker
         self.timeout = worker_timeout
         self.root = root or tempfile.mkdtemp(prefix="dryad-localjob-")
         self.job_id = f"job-{os.getpid()}-{int(time.time() * 1000)}"
         self.service = ProcessService(self.root)
-        self.scheduler = LocalScheduler(
-            [Computer(f"worker{i}", slots=1) for i in range(num_workers)]
-        )
+        self.launcher = launcher or SubprocessLauncher()
+        # Computers register on ANNOUNCE (elastic membership), not at
+        # construction — a late worker's slot must not accept tasks
+        # that would stall until it exists.
+        self.scheduler = LocalScheduler([])
         self._client = ServiceClient("127.0.0.1", self.service.port)
+        self.events = EventLog(os.path.join(self.root, "events.jsonl"))
+        self._cp = ControlPlane(self.job_id, -1, mailbox=self.service.mailbox)
         self._status_ver: Dict[int, int] = {}
         self._seq = 0
         self._cseq = 0  # unique per driver command; echoed in statuses
-        self._procs: List[subprocess.Popen] = []
-        self._logs: List[str] = []
-        self._spawn()
+        self._handles: Dict[int, object] = {}
+        self._logs: Dict[int, str] = {}
+        self._registered: set = set()
+        self._dead: set = set()
+        self._coord = f"127.0.0.1:{_free_port()}"
+        for i in range(self.n - max(defer_workers, 0)):
+            self.start_worker(i)
 
     # -- worker process group (the Peloponnese "Worker" group) ---------------
-    def _spawn(self) -> None:
-        coord = f"127.0.0.1:{_free_port()}"
+    def _worker_spec(self, i: int) -> Dict:
         env = dict(os.environ, JAX_PLATFORMS="cpu")
         env.pop("XLA_FLAGS", None)  # workers set their own device count
+        # Workers must resolve the same modules as the driver: packed
+        # plans pickle user fns BY REFERENCE to their defining module
+        # (the local-mode analog of the reference staging the generated
+        # vertex DLL to every worker, LocalJobSubmission.cs:141-147).
         repo = os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
-        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
-        for i in range(self.n):
-            log_path = os.path.join(self.root, f"worker{i}.log")
-            self._logs.append(log_path)
-            lf = open(log_path, "w")
-            p = subprocess.Popen(
-                [
-                    sys.executable, "-m", "dryad_tpu.cluster.worker",
-                    "--service-port", str(self.service.port),
-                    "--job", self.job_id,
-                    "--pid", str(i),
-                    "--nproc", str(self.n),
-                    "--devices-per-proc", str(self.k),
-                    "--coordinator", coord,
-                    "--root", self.root,
-                ],
-                stdout=lf, stderr=subprocess.STDOUT, env=env,
-            )
-            lf.close()
-            self._procs.append(p)
+        paths = [repo] + [p for p in sys.path if p] + [
+            p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p
+        ]
+        env["PYTHONPATH"] = os.pathsep.join(dict.fromkeys(paths))
+        return {
+            "argv": [
+                sys.executable, "-m", "dryad_tpu.cluster.worker",
+                "--service-port", str(self.service.port),
+                "--job", self.job_id,
+                "--pid", str(i),
+                "--nproc", str(self.n),
+                "--devices-per-proc", str(self.k),
+                "--coordinator", self._coord,
+                "--root", self.root,
+            ],
+            "env": env,
+            "log_path": os.path.join(self.root, f"worker{i}.log"),
+            "index": i,
+        }
+
+    def start_worker(self, i: int) -> None:
+        """Start (possibly late) worker ``i`` through the launcher."""
+        if i in self._handles:
+            raise ValueError(f"worker {i} already started")
+        spec = self._worker_spec(i)
+        self._logs[i] = spec["log_path"]
+        self._handles[i] = self.launcher.start(spec)
+        self.events.emit("worker_started", worker=i)
         log.info(
-            "spawned %d workers x %d devices (job %s, psvc :%d)",
-            self.n, self.k, self.job_id, self.service.port,
+            "started worker %d/%d x %d devices (job %s, psvc :%d)",
+            i, self.n, self.k, self.job_id, self.service.port,
         )
+
+    def _sync_membership(self, timeout: float = 120.0, gang: bool = True) -> None:
+        """Block until the gang announced; register each announced
+        worker's computer with the scheduler exactly once.
+
+        ``gang=True`` (SPMD jobs) needs EVERY worker: a started worker
+        dying before it announces fails fast with its log tail instead
+        of burning the membership timeout.  ``gang=False`` (independent
+        vertex tasks) tolerates dead workers — survivors carry the job
+        (DrVertex re-execution semantics)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            if gang:
+                self._check_workers_alive()
+            else:
+                self._reap_dead_workers()
+            for i in self._cp.announced(self.n):
+                if i not in self._registered:
+                    self._registered.add(i)
+                    self.scheduler.add_computer(
+                        Computer(f"worker{i}", slots=1)
+                    )
+                    self.events.emit("worker_joined", worker=i)
+            live = len(self._registered - self._dead)
+            need = self.n if gang else max(1, self.n - len(self._dead))
+            if live >= need:
+                return
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"only {live}/{need} workers announced after {timeout}s"
+                )
+            time.sleep(0.1)
 
     def _worker_log_tail(self, i: int, nbytes: int = 2000) -> str:
         try:
@@ -144,51 +257,97 @@ class LocalJobSubmission:
             return "<no log>"
 
     def _check_workers_alive(self) -> None:
-        for i, p in enumerate(self._procs):
-            rc = p.poll()
+        for i, h in self._handles.items():
+            rc = self.launcher.poll(h)
             if rc is not None:
                 raise RuntimeError(
                     f"worker {i} exited rc={rc}; log tail:\n"
                     + self._worker_log_tail(i)
                 )
 
+    def _reap_dead_workers(self) -> None:
+        """Deregister dead workers' computers so vertex-task retries and
+        duplicates place on survivors only."""
+        for i, h in self._handles.items():
+            if i in self._dead:
+                continue
+            if self.launcher.poll(h) is not None:
+                self._dead.add(i)
+                self.scheduler.remove_computer(f"worker{i}")
+                self.events.emit("worker_dead", worker=i)
+                log.warning("worker %d died; removed from scheduling", i)
+
     # -- submission ----------------------------------------------------------
     def _next_cseq(self) -> int:
         self._cseq += 1
         return self._cseq
 
+    def _check_worker_alive(self, i: int) -> None:
+        h = self._handles.get(i)
+        if h is not None:
+            rc = self.launcher.poll(h)
+            if rc is not None:
+                raise RuntimeError(
+                    f"worker {i} exited rc={rc}; log tail:\n"
+                    + self._worker_log_tail(i)
+                )
+
+    def _round_trip_body(
+        self, i: int, cmd: Dict, proc: ClusterProcess, gang: bool = True
+    ) -> Dict:
+        """The GM->worker command protocol: set ``cmd/<i>``, long-poll
+        ``status/<i>`` (DVertexCommand / DVertexStatus,
+        ``dvertexcommand.cpp:29-30``).  ``cmd`` must carry a unique
+        ``cseq``; statuses echoing an older cseq (a run the driver
+        already timed out on or canceled) are consumed and discarded so
+        they can't be misattributed to this command.
+
+        ``gang`` commands fail fast when ANY worker dies (a gang SPMD
+        program cannot finish without every member); vertex-task round
+        trips watch only their OWN worker, so an unrelated death leaves
+        independent work running (re-execution handles the victim)."""
+        mb = self.service.mailbox
+        mb.set_prop(self.job_id, f"cmd/{i}", json.dumps(cmd).encode())
+        deadline = time.monotonic() + self.timeout
+        while not proc.cancelled:
+            after = self._status_ver.get(i, 0)
+            got = mb.get_prop(self.job_id, f"status/{i}", after, timeout=1.0)
+            if got is not None:
+                self._status_ver[i] = got[0]
+                st = json.loads(got[1])
+                if st.get("cseq") != cmd["cseq"]:
+                    continue  # stale status from an abandoned command
+                if st.get("state") == "failed":
+                    raise RuntimeError(
+                        f"worker {i} failed: {st.get('error')}"
+                    )
+                return st
+            if gang:
+                self._check_workers_alive()
+            else:
+                self._check_worker_alive(i)
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"worker {i}: no status after {self.timeout}s; "
+                    f"log tail:\n" + self._worker_log_tail(i)
+                )
+        return {"state": "canceled"}
+
     def _command_round_trip(self, i: int, cmd: Dict):
-        """The GM->worker command protocol as a schedulable process fn:
-        set ``cmd/<i>``, long-poll ``status/<i>`` (DVertexCommand /
-        DVertexStatus, ``dvertexcommand.cpp:29-30``).  ``cmd`` must
-        carry a unique ``cseq``; statuses echoing an older cseq (a run
-        the driver already timed out on) are consumed and discarded so
-        they can't be misattributed to this command."""
+        """Round trip pinned to worker ``i`` (gang commands)."""
 
         def fn(proc: ClusterProcess) -> Dict:
-            mb = self.service.mailbox
-            mb.set_prop(self.job_id, f"cmd/{i}", json.dumps(cmd).encode())
-            deadline = time.monotonic() + self.timeout
-            while not proc.cancelled:
-                after = self._status_ver.get(i, 0)
-                got = mb.get_prop(self.job_id, f"status/{i}", after, timeout=1.0)
-                if got is not None:
-                    self._status_ver[i] = got[0]
-                    st = json.loads(got[1])
-                    if st.get("cseq") != cmd["cseq"]:
-                        continue  # stale status from an abandoned command
-                    if st.get("state") == "failed":
-                        raise RuntimeError(
-                            f"worker {i} failed: {st.get('error')}"
-                        )
-                    return st
-                self._check_workers_alive()
-                if time.monotonic() > deadline:
-                    raise TimeoutError(
-                        f"worker {i}: no status after {self.timeout}s; "
-                        f"log tail:\n" + self._worker_log_tail(i)
-                    )
-            return {"state": "canceled"}
+            return self._round_trip_body(i, cmd, proc)
+
+        return fn
+
+    def _placed_round_trip(self, cmd: Dict):
+        """Round trip to whichever worker the scheduler placed the
+        process on (vertex tasks: any computer may serve any task)."""
+
+        def fn(proc: ClusterProcess) -> Dict:
+            i = int(proc.computer.removeprefix("worker"))
+            return self._round_trip_body(i, cmd, proc, gang=False)
 
         return fn
 
@@ -196,6 +355,7 @@ class LocalJobSubmission:
         """Pack the query, run it across the worker gang, assemble the
         result table (reference SubmitAndWait)."""
         self._check_workers_alive()
+        self._sync_membership()
         self._seq += 1
         seq = self._seq
         job_dir = os.path.join(self.root, self.job_id, f"r{seq}")
@@ -231,8 +391,250 @@ class LocalJobSubmission:
         )
         return self._assemble(query, result_rel, part_ids)
 
+    # -- independent vertex tasks with speculative duplication ---------------
+    _PARTITIONED_OPS = frozenset(
+        {"select", "where", "project", "select_many", "resize"}
+    )
+
+    def submit_partitioned(
+        self,
+        query,
+        nparts: Optional[int] = None,
+        speculation: bool = True,
+    ) -> Dict[str, np.ndarray]:
+        """Run a partition-local plan as ``nparts`` INDEPENDENT vertex
+        tasks — the reference's execution model (one re-executable
+        vertex per partition, ``DrVertex.h:49``), with **speculative
+        duplication**: completed-task durations feed the robust stage
+        model (``exec.stats``, ``DrStageStatistics.cpp:93``), and a
+        task running past the outlier threshold is duplicated onto the
+        least-loaded idle worker, first completion wins, the loser is
+        canceled (``DrVertex.cpp:444`` RequestDuplicate,
+        ``DrStageManager.h:156`` CheckForDuplicates).
+
+        Only exchange-free plans qualify (each vertex sees one input
+        partition; the union of outputs is the job output).  Plans with
+        shuffles run as one gang-scheduled SPMD program via
+        :meth:`submit`, where lockstep collectives make mid-program
+        speculation meaningless.
+        """
+        from dryad_tpu.cluster.interfaces import ProcessState as PS
+        from dryad_tpu.plan.lower import lower
+
+        self._reap_dead_workers()
+        self._sync_membership(gang=False)
+        graph = lower([query.node], query.ctx.config)
+        for st in graph.stages:
+            bad = [
+                op.kind for op in st.ops
+                if op.kind not in self._PARTITIONED_OPS
+            ]
+            if bad:
+                raise ValueError(
+                    f"partitioned submission requires an exchange-free "
+                    f"plan; stage {st.name!r} contains {bad} — use submit()"
+                )
+        nparts = nparts or self.n * 2
+        self._seq += 1
+        seq = self._seq
+        job_dir = os.path.join(self.root, self.job_id, f"r{seq}")
+        os.makedirs(job_dir, exist_ok=True)
+        pkg_rel = f"{self.job_id}/r{seq}/job.pkg"
+        self._register_strings(query)
+        pack_query(query, os.path.join(self.root, pkg_rel))
+        result_rel = f"{self.job_id}/r{seq}/result"
+        self.events.emit(
+            "vertex_job_start", seq=seq, nparts=nparts,
+            speculation=speculation,
+        )
+
+        stats = StageStatistics()
+        run_t0: Dict[int, float] = {}  # ClusterProcess.id -> RUNNING ts
+
+        def make_proc(part: int, attempt: int) -> ClusterProcess:
+            cmd = {
+                "kind": "runpart", "package": pkg_rel, "part": part,
+                "nparts": nparts, "result_dir": result_rel, "seq": seq,
+                "cseq": self._next_cseq(),
+            }
+            # Primaries spread round-robin as a soft preference;
+            # duplicates go wherever a slot is free first.
+            affs = (
+                [Affinity(f"worker{part % self.n}")] if attempt == 0 else []
+            )
+            p = ClusterProcess(
+                self._placed_round_trip(cmd),
+                name=f"part{part}-a{attempt}", affinities=affs,
+            )
+
+            def watch(pr: ClusterProcess) -> None:
+                if pr.state is PS.RUNNING:
+                    run_t0[pr.id] = time.monotonic()
+
+            p.on_state(watch)
+            return p
+
+        terminal = (PS.COMPLETED, PS.FAILED, PS.CANCELED)
+        tasks: Dict[int, Dict] = {}
+        for part in range(nparts):
+            p = make_proc(part, 0)
+            tasks[part] = {"procs": [p], "dup": False}
+            self.scheduler.schedule(p)
+
+        pending = set(range(nparts))
+        # nparts tasks over n worker slots run in ceil(nparts/n)
+        # sequential waves; every wave gets the per-command budget.
+        waves = -(-nparts // max(self.n, 1))
+        deadline = time.monotonic() + self.timeout * waves + 30.0
+        max_attempts = 3  # versioned re-execution budget (DrVertexRecord)
+        try:
+            while pending:
+                self._reap_dead_workers()
+                for part in sorted(pending):
+                    t = tasks[part]
+                    winner = next(
+                        (p for p in t["procs"] if p.state is PS.COMPLETED),
+                        None,
+                    )
+                    if winner is not None:
+                        dur = time.monotonic() - run_t0.get(
+                            winner.id, time.monotonic()
+                        )
+                        stats.record(dur)
+                        for p in t["procs"]:
+                            if p is not winner and p.state not in terminal:
+                                self.scheduler.cancel(p)
+                                self.events.emit(
+                                    "vertex_duplicate_cancel", part=part,
+                                    loser=p.computer or "queued",
+                                )
+                        if t["dup"]:
+                            self.events.emit(
+                                "vertex_duplicate_win", part=part,
+                                winner=winner.computer, seconds=dur,
+                            )
+                        self.events.emit(
+                            "vertex_complete", part=part, seconds=dur,
+                            computer=winner.computer,
+                        )
+                        pending.discard(part)
+                        continue
+                    if t["procs"] and all(
+                        p.state in (PS.FAILED, PS.CANCELED)
+                        for p in t["procs"]
+                    ):
+                        # Independent re-executable vertex: a failed
+                        # attempt re-runs (on a surviving worker) up to
+                        # the version budget (DrVertex.cpp:531
+                        # InstantiateVersion; failure budget DrGraph.h:42).
+                        if len(t["procs"]) < max_attempts:
+                            self.events.emit(
+                                "vertex_retry", part=part,
+                                attempt=len(t["procs"]) + 1,
+                            )
+                            np_ = make_proc(part, len(t["procs"]))
+                            t["procs"].append(np_)
+                            self.scheduler.schedule(np_)
+                            continue
+                        errs = "; ".join(
+                            str(p.error) for p in t["procs"] if p.error
+                        )
+                        self.events.emit("vertex_job_failed", part=part)
+                        raise RuntimeError(
+                            f"vertex task {part} failed on all "
+                            f"{len(t['procs'])} attempts: {errs}"
+                        )
+                    # Speculation: a RUNNING attempt past the outlier
+                    # threshold gets one duplicate (CheckForDuplicates).
+                    thr = stats.outlier_threshold()
+                    if speculation and not t["dup"] and thr is not None:
+                        running = [
+                            p for p in t["procs"]
+                            if p.state is PS.RUNNING and p.id in run_t0
+                        ]
+                        if running and any(
+                            time.monotonic() - run_t0[p.id] > thr
+                            for p in running
+                        ):
+                            t["dup"] = True
+                            dp = make_proc(part, 1)
+                            t["procs"].append(dp)
+                            self.scheduler.schedule(dp)
+                            self.events.emit(
+                                "vertex_duplicate", part=part,
+                                threshold=round(thr, 4),
+                                elapsed=round(
+                                    max(
+                                        time.monotonic() - run_t0[p.id]
+                                        for p in running
+                                    ), 4,
+                                ),
+                            )
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"vertex job timed out with parts "
+                        f"{sorted(pending)} outstanding"
+                    )
+                if pending:
+                    time.sleep(0.05)
+        finally:
+            # Never leak attempts: a queued proc dispatched later would
+            # clobber the worker's cmd mailbox slot (latest-value
+            # semantics) and poison the next submission.
+            for t in tasks.values():
+                for p in t["procs"]:
+                    if p.state not in terminal:
+                        self.scheduler.cancel(p)
+        self.events.emit("vertex_job_complete", seq=seq)
+        return self._assemble(
+            query, result_rel, list(range(nparts)),
+            dictionary=query.ctx.dictionary,
+        )
+
+    def _register_strings(self, query) -> None:
+        """Register every host-bound STRING token in the DRIVER's
+        dictionary before packing.  Workers re-encode the same strings
+        with the same deterministic Hash64 (``columnar/schema.py``), so
+        assembly can decode results without a worker-shipped dictionary
+        (the gang path ships one; vertex tasks don't)."""
+        from dryad_tpu.columnar.schema import ColumnType, hash64_str
+        from dryad_tpu.plan.nodes import walk
+
+        for n in walk([query.node]):
+            b = query.ctx._bindings.get(n.id)
+            if not b or b[0] != "host":
+                continue
+            arrays = b[1]
+            for f in n.schema.fields:
+                if f.ctype is ColumnType.STRING and f.name in arrays:
+                    for s in np.unique(np.asarray(arrays[f.name], object)):
+                        query.ctx.dictionary._map[hash64_str(str(s))] = str(s)
+
+    def inject_delay(
+        self, worker: int, seconds: float, count: int = 1
+    ) -> None:
+        """Make the next ``count`` vertex tasks on one worker stall
+        ``seconds`` — the injected-straggler knob (per-worker, unlike
+        :meth:`inject_fault`'s gang broadcast)."""
+        self._sync_membership()
+        cmd = {
+            "kind": "set_delay", "seconds": seconds, "count": count,
+            "cseq": self._next_cseq(),
+        }
+        p = ClusterProcess(
+            self._command_round_trip(worker, cmd),
+            name=f"delay-w{worker}",
+            affinities=[Affinity(f"worker{worker}", hard=True)],
+        )
+        self.scheduler.schedule(p)
+        if not p.wait(30.0) or p.state is not ProcessState.COMPLETED:
+            raise RuntimeError(
+                f"delay injection on worker {worker} failed: {p.error}"
+            )
+
     def _assemble(
-        self, query, result_rel: str, part_ids: List[int]
+        self, query, result_rel: str, part_ids: List[int],
+        dictionary: Optional[StringDictionary] = None,
     ) -> Dict[str, np.ndarray]:
         """Fetch result partitions through the file server (HTTP range
         reads via the block cache) and decode to a host table."""
@@ -240,18 +642,39 @@ class LocalJobSubmission:
 
         from dryad_tpu.columnar.batch import ColumnBatch
 
-        cols_parts = [
-            parse_partition_bytes(
-                self._client.read_whole_file(f"{result_rel}/part{g}.dpf")
+        from concurrent.futures import ThreadPoolExecutor
+
+        # Partitions fetch CONCURRENTLY with zlib wire compression
+        # (assemble time ~ max partition, not the sum; the async
+        # channel-reader role, HttpReader.cs:78 + dryadvertex.h:33-48).
+        w0, r0 = self._client.wire_bytes, self._client.raw_bytes
+        with ThreadPoolExecutor(
+            max_workers=min(8, max(len(part_ids), 1))
+        ) as ex:
+            cols_parts = list(
+                ex.map(
+                    lambda g: parse_partition_bytes(
+                        self._client.read_whole_file(
+                            f"{result_rel}/part{g}.dpf", compress=True
+                        )
+                    ),
+                    part_ids,
+                )
             )
-            for g in part_ids
-        ]
-        dictionary = StringDictionary()
-        dictionary._map.update(
-            pickle.loads(
-                self._client.read_whole_file(f"{result_rel}/dictionary.pkl")
-            )
+        self.events.emit(
+            "assemble_fetch", parts=len(part_ids),
+            wire_bytes=self._client.wire_bytes - w0,
+            raw_bytes=self._client.raw_bytes - r0,
         )
+        if dictionary is None:
+            dictionary = StringDictionary()
+            dictionary._map.update(
+                pickle.loads(
+                    self._client.read_whole_file(
+                        f"{result_rel}/dictionary.pkl"
+                    )
+                )
+            )
         phys = query.schema.device_names()
         if not cols_parts:
             return {n: np.zeros(0) for n in query.schema.names}
@@ -270,6 +693,7 @@ class LocalJobSubmission:
         SetFakeVertexFailure; ``stage=None`` clears).  All gang members
         must fault together — a partial fault would strand the rest in a
         collective."""
+        self._sync_membership()
         cmd = {
             "kind": "set_fault", "stage": stage, "count": count,
             "cseq": self._next_cseq(),
@@ -290,8 +714,8 @@ class LocalJobSubmission:
     # -- teardown ------------------------------------------------------------
     def shutdown(self, graceful_timeout: float = 15.0) -> None:
         try:
-            for i in range(self.n):
-                if self._procs[i].poll() is None:
+            for i, h in self._handles.items():
+                if self.launcher.poll(h) is None:
                     self.service.mailbox.set_prop(
                         self.job_id, f"cmd/{i}",
                         json.dumps(
@@ -299,19 +723,16 @@ class LocalJobSubmission:
                         ).encode(),
                     )
             deadline = time.monotonic() + graceful_timeout
-            for p in self._procs:
+            for h in self._handles.values():
                 left = max(0.1, deadline - time.monotonic())
                 try:
-                    p.wait(timeout=left)
-                except subprocess.TimeoutExpired:
-                    p.terminate()
-                    try:
-                        p.wait(timeout=5)
-                    except subprocess.TimeoutExpired:
-                        p.kill()
+                    self.launcher.wait(h, timeout=left)
+                except Exception:  # noqa: BLE001 — escalate to stop
+                    self.launcher.stop(h)
         finally:
             self.scheduler.shutdown()
             self.service.close()
+            self.events.close()
 
     def __enter__(self):
         return self

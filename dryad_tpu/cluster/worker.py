@@ -32,8 +32,79 @@ import os
 import pickle
 import sys
 import tempfile
+import time
 import traceback
 from typing import Dict, List
+
+
+class _PackageCache:
+    """Per-process cache of loaded job packages for vertex tasks.
+
+    A ``runpart`` stream re-uses one loaded plan + context (and its
+    compiled-stage cache) across partitions — the reference's VertexHost
+    similarly keeps the vertex DLL loaded across vertex executions."""
+
+    def __init__(self) -> None:
+        self.key: str = ""
+        self.query = None
+        self.pristine: Dict = {}
+
+    def load(self, rel: str, client):
+        if self.key == rel and self.query is not None:
+            return self.query, self.pristine
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        from dryad_tpu.exec.jobpackage import load_query
+        from dryad_tpu.parallel.mesh import AXIS
+
+        blob = client.read_whole_file(rel)
+        with tempfile.NamedTemporaryFile(suffix=".pkg", delete=False) as fh:
+            fh.write(blob)
+            pkg_path = fh.name
+        try:
+            # Vertex tasks run on ONE local device — independent work,
+            # not the gang mesh (DrStorageVertex-style per-partition
+            # channels, not cohort collectives).
+            local = Mesh(np.array(jax.local_devices()[:1]), (AXIS,))
+            q = load_query(pkg_path, mesh=local)
+        finally:
+            os.unlink(pkg_path)
+        self.key = rel
+        self.query = q
+        self.pristine = dict(q.ctx._bindings)
+        return q, self.pristine
+
+
+def _run_part(cmd: Dict, args, client, pkgs: _PackageCache) -> Dict:
+    """Execute ONE vertex task: the plan restricted to input partition
+    ``part`` of ``nparts``, on this worker's local device, writing the
+    result as a partition file (the independent re-executable vertex of
+    the reference, ``DrVertex.h:49`` — duplicate-safe: every attempt
+    writes identical bytes and the rename is atomic)."""
+    import numpy as np
+
+    from dryad_tpu.columnar.io import write_partition_file
+    from dryad_tpu.exec.jobpackage import slice_binding
+
+    q, pristine = pkgs.load(cmd["package"], client)
+    part, nparts = int(cmd["part"]), int(cmd["nparts"])
+    for nid, binding in pristine.items():
+        q.ctx._bindings[nid] = slice_binding(binding, part, nparts)
+    # rebinding invalidates cached binding fingerprints — a stale part-0
+    # fingerprint would make checkpointing restore part 0 for every part
+    q.ctx._binding_fp_cache.clear()
+    batch = q.ctx._execute_device(q)
+    valid = np.asarray(batch.valid)
+    cols = {c: np.asarray(v)[valid] for c, v in batch.data.items()}
+    out_dir = os.path.join(args.root, cmd["result_dir"])
+    os.makedirs(out_dir, exist_ok=True)
+    final = os.path.join(out_dir, f"part{part}.dpf")
+    tmp = f"{final}.w{args.pid}.tmp"
+    write_partition_file(tmp, cols)
+    os.replace(tmp, final)
+    return {"state": "completed", "parts": [part]}
 
 
 def _run_command(cmd: Dict, args, client, cp) -> Dict:
@@ -137,6 +208,8 @@ def main(argv=None) -> int:
     cp.start_heartbeat()
 
     after = 0
+    pkgs = _PackageCache()
+    delay = {"seconds": 0.0, "count": 0}  # injected straggler behavior
     while True:
         got = client.get_prop(args.job, f"cmd/{args.pid}", after, timeout=2.0)
         if got is None:
@@ -172,9 +245,28 @@ def main(argv=None) -> int:
                 json.dumps({"state": "fault_set", "cseq": cseq}).encode(),
             )
             continue
-        if cmd["kind"] == "run":
+        if cmd["kind"] == "set_delay":
+            # Injected straggler (per-worker, unlike set_fault's gang
+            # broadcast): the next ``count`` vertex tasks on THIS worker
+            # stall ``seconds`` before executing — the slow-machine
+            # scenario speculative duplication exists for
+            # (``DrStageStatistics.cpp:93`` outlier model).
+            delay["seconds"] = float(cmd.get("seconds", 0.0))
+            delay["count"] = int(cmd.get("count", 0))
+            client.set_prop(
+                args.job, f"status/{args.pid}",
+                json.dumps({"state": "delay_set", "cseq": cseq}).encode(),
+            )
+            continue
+        if cmd["kind"] in ("run", "runpart"):
             try:
-                status = _run_command(cmd, args, client, cp)
+                if cmd["kind"] == "runpart":
+                    if delay["count"] > 0:
+                        delay["count"] -= 1
+                        time.sleep(delay["seconds"])
+                    status = _run_part(cmd, args, client, pkgs)
+                else:
+                    status = _run_command(cmd, args, client, cp)
             except Exception as e:  # noqa: BLE001 — report, keep serving
                 traceback.print_exc()
                 info = {"error": f"{type(e).__name__}: {e}", "cmd": cmd}

@@ -82,6 +82,37 @@ def load_query(path: str, ctx=None, mesh=None):
     return Query(ctx, blob["node"])
 
 
+def slice_binding(binding: tuple, part: int, nparts: int) -> tuple:
+    """Restrict one packed input binding to vertex-task ``part`` of
+    ``nparts`` — the per-vertex input channel of the reference's
+    independent-vertex execution model (a ``DrStorageVertex`` holds one
+    input partition, ``GraphManager/vertex/DrVertex.h:146``).  Host rows
+    split into ``nparts`` contiguous blocks; store partitions deal
+    round-robin.  The union over parts is exactly the full input."""
+    import numpy as np
+
+    kind, *rest = binding
+    if kind == "host":
+        arrays, _cap = rest
+        return (
+            "host",
+            {k: np.array_split(np.asarray(v), nparts)[part]
+             for k, v in arrays.items()},
+            None,
+        )
+    if kind == "host_physical":
+        (phys,) = rest
+        return (
+            "host_physical",
+            {k: np.array_split(np.asarray(v), nparts)[part]
+             for k, v in phys.items()},
+        )
+    if kind == "store":
+        parts, schema = rest
+        return ("store", parts[part::nparts], schema)
+    raise ValueError(f"cannot slice binding kind {kind!r}")
+
+
 def run_package(path: str, ctx=None):
     """Load a job package and execute it, returning the host table —
     the entry point a worker process calls after learning the package

@@ -127,6 +127,10 @@ class ControlPlane:
         ).encode()
         self._set(f"member/{self.process_id}", body)
 
+    def announced(self, n: int) -> List[int]:
+        """Process ids (of 0..n-1) that have announced, non-blocking."""
+        return [i for i in range(n) if self._get(f"member/{i}") is not None]
+
     def wait_for_members(
         self, n: int, timeout: float = 60.0, poll: float = 0.1
     ) -> List[int]:

@@ -1,0 +1,63 @@
+"""Elastic membership: late worker join through the launcher seam.
+
+The reference's scheduler waits for a reasonable number of computers and
+lets workers join during a job (``LocalScheduler.cs:88``,
+``PeloponneseInterface.cs:370``); worker start is pluggable (LOCAL vs
+YARN process groups, ``YarnJobSubmission.cs:63-111``).  Here: start N-1
+workers, submit (blocks in wait_for_members), start the last worker
+late, and the job completes.
+"""
+
+import threading
+import time
+
+import numpy as np
+
+from dryad_tpu import DryadContext
+from dryad_tpu.cluster.localjob import LocalJobSubmission, SubprocessLauncher
+
+
+class CountingLauncher(SubprocessLauncher):
+    """Proves the seam is used for every start."""
+
+    def __init__(self):
+        self.started = []
+
+    def start(self, spec):
+        self.started.append(spec["index"])
+        return super().start(spec)
+
+
+def test_late_worker_join_completes_job():
+    launcher = CountingLauncher()
+    with LocalJobSubmission(
+        num_workers=2, devices_per_worker=1,
+        launcher=launcher, defer_workers=1,
+    ) as sub:
+        assert launcher.started == [0]
+
+        rng = np.random.default_rng(0)
+        tbl = {"k": rng.integers(0, 9, 400).astype(np.int32)}
+        ctx = DryadContext(num_partitions_=2)
+        q = ctx.from_arrays(tbl).group_by(
+            "k", {"c": ("count", None)}
+        ).order_by(["k"])
+
+        result = {}
+
+        def submit():
+            result["table"] = sub.submit(q)
+
+        t = threading.Thread(target=submit, daemon=True)
+        t.start()
+        # the submission must be blocked in wait_for_members
+        time.sleep(2.0)
+        assert t.is_alive(), "submit did not wait for the late worker"
+
+        sub.start_worker(1)
+        t.join(timeout=180.0)
+        assert not t.is_alive(), "job did not complete after late join"
+        assert launcher.started == [0, 1]
+        assert int(result["table"]["c"].sum()) == 400
+        kinds = [e["kind"] for e in sub.events.events()]
+        assert kinds.count("worker_joined") == 2
