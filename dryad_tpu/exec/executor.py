@@ -32,7 +32,7 @@ from dryad_tpu.columnar.batch import ColumnBatch
 from dryad_tpu.exec import faults
 from dryad_tpu.exec.checkpoint import CheckpointStore, stage_fingerprint
 from dryad_tpu.exec.events import EventLog
-from dryad_tpu.exec.kernels import build_stage_fn
+from dryad_tpu.exec.kernels import NON_OVERFLOW_OPS, build_stage_fn
 from dryad_tpu.exec.stats import StageStatistics
 from dryad_tpu.parallel.mesh import mesh_axes, num_partitions
 from dryad_tpu.parallel.stage import compile_stage
@@ -232,6 +232,9 @@ class GraphExecutor:
                     return
         st = self.stats.setdefault(stage.name, StageStatistics(self.config.outlier_sigmas))
 
+        can_overflow = any(
+            op.kind not in NON_OVERFLOW_OPS for op in stage.ops
+        )
         boost = 1
         failures = 0
         version = 0
@@ -250,7 +253,12 @@ class GraphExecutor:
                     stage.name, step_num=version
                 ):
                     outs, (overflow,) = fn(inputs, ())
-                    overflow = bool(overflow)
+                    # Overflow-free stages skip the host sync: their
+                    # flag is statically False, so the driver moves on
+                    # and JAX async dispatch overlaps this stage's
+                    # device time with independent stages (the GM
+                    # message-pump concurrency, DrMessagePump.h:116).
+                    overflow = bool(overflow) if can_overflow else False
             except faults.InjectedStageFailure as e:
                 failures += 1
                 self.events.emit(
@@ -290,6 +298,9 @@ class GraphExecutor:
             self.events.emit(
                 "stage_complete", stage=stage.id, name=stage.name,
                 version=version, seconds=dt,
+                # async stages report DISPATCH time; device time overlaps
+                # downstream stages (jobview surfaces the distinction)
+                **({} if can_overflow else {"async": True}),
             )
             for i, out_idx in enumerate(range(len(stage.out_slots))):
                 results[(stage.id, out_idx)] = outs[i]
