@@ -65,10 +65,17 @@ def _order_keys(keys: Sequence[OrderArg]) -> List[Tuple[str, bool]]:
 
 
 class _Project:
-    """Name-projection row fn, picklable for job packages."""
+    """Name-projection row fn: picklable for job packages, VALUE-equal
+    so re-lowering a rebuilt query hits the compiled-stage cache."""
 
     def __init__(self, phys: List[str]):
-        self.phys = list(phys)
+        self.phys = tuple(phys)
+
+    def __eq__(self, other) -> bool:
+        return type(other) is _Project and other.phys == self.phys
+
+    def __hash__(self) -> int:
+        return hash(("_Project", self.phys))
 
     def __call__(self, cols: Dict) -> Dict:
         return {c: cols[c] for c in self.phys}

@@ -43,21 +43,34 @@ def group_carry_cols(schema: Schema, names: Sequence[str]) -> List[str]:
     return out
 
 
-def ordering_operands(
-    schema: Schema, keys: Sequence[Tuple[str, bool]]
-) -> Callable[[ColumnBatch], List[jax.Array]]:
-    """Build a fn: batch -> uint32 operand list, lexicographic order ==
+class OrderingOperands:
+    """Callable: batch -> uint32 operand list, lexicographic order ==
     logical (column, descending) chain order.
 
     INT64: (sign-flipped high word, low word).  STRING: (8-byte prefix
     rank words, hash words) — exact for 8-byte prefixes, hash-order
     beyond (documented engine semantic for string ordering).
-    """
-    fields = [(schema.field(n), bool(d)) for n, d in keys]
 
-    def build(batch: ColumnBatch) -> List[jax.Array]:
+    VALUE-equal (not identity-equal): re-lowering the same logical plan
+    builds a new instance, and the compiled-stage cache keys ops by
+    their params — an identity-keyed callable here would recompile the
+    sort pipeline on every collect() (on a TPU tunnel, ~30s per rep).
+    """
+
+    def __init__(self, schema: Schema, keys: Sequence[Tuple[str, bool]]):
+        self.fields = tuple((schema.field(n), bool(d)) for n, d in keys)
+
+    def __eq__(self, other) -> bool:
+        return (
+            type(other) is OrderingOperands and other.fields == self.fields
+        )
+
+    def __hash__(self) -> int:
+        return hash(self.fields)
+
+    def __call__(self, batch: ColumnBatch) -> List[jax.Array]:
         ops: List[jax.Array] = []
-        for f, desc in fields:
+        for f, desc in self.fields:
             if f.ctype == ColumnType.STRING:
                 r0 = batch.data[f"{f.name}#r0"]
                 r1 = batch.data[f"{f.name}#r1"]
@@ -73,4 +86,8 @@ def ordering_operands(
                 ops.append(to_sortable_u32(batch.data[f.name], desc))
         return ops
 
-    return build
+
+def ordering_operands(
+    schema: Schema, keys: Sequence[Tuple[str, bool]]
+) -> Callable[[ColumnBatch], List[jax.Array]]:
+    return OrderingOperands(schema, keys)

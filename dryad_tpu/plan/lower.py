@@ -441,18 +441,10 @@ class _Builder:
                 # key over `salt` destinations — partial-reduce on
                 # (key, salt), exchange on (key, salt), re-reduce, then
                 # collapse with the normal key-only exchange below.
-                def _add_salt(cols, _s=int(salt)):
-                    import jax.numpy as jnp
-
-                    n = next(iter(cols.values())).shape[0]
-                    out = dict(cols)
-                    out["#salt"] = (
-                        jnp.arange(n, dtype=jnp.int32) % jnp.int32(_s)
-                    )
-                    return out
-
                 salted = carry_cols + ["#salt"]
-                stage.ops.append(StageOp("select", dict(slot=slot, fn=_add_salt)))
+                stage.ops.append(
+                    StageOp("select", dict(slot=slot, fn=_AddSalt(int(salt))))
+                )
                 stage.ops.append(
                     StageOp("group_reduce", dict(slot=slot, keys=salted, aggs=partial))
                 )
@@ -657,23 +649,58 @@ def _decompose_aggs(aggs):
     return partial, final
 
 
-def _finalize_fn(aggs):
-    """Post-shuffle finalize for aggs whose partials differ (mean)."""
-    means = [a for a in aggs if a.op == "mean"]
-    if not means:
-        return None
+class _AddSalt:
+    """Row fn appending the #salt spread column; VALUE-equal so
+    re-lowering doesn't bust the compiled-stage cache."""
 
-    def fin(cols):
+    def __init__(self, salt: int):
+        self.salt = salt
+
+    def __eq__(self, other) -> bool:
+        return type(other) is _AddSalt and other.salt == self.salt
+
+    def __hash__(self) -> int:
+        return hash(("_AddSalt", self.salt))
+
+    def __call__(self, cols):
+        import jax.numpy as jnp
+
+        n = next(iter(cols.values())).shape[0]
+        out = dict(cols)
+        out["#salt"] = jnp.arange(n, dtype=jnp.int32) % jnp.int32(self.salt)
+        return out
+
+
+class _FinalizeMeans:
+    """Post-shuffle mean finalize (sum/count -> mean); VALUE-equal so
+    re-lowering doesn't bust the compiled-stage cache."""
+
+    def __init__(self, outs):
+        self.outs = tuple(outs)
+
+    def __eq__(self, other) -> bool:
+        return type(other) is _FinalizeMeans and other.outs == self.outs
+
+    def __hash__(self) -> int:
+        return hash(("_FinalizeMeans", self.outs))
+
+    def __call__(self, cols):
         import jax.numpy as jnp
 
         out = dict(cols)
-        for a in means:
-            s = out.pop(f"{a.out}#s").astype(jnp.float32)
-            c = out.pop(f"{a.out}#c").astype(jnp.float32)
-            out[a.out] = s / jnp.maximum(c, 1.0)
+        for name in self.outs:
+            s = out.pop(f"{name}#s").astype(jnp.float32)
+            c = out.pop(f"{name}#c").astype(jnp.float32)
+            out[name] = s / jnp.maximum(c, 1.0)
         return out
 
-    return fin
+
+def _finalize_fn(aggs):
+    """Post-shuffle finalize for aggs whose partials differ (mean)."""
+    means = [a.out for a in aggs if a.op == "mean"]
+    if not means:
+        return None
+    return _FinalizeMeans(means)
 
 
 def lower(roots: Sequence[Node], config) -> StageGraph:

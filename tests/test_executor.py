@@ -298,3 +298,37 @@ def test_device_do_while_fallback_on_unsupported(tmp_path, rng):
             events += [json.loads(l) for l in fh]
     kinds = {e["kind"] for e in events}
     assert "do_while_device_fallback" in kinds
+
+
+def test_rebuilt_query_hits_compile_cache(rng):
+    """Re-building the same logical pipeline (fresh Query objects, as a
+    repeated caller does) must hit the structural compile cache: the
+    lowering-created callables (ordering operands, mean finalize, salt,
+    project) are VALUE-equal across lowerings.  An identity-keyed
+    callable here recompiled the sort pipeline on every collect — ~30s
+    per rep through the TPU tunnel (the round-2 bench failure)."""
+    from dryad_tpu import DryadContext
+
+    ctx = DryadContext(num_partitions_=8)
+    tbl = {
+        "k": rng.integers(0, 50, 2048).astype(np.int32),
+        "v": rng.standard_normal(2048).astype(np.float32),
+    }
+
+    def build():
+        return (
+            ctx.from_arrays(tbl)
+            .group_by("k", {"c": ("count", None), "m": ("mean", "v")},
+                      salt=4)
+            .project(["k", "c", "m"])
+            .order_by([("c", True), "k"])
+            .collect()
+        )
+
+    first = build()
+    n0 = len(ctx.executor._compiled)
+    second = build()
+    assert len(ctx.executor._compiled) == n0, (
+        "rebuilt query recompiled stages"
+    )
+    assert first["k"].tolist() == second["k"].tolist()
