@@ -434,7 +434,7 @@ class LocalJobSubmission:
                     f"partitioned submission requires an exchange-free "
                     f"plan; stage {st.name!r} contains {bad} — use submit()"
                 )
-        nparts = nparts or self.n * 2
+        nparts = nparts or self._auto_fanout(query)
         self._seq += 1
         seq = self._seq
         job_dir = os.path.join(self.root, self.job_id, f"r{seq}")
@@ -590,6 +590,33 @@ class LocalJobSubmission:
             query, result_rel, list(range(nparts)),
             dictionary=query.ctx.dictionary,
         )
+
+    def _auto_fanout(self, query) -> int:
+        """Data-size-driven task count (``DrDynamicRangeDistributor.cpp:
+        54-110``: consumer copies = observed size / data-per-vertex):
+        one task per ``config.rows_per_vertex`` input rows, at least one
+        wave over the gang, capped at 8 waves."""
+        from dryad_tpu.plan.nodes import walk
+
+        rows = 0
+        for n in walk([query.node]):
+            b = query.ctx._bindings.get(n.id)
+            if not b:
+                continue
+            kind, *rest = b
+            if kind in ("host", "host_physical"):
+                arrays = rest[0]
+                rows += max(
+                    (len(np.asarray(v)) for v in arrays.values()), default=0
+                )
+            elif kind == "store":
+                parts = rest[0]
+                rows += sum(
+                    len(next(iter(c.values()))) if c else 0 for c in parts
+                )
+        per = max(query.ctx.config.rows_per_vertex, 1)
+        fanout = max(self.n, -(-rows // per))
+        return min(fanout, self.n * 8)
 
     def _register_strings(self, query) -> None:
         """Register every host-bound STRING token in the DRIVER's

@@ -99,6 +99,12 @@ class DryadConfig:
     # dynamic broadcast decision of DynamicManager.cs:51 /
     # DrDynamicBroadcast.h:23, made trace-time from static capacities).
     broadcast_limit: int = _env_int("DRYAD_TPU_BROADCAST_LIMIT", 1 << 16)
+    # Target rows per independent vertex task: when a partitioned
+    # submission doesn't pin nparts, the fan-out is computed from the
+    # OBSERVED input size (the data-size-driven consumer-count
+    # recomputation of DrDynamicRangeDistributor.cpp:54-110:
+    # copies = sampledSize / dataPerVertex).
+    rows_per_vertex: int = _env_int("DRYAD_TPU_ROWS_PER_VERTEX", 1 << 18)
 
     def __post_init__(self) -> None:
         self.validate()

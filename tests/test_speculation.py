@@ -87,6 +87,28 @@ def test_partitioned_submission_string_columns(submission):
     assert sorted(out["w"].tolist()) == sorted(words.tolist())
 
 
+def test_auto_fanout_scales_with_data_size(submission):
+    """nparts unset: the task count follows observed input size
+    (DrDynamicRangeDistributor.cpp:54-110 consumer recomputation)."""
+    small_ctx = DryadContext(num_partitions_=1)
+    small = small_ctx.from_arrays(
+        {"k": np.arange(100, dtype=np.int32)}
+    ).project(["k"])
+    assert submission._auto_fanout(small) == submission.n  # one wave
+
+    # a small rows_per_vertex stands in for a big input: fan-out is
+    # rows / rows_per_vertex, so the ratio is what's under test
+    from dryad_tpu.utils.config import DryadConfig
+
+    ctx = DryadContext(
+        num_partitions_=1, config=DryadConfig(rows_per_vertex=50)
+    )
+    big = ctx.from_arrays(
+        {"k": np.arange(50 * submission.n * 3, dtype=np.int32)}
+    ).project(["k"])
+    assert submission._auto_fanout(big) == submission.n * 3
+
+
 def test_worker_death_survivors_finish_vertex_job():
     """A dead worker must not abort independent vertex tasks: its
     computer deregisters, its in-flight attempt fails and re-executes
