@@ -10,9 +10,11 @@ store partitions), the string dictionary, and the config.  A remote
 driver process (or a ControlPlane worker told the package path over the
 mailbox) loads and executes it against its own mesh.
 
-Lambdas are not picklable by the stdlib — user functions referenced by
-a packed plan must be module-level (the analog of the reference's
-requirement that lambdas compile into the shipped vertex DLL).
+User functions (including lambdas and ``__main__``-level defs) ship BY
+VALUE via cloudpickle when it is available — the analog of the
+reference compiling lambdas into the shipped vertex DLL
+(``DryadLinqCodeGen.cs:1910``).  Without cloudpickle the stdlib pickler
+applies and functions must live in a module importable on the worker.
 """
 
 from __future__ import annotations
@@ -21,6 +23,11 @@ import pickle
 from typing import Any, Dict, Optional
 
 from dryad_tpu.plan.nodes import walk
+
+try:
+    import cloudpickle as _pickler
+except ImportError:  # pragma: no cover - cloudpickle present in-tree
+    _pickler = pickle
 
 PACKAGE_VERSION = 1
 
@@ -48,7 +55,7 @@ def pack_query(query, path: str) -> Dict[str, Any]:
         "config": ctx.config,
     }
     with open(path, "wb") as fh:
-        pickle.dump(blob, fh, protocol=pickle.HIGHEST_PROTOCOL)
+        _pickler.dump(blob, fh, protocol=pickle.HIGHEST_PROTOCOL)
     return {
         "version": PACKAGE_VERSION,
         "nodes": len(nodes),
