@@ -35,6 +35,9 @@ class StageInfo:
     seconds: float = 0.0
     last_error: Optional[str] = None
     max_boost: int = 1
+    # async dispatch (overflow-free stage): seconds is DISPATCH time;
+    # device time overlapped downstream stages
+    async_dispatch: bool = False
 
 
 @dataclasses.dataclass
@@ -118,6 +121,7 @@ def _fold_job(events: List[Dict[str, Any]]) -> JobInfo:
             s = stage(ev)
             s.completed = True
             s.seconds += ev.get("seconds", 0.0)
+            s.async_dispatch = bool(ev.get("async", s.async_dispatch))
         elif kind == "stage_checkpoint_hit":
             s = stage(ev)
             s.completed = True
@@ -214,12 +218,119 @@ def render(job: JobInfo) -> str:
         state = "NOT DONE"
         if s.completed:
             state = "ckpt" if s.from_checkpoint else "done"
+            if s.async_dispatch:
+                state += " (async)"
         lines.append(
             f"{s.id:>4} {s.name[:40]:<40} {s.versions:>4} {s.failures:>4} "
             f"{s.overflows:>4} {s.stragglers:>4} {s.seconds:>8.3f}  {state}"
         )
     lines.append("-- diagnosis --")
     lines.extend("  " + d for d in diagnose(job))
+    return "\n".join(lines)
+
+
+# -- vertex-task (partitioned) jobs ----------------------------------------
+
+@dataclasses.dataclass
+class VertexJobInfo:
+    """Model of one independent-vertex-task job (submit_partitioned):
+    the per-vertex drill-down the JobBrowser GUI offers for reference
+    jobs (``JOM/jobinfo.cs:62`` vertex lists)."""
+
+    seq: int
+    nparts: int
+    attempts: Dict[int, int]
+    seconds: Dict[int, float]
+    computers: Dict[int, str]
+    duplicated: List[int]
+    dup_wins: List[int]
+    retries: List[int]
+    completed: bool
+    failed_part: Optional[int] = None
+    wire_bytes: int = 0
+    raw_bytes: int = 0
+    workers_joined: int = 0
+    workers_dead: int = 0
+
+
+def build_vertex_jobs(events: List[Dict[str, Any]]) -> List[VertexJobInfo]:
+    """Fold a LocalJobSubmission event stream into vertex-job models."""
+    jobs: List[VertexJobInfo] = []
+    cur: Optional[VertexJobInfo] = None
+    joined = dead = 0
+    for ev in events:
+        kind = ev["kind"]
+        if kind == "worker_joined":
+            joined += 1
+        elif kind == "worker_dead":
+            dead += 1
+        # membership counters reflect what each job could SEE: stamped
+        # continuously while the job is open, frozen once it ends (a
+        # later worker_dead must not be misattributed to an earlier job)
+        if cur is not None and not cur.completed and cur.failed_part is None:
+            cur.workers_joined = joined
+            cur.workers_dead = dead
+        if kind in ("worker_joined", "worker_dead"):
+            continue
+        if kind == "vertex_job_start":
+            cur = VertexJobInfo(
+                ev.get("seq", 0), ev.get("nparts", 0),
+                {}, {}, {}, [], [], [], False,
+                workers_joined=joined, workers_dead=dead,
+            )
+            jobs.append(cur)
+        elif cur is None:
+            continue
+        elif kind == "vertex_complete":
+            p = ev["part"]
+            cur.attempts[p] = cur.attempts.get(p, 1)
+            cur.seconds[p] = ev.get("seconds", 0.0)
+            cur.computers[p] = ev.get("computer", "?")
+        elif kind == "vertex_duplicate":
+            cur.duplicated.append(ev["part"])
+        elif kind == "vertex_duplicate_win":
+            cur.dup_wins.append(ev["part"])
+        elif kind == "vertex_retry":
+            cur.retries.append(ev["part"])
+            cur.attempts[ev["part"]] = ev.get("attempt", 2)
+        elif kind == "vertex_job_complete":
+            cur.completed = True
+        elif kind == "vertex_job_failed":
+            cur.failed_part = ev.get("part")
+        elif kind == "assemble_fetch":
+            cur.wire_bytes += ev.get("wire_bytes", 0)
+            cur.raw_bytes += ev.get("raw_bytes", 0)
+    return jobs
+
+
+def render_vertex_job(j: VertexJobInfo) -> str:
+    """Per-vertex drill-down: attempts, placement, duplication story."""
+    lines = [
+        f"vertex job r{j.seq}: "
+        + ("OK" if j.completed else f"FAILED (part {j.failed_part})")
+        + f"  parts={j.nparts}  workers_joined={j.workers_joined}"
+        + (f"  workers_dead={j.workers_dead}" if j.workers_dead else "")
+    ]
+    lines.append(f"{'part':>5} {'attempts':>8} {'secs':>8} {'computer':<12} notes")
+    for p in range(j.nparts):
+        notes = []
+        if p in j.duplicated:
+            notes.append("duplicated")
+        if p in j.dup_wins:
+            notes.append("dup won")
+        if p in j.retries:
+            notes.append("re-executed")
+        lines.append(
+            f"{p:>5} {j.attempts.get(p, 0):>8} "
+            f"{j.seconds.get(p, 0.0):>8.3f} "
+            f"{j.computers.get(p, '?'):<12} {', '.join(notes) or '—'}"
+        )
+    if j.raw_bytes:
+        ratio = j.raw_bytes / max(j.wire_bytes, 1)
+        lines.append(
+            f"assemble: {j.raw_bytes} bytes decoded from {j.wire_bytes} "
+            f"on the wire ({ratio:.1f}x compression)"
+        )
     return "\n".join(lines)
 
 
@@ -275,6 +386,61 @@ color:#fff;background:{color};font-weight:600}}
 </body></html>"""
 
 
+def _render_stream(events: List[Dict[str, Any]]) -> str:
+    """Render whichever job model the stream holds."""
+    if any(e["kind"] == "vertex_job_start" for e in events):
+        return "\n\n".join(
+            render_vertex_job(vj) for vj in build_vertex_jobs(events)
+        )
+    return render(build_job(events))
+
+
+def _load_tolerant(path: str) -> List[Dict[str, Any]]:
+    """Load a JSONL event log that may be MID-WRITE: a torn final line
+    (flushed across two OS writes by the producer) is skipped rather
+    than crashing the live view."""
+    import json
+
+    out: List[Dict[str, Any]] = []
+    try:
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue  # torn tail; the next poll re-reads it
+    except OSError:
+        pass
+    return out
+
+
+def follow(path: str, interval: float = 1.0) -> None:
+    """LIVE view (the JobBrowser's running-job mode): re-render whenever
+    the event log grows; Ctrl-C to stop."""
+    import os
+    import time
+
+    last = -1
+    try:
+        while True:
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                size = -1
+            if size != last:
+                last = size
+                events = _load_tolerant(path) if size > 0 else []
+                print("\x1b[2J\x1b[H", end="")  # clear screen, home
+                print(_render_stream(events))
+                print(f"\n[watching {path} — Ctrl-C to stop]")
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        pass
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = argv if argv is not None else sys.argv[1:]
     html_out: Optional[str] = None
@@ -286,13 +452,38 @@ def main(argv: Optional[List[str]] = None) -> int:
             print("--html requires an output path")
             return 2
         argv = argv[:i] + argv[i + 2 :]
+    live = "--follow" in argv
+    if live:
+        argv.remove("--follow")
     if len(argv) != 1:
         print(
             "usage: python -m dryad_tpu.tools.jobview [--html out.html] "
-            "<events.jsonl>"
+            "[--follow] <events.jsonl>"
         )
         return 2
-    job = build_job(EventLog.load(argv[0]))
+    if live:
+        if html_out:
+            print("--follow and --html are mutually exclusive")
+            return 2
+        follow(argv[0])
+        return 0
+    events = EventLog.load(argv[0])
+    if any(e["kind"] == "vertex_job_start" for e in events):
+        vjobs = build_vertex_jobs(events)
+        text = "\n\n".join(render_vertex_job(vj) for vj in vjobs)
+        if html_out:
+            import html as H
+
+            with open(html_out, "w") as fh:
+                fh.write(
+                    "<!doctype html><html><head><meta charset='utf-8'>"
+                    "<title>dryad_tpu vertex jobs</title></head><body>"
+                    f"<pre>{H.escape(text)}</pre></body></html>"
+                )
+            print(f"wrote {html_out}")
+        print(text)
+        return 0 if all(vj.completed for vj in vjobs) else 1
+    job = build_job(events)
     if html_out:
         with open(html_out, "w") as fh:
             fh.write(render_html(job))

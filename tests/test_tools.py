@@ -198,3 +198,72 @@ def test_explain_dot(rng):
     dot = explain_dot(q)
     assert dot.startswith("digraph stages {") and dot.endswith("}")
     assert "exchange(s)" in dot and "in" in dot
+
+
+def test_vertex_jobview_drilldown():
+    """Vertex-task job model + render (JobBrowser per-vertex view)."""
+    from dryad_tpu.tools.jobview import build_vertex_jobs, render_vertex_job
+
+    events = [
+        {"ts": 1.0, "kind": "worker_joined", "worker": 0},
+        {"ts": 1.1, "kind": "worker_joined", "worker": 1},
+        {"ts": 2.0, "kind": "vertex_job_start", "seq": 1, "nparts": 3},
+        {"ts": 2.5, "kind": "vertex_complete", "part": 0, "seconds": 0.4,
+         "computer": "worker0"},
+        {"ts": 2.6, "kind": "vertex_duplicate", "part": 1, "threshold": 0.5,
+         "elapsed": 1.2},
+        {"ts": 2.9, "kind": "vertex_duplicate_win", "part": 1,
+         "winner": "worker0", "seconds": 0.3},
+        {"ts": 2.9, "kind": "vertex_complete", "part": 1, "seconds": 0.3,
+         "computer": "worker0"},
+        {"ts": 3.0, "kind": "vertex_retry", "part": 2, "attempt": 2},
+        {"ts": 3.4, "kind": "vertex_complete", "part": 2, "seconds": 0.4,
+         "computer": "worker1"},
+        {"ts": 3.5, "kind": "assemble_fetch", "parts": 3,
+         "wire_bytes": 1000, "raw_bytes": 9000},
+        {"ts": 3.6, "kind": "vertex_job_complete", "seq": 1},
+    ]
+    jobs = build_vertex_jobs(events)
+    assert len(jobs) == 1
+    j = jobs[0]
+    assert j.completed and j.nparts == 3 and j.workers_joined == 2
+    assert j.duplicated == [1] and j.dup_wins == [1] and j.retries == [2]
+    text = render_vertex_job(j)
+    assert "dup won" in text and "re-executed" in text
+    assert "9.0x compression" in text
+
+
+def test_vertex_jobview_membership_attribution():
+    """A worker death AFTER a job completed must not be attributed to
+    that job; the next job sees it."""
+    from dryad_tpu.tools.jobview import build_vertex_jobs
+
+    events = [
+        {"ts": 1, "kind": "worker_joined", "worker": 0},
+        {"ts": 1, "kind": "worker_joined", "worker": 1},
+        {"ts": 2, "kind": "vertex_job_start", "seq": 1, "nparts": 1},
+        {"ts": 3, "kind": "vertex_complete", "part": 0, "seconds": 0.1,
+         "computer": "worker0"},
+        {"ts": 4, "kind": "vertex_job_complete", "seq": 1},
+        {"ts": 5, "kind": "worker_dead", "worker": 1},
+        {"ts": 6, "kind": "vertex_job_start", "seq": 2, "nparts": 1},
+        {"ts": 7, "kind": "vertex_complete", "part": 0, "seconds": 0.1,
+         "computer": "worker0"},
+        {"ts": 8, "kind": "vertex_job_complete", "seq": 2},
+    ]
+    r1, r2 = build_vertex_jobs(events)
+    assert r1.workers_dead == 0 and r1.workers_joined == 2
+    assert r2.workers_dead == 1
+
+
+def test_jobview_tolerant_load(tmp_path):
+    """The live follower skips a torn trailing line instead of dying."""
+    from dryad_tpu.tools.jobview import _load_tolerant
+
+    p = tmp_path / "ev.jsonl"
+    p.write_text(
+        '{"ts": 1, "kind": "job_start", "stages": 1}\n'
+        '{"ts": 2, "kind": "stage_sta'  # torn mid-write
+    )
+    events = _load_tolerant(str(p))
+    assert len(events) == 1 and events[0]["kind"] == "job_start"
