@@ -190,7 +190,7 @@ def _do_resize(ctx: StageContext, slot: int, factor: float) -> None:
 NON_OVERFLOW_OPS = frozenset({
     "select", "where", "project", "select_many", "apply", "fork",
     "group_reduce", "group_combine", "group_reduce_dense", "distinct",
-    "local_sort", "concat", "scalar_agg",
+    "local_sort", "concat", "scalar_agg", "topk",
 })
 
 
@@ -327,6 +327,45 @@ def _k_group_reduce_dense(ctx: StageContext, p) -> None:
 def _k_distinct(ctx: StageContext, p) -> None:
     b = ctx.slots[p["slot"]]
     ctx.slots[p["slot"]] = SEG.distinct(b, p["keys"])
+
+
+def _k_topk(ctx: StageContext, p) -> None:
+    """Fused OrderBy+Take(n): per-partition local top-n, one
+    ``all_gather`` of the P heads, final local sort — no full range
+    exchange, no full-data shuffle (the SimpleRewriter-style plan
+    rewrite, ``LinqToDryad/SimpleRewriter.cs``; classic distributed
+    top-k).  Output is partition-major globally sorted with exactly n
+    valid rows; per-partition capacity shrinks to the padded head size.
+    Tie rows beyond position n are dropped in post-sort order (the
+    engine's order_by+take makes the same unstable tie choice after a
+    shuffle)."""
+    b = ctx.slots[p["slot"]]
+    operands = p["operands_fn"](b)
+    order = SORT.sort_order_by_operands(operands, b.valid)
+    sb = b.take(order)  # local sort; valid rows first
+    n = int(p["n"])
+    # head size never exceeds the partition capacity: slicing past the
+    # array would clamp and the gather arithmetic below would duplicate
+    # the tail partition's rows
+    n_pad = min(b.capacity, max(8, _round8(n)))
+    head = ColumnBatch(
+        {c: v[:n_pad] for c, v in sb.data.items()}, sb.valid[:n_pad]
+    )
+    gb = _gather_all(head, ctx.axes)  # every partition: all P heads
+    gorder = SORT.sort_order_by_operands(p["operands_fn"](gb), gb.valid)
+    gsb = gb.take(gorder)  # identical globally-sorted array everywhere
+    me = jax.lax.axis_index(ctx.axes)
+    start = me * n_pad
+    pos = start + jnp.arange(n_pad, dtype=jnp.int32)
+    data = {
+        c: jax.lax.dynamic_slice_in_dim(v, start, n_pad)
+        for c, v in gsb.data.items()
+    }
+    valid = (
+        jax.lax.dynamic_slice_in_dim(gsb.valid, start, n_pad)
+        & (pos < jnp.int32(n))
+    )
+    ctx.slots[p["slot"]] = ColumnBatch(data, valid)
 
 
 def _k_local_sort(ctx: StageContext, p) -> None:
@@ -753,6 +792,7 @@ _KERNELS = {
     "group_combine": _k_group_combine,
     "distinct": _k_distinct,
     "local_sort": _k_local_sort,
+    "topk": _k_topk,
     "join": _k_join,
     "semi": _k_semi,
     "concat": _k_concat,

@@ -182,6 +182,24 @@ class _Builder:
                 )
             self.cursor[node.id] = ("open", stage, slot)
 
+        elif k == "topk":
+            stage, slot = self._continue_or_start(
+                node, fanout.get(node.inputs[0].id, 1)
+            )
+            in_schema = node.inputs[0].schema
+            operands_fn = K.ordering_operands(in_schema, node.params["keys"])
+            stage.ops.append(
+                StageOp(
+                    "topk",
+                    dict(slot=slot, operands_fn=operands_fn,
+                         n=int(node.params["n"])),
+                )
+            )
+            # topk SHRINKS the batch capacity; close the stage so any
+            # consumer's capacity bookkeeping starts from the new size.
+            self.cursor[node.id] = ("open", stage, slot)
+            self._materialize(node)
+
         elif k == "assume_partition":
             # Metadata-only: value identical to input.
             self.cursor[node.id] = self.cursor[node.inputs[0].id]
@@ -313,6 +331,20 @@ class _Builder:
                     # aggregate surface is DryadLinqQueryGen.cs:3439ff)
                     out.append(AggSpec(f"{op}64", f"{col}#h0", name))
                     continue
+                if f.ctype is ColumnType.FLOAT64:
+                    if op in ("min", "max"):
+                        # the stored words are the order-preserving
+                        # signed-int64 image, so int64 signed-lex
+                        # min/max apply unchanged (columnar/schema.py)
+                        out.append(AggSpec(f"{op}64", f"{col}#h0", name))
+                        continue
+                    if op in ("sum", "mean"):
+                        raise ValueError(
+                            f"aggregate {op!r} unsupported on float64 "
+                            f"column {col!r}: no f64 arithmetic on "
+                            f"device — cast to float32 for approximate "
+                            f"sums"
+                        )
                 if f.ctype.is_split:
                     if op != "first":
                         raise ValueError(
@@ -703,13 +735,58 @@ def _finalize_fn(aggs):
     return _FinalizeMeans(means)
 
 
+def _rewrite_topk(roots: Sequence[Node], limit: int) -> List[Node]:
+    """Plan rewrite (the ``SimpleRewriter.cs`` Phase-1 analog):
+    ``take(n)`` over a sole-consumer ``order_by`` becomes one fused
+    ``topk`` node — per-partition top-n + an ``all_gather`` of the P
+    heads + a final local sort, instead of a full range exchange of the
+    whole dataset.  Applied only for n <= ``limit`` (the gathered head
+    array is P*n rows on every partition)."""
+    fanout = consumers(roots)
+    memo: Dict[int, Node] = {}
+
+    def rb(node: Node) -> Node:
+        if node.id in memo:
+            return memo[node.id]
+        new_inputs = [rb(i) for i in node.inputs]
+        src = node.inputs[0] if node.inputs else None
+        if (
+            node.kind == "take"
+            and src is not None
+            and src.kind == "order_by"
+            and fanout.get(src.id, 1) == 1
+            and 0 < node.params["n"] <= limit
+        ):
+            ob = new_inputs[0]
+            ks = [(kk, bool(d)) for kk, d in ob.params["keys"]]
+            nn = Node(
+                "topk", [ob.inputs[0]], node.schema,
+                PartitionInfo.ranged(ks, ks, spread=True),
+                keys=ks, n=node.params["n"],
+            )
+        elif all(ni is oi for ni, oi in zip(new_inputs, node.inputs)):
+            nn = node
+        else:
+            nn = Node(
+                node.kind, new_inputs, node.schema, node.partition,
+                **node.params,
+            )
+        memo[node.id] = nn
+        return nn
+
+    return [rb(r) for r in roots]
+
+
 def lower(roots: Sequence[Node], config) -> StageGraph:
     """Lower a logical DAG to a stage graph (Phase 2+3)."""
     b = _Builder(config)
-    fanout = consumers(roots)
-    for node in walk(roots):
+    rewritten = _rewrite_topk(roots, getattr(config, "topk_limit", 1024))
+    fanout = consumers(rewritten)
+    for node in walk(rewritten):
         b.lower_node(node, fanout)
+    # outputs stay keyed by the CALLER's root ids (rewrites rebuild
+    # nodes, but callers look up query.node.id)
     outputs: Dict[int, Tuple[int, int]] = {}
-    for r in roots:
-        outputs[r.id] = b._materialize(r)
+    for orig, r in zip(roots, rewritten):
+        outputs[orig.id] = b._materialize(r)
     return StageGraph(b.stages, outputs, b.plan_inputs)

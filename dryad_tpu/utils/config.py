@@ -99,6 +99,10 @@ class DryadConfig:
     # dynamic broadcast decision of DynamicManager.cs:51 /
     # DrDynamicBroadcast.h:23, made trace-time from static capacities).
     broadcast_limit: int = _env_int("DRYAD_TPU_BROADCAST_LIMIT", 1 << 16)
+    # order_by+take(n) fuses into a shuffle-free distributed top-k when
+    # n is at or below this (each partition gathers P*n head rows);
+    # larger takes keep the full range-exchange sort.
+    topk_limit: int = _env_int("DRYAD_TPU_TOPK_LIMIT", 1024)
     # Target rows per independent vertex task: when a partitioned
     # submission doesn't pin nparts, the fan-out is computed from the
     # OBSERVED input size (the data-size-driven consumer-count

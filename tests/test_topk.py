@@ -1,0 +1,112 @@
+"""Fused OrderBy+Take top-k rewrite (SimpleRewriter.cs analog).
+
+``take(n)`` over a sole-consumer ``order_by`` becomes a shuffle-free
+distributed top-k: per-partition local top-n, one ``all_gather`` of the
+P heads, final local sort — the full range exchange of the dataset
+disappears.
+"""
+
+import numpy as np
+
+from dryad_tpu import DryadContext
+from dryad_tpu.plan.lower import lower
+from dryad_tpu.utils.config import DryadConfig
+
+
+def _ops(q):
+    graph = lower([q.node], q.ctx.config)
+    return [op.kind for st in graph.stages for op in st.ops]
+
+
+def test_topk_rewrite_removes_exchange(rng):
+    ctx = DryadContext(num_partitions_=8)
+    q = ctx.from_arrays(
+        {"k": rng.integers(0, 1 << 20, 4096).astype(np.int32)}
+    ).order_by(["k"]).take(10)
+    kinds = _ops(q)
+    assert "topk" in kinds
+    assert "exchange_range" not in kinds
+
+
+def test_topk_matches_full_sort(rng):
+    n = 1 << 13
+    tbl = {
+        "k": rng.integers(-(2 ** 31), 2 ** 31 - 1, n).astype(np.int32),
+        "v": rng.standard_normal(n).astype(np.float32),
+    }
+    ctx = DryadContext(num_partitions_=8)
+    out = ctx.from_arrays(tbl).order_by(["k"]).take(25).collect()
+    ref = np.sort(tbl["k"])[:25]
+    np.testing.assert_array_equal(out["k"], ref)
+    # the payload must travel with its key
+    by_key = {int(k): float(v) for k, v in zip(tbl["k"], tbl["v"])}
+    for k, v in zip(out["k"], out["v"]):
+        assert by_key[int(k)] == float(v)
+
+
+def test_topk_descending_multikey(rng):
+    n = 4096
+    tbl = {
+        "a": rng.integers(0, 64, n).astype(np.int32),
+        "b": rng.integers(0, 1 << 16, n).astype(np.int32),
+    }
+    ctx = DryadContext(num_partitions_=8)
+    out = ctx.from_arrays(tbl).order_by([("a", True), "b"]).take(17).collect()
+    ref = sorted(zip(tbl["a"].tolist(), tbl["b"].tolist()),
+                 key=lambda t: (-t[0], t[1]))[:17]
+    assert list(zip(out["a"].tolist(), out["b"].tolist())) == ref
+
+
+def test_topk_n_exceeding_rows(rng):
+    tbl = {"k": rng.integers(0, 99, 50).astype(np.int32)}
+    ctx = DryadContext(num_partitions_=8)
+    out = ctx.from_arrays(tbl).order_by(["k"]).take(500).collect()
+    np.testing.assert_array_equal(out["k"], np.sort(tbl["k"]))
+
+
+def test_topk_limit_keeps_full_sort_path(rng):
+    ctx = DryadContext(
+        num_partitions_=8, config=DryadConfig(topk_limit=8)
+    )
+    q = ctx.from_arrays(
+        {"k": rng.integers(0, 999, 1024).astype(np.int32)}
+    ).order_by(["k"]).take(100)
+    kinds = _ops(q)
+    assert "topk" not in kinds and "exchange_range" in kinds
+    out = q.collect()
+    assert len(out["k"]) == 100
+    assert out["k"].tolist() == sorted(out["k"].tolist())
+
+
+def test_multi_consumer_order_by_not_rewritten(rng):
+    """An order_by feeding BOTH a take and another consumer keeps the
+    full sort (the take alone cannot claim it)."""
+    ctx = DryadContext(num_partitions_=8)
+    sorted_q = ctx.from_arrays(
+        {"k": rng.integers(0, 99, 512).astype(np.int32)}
+    ).order_by(["k"])
+    top = sorted_q.take(5)
+    everything = sorted_q.skip(5)
+    graph = lower([top.node, everything.node], ctx.config)
+    kinds = [op.kind for st in graph.stages for op in st.ops]
+    assert "topk" not in kinds
+    out_top = top.collect()
+    assert len(out_top["k"]) == 5
+
+
+def test_topk_with_strings(rng):
+    vocab = np.array([f"w{i:03d}" for i in range(200)], object)
+    words = vocab[rng.integers(0, 200, 2000)]
+    ctx = DryadContext(num_partitions_=8)
+    out = (
+        ctx.from_arrays({"w": words})
+        .group_by("w", {"c": ("count", None)})
+        .order_by([("c", True)])
+        .take(5)
+        .collect()
+    )
+    counts = {}
+    for w in words:
+        counts[w] = counts.get(w, 0) + 1
+    ref = sorted(counts.values(), reverse=True)[:5]
+    assert out["c"].tolist() == ref
