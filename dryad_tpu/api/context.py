@@ -48,12 +48,13 @@ _NP_TYPE_MAP = {
     np.dtype(np.int32): ColumnType.INT32,
     np.dtype(np.int64): ColumnType.INT64,
     np.dtype(np.float32): ColumnType.FLOAT32,
-    np.dtype(np.float64): ColumnType.FLOAT32,
+    # float64 is PRESERVED (order-preserving split-word storage,
+    # columnar/schema.py): exact round-trip, ordering, min/max, joins;
+    # device arithmetic (sum/mean) requires an explicit f32 cast.
+    np.dtype(np.float64): ColumnType.FLOAT64,
     np.dtype(np.bool_): ColumnType.BOOL,
     np.dtype(np.uint32): ColumnType.UINT32,
 }
-
-_warned_f64: set = set()
 
 
 def _infer_schema(arrays: Dict[str, np.ndarray]) -> Schema:
@@ -63,17 +64,6 @@ def _infer_schema(arrays: Dict[str, np.ndarray]) -> Schema:
         if a.dtype == object or a.dtype.kind in ("U", "S"):
             fields.append((name, ColumnType.STRING))
         elif a.dtype in _NP_TYPE_MAP:
-            if a.dtype == np.float64 and name not in _warned_f64:
-                # No silent precision loss: f64 has no native TPU story
-                # (x64 stays off framework-wide, columnar/schema.py), so
-                # ingest narrows to f32 — loudly, once per column.  Pass
-                # an explicit f32 array or an int64 column for exactness.
-                _warned_f64.add(name)
-                log.warning(
-                    "column %r: float64 ingest narrows to float32 "
-                    "(cast explicitly to silence; use int64 for exact "
-                    "wide integers)", name,
-                )
             fields.append((name, _NP_TYPE_MAP[a.dtype]))
         else:
             raise TypeError(f"column {name!r}: unsupported dtype {a.dtype}")

@@ -185,6 +185,11 @@ class ColumnBatch:
             elif f.ctype == ColumnType.INT64:
                 lo, hi = split64(a.astype(np.int64))
                 phys = {f"{f.name}#h0": lo, f"{f.name}#h1": hi}
+            elif f.ctype == ColumnType.FLOAT64:
+                from dryad_tpu.columnar.schema import f64_to_ordered_i64
+
+                lo, hi = split64(f64_to_ordered_i64(a))
+                phys = {f"{f.name}#h0": lo, f"{f.name}#h1": hi}
             else:
                 phys = {f.name: a.astype(f.ctype.numpy_dtype)}
             for pname, pvals in phys.items():
@@ -218,6 +223,12 @@ class ColumnBatch:
                 lo = np.asarray(self.data[f"{f.name}#h0"])[valid]
                 hi = np.asarray(self.data[f"{f.name}#h1"])[valid]
                 out[f.name] = join64(lo, hi, signed=True)
+            elif f.ctype == ColumnType.FLOAT64:
+                from dryad_tpu.columnar.schema import ordered_i64_to_f64
+
+                lo = np.asarray(self.data[f"{f.name}#h0"])[valid]
+                hi = np.asarray(self.data[f"{f.name}#h1"])[valid]
+                out[f.name] = ordered_i64_to_f64(join64(lo, hi, signed=True))
             else:
                 out[f.name] = np.asarray(self.data[f.name])[valid]
         return out

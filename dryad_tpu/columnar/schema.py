@@ -27,6 +27,12 @@ class ColumnType(enum.Enum):
     INT32 = "int32"
     INT64 = "int64"  # stored on device as two uint32 words (#h0 low, #h1 high)
     FLOAT32 = "float32"
+    # Stored on device as the ORDER-PRESERVING signed-int64 image of the
+    # IEEE-754 bits (two uint32 words): exact round-trip, and every
+    # int64 comparison/sort/min/max kernel applies unchanged.  No f64
+    # arithmetic on device (x64 stays off): sum/mean are rejected with
+    # a cast-to-f32 suggestion.
+    FLOAT64 = "float64"
     BOOL = "bool"
     UINT32 = "uint32"
     STRING = "string"  # dictionary-encoded: two uint32 hash words + host dict
@@ -34,7 +40,7 @@ class ColumnType(enum.Enum):
     @property
     def is_split(self) -> bool:
         """True when the logical column maps to multiple uint32 device columns."""
-        return self in (ColumnType.INT64, ColumnType.STRING)
+        return self in (ColumnType.INT64, ColumnType.FLOAT64, ColumnType.STRING)
 
     @property
     def numpy_dtype(self) -> np.dtype:
@@ -42,6 +48,7 @@ class ColumnType(enum.Enum):
             ColumnType.INT32: np.dtype(np.int32),
             ColumnType.INT64: np.dtype(np.int64),
             ColumnType.FLOAT32: np.dtype(np.float32),
+            ColumnType.FLOAT64: np.dtype(np.float64),
             ColumnType.BOOL: np.dtype(np.bool_),
             ColumnType.UINT32: np.dtype(np.uint32),
             ColumnType.STRING: np.dtype(object),
@@ -59,7 +66,7 @@ def device_column_names(name: str, ctype: ColumnType) -> List[str]:
     """
     if ctype == ColumnType.STRING:
         return [f"{name}#h0", f"{name}#h1", f"{name}#r0", f"{name}#r1"]
-    if ctype == ColumnType.INT64:
+    if ctype in (ColumnType.INT64, ColumnType.FLOAT64):
         return [f"{name}#h0", f"{name}#h1"]
     return [name]
 
@@ -113,6 +120,33 @@ def split64(values: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
 def join64(lo: np.ndarray, hi: np.ndarray, signed: bool = False) -> np.ndarray:
     v = lo.astype(np.uint64) | (hi.astype(np.uint64) << np.uint64(32))
     return v.view(np.int64) if signed else v
+
+
+_SIGN64 = np.uint64(1 << 63)
+
+
+def f64_to_ordered_i64(values: np.ndarray) -> np.ndarray:
+    """Order-preserving signed-int64 image of float64 values.
+
+    The classic memcomparable-double transform (negatives: ~bits;
+    non-negatives: bits | signbit) shifted into the signed domain
+    (xor signbit), so signed-int64 comparisons order exactly like the
+    doubles under IEEE-754 totalOrder semantics: -0.0 orders below
+    +0.0, sign-negative NaNs below -inf, sign-positive NaNs above +inf
+    (the documented engine semantic for float64 ordering).
+    """
+    bits = np.ascontiguousarray(values, np.float64).view(np.uint64)
+    neg = (bits & _SIGN64) != 0
+    t = np.where(neg, ~bits ^ _SIGN64, bits)
+    return t.view(np.int64)
+
+
+def ordered_i64_to_f64(vals: np.ndarray) -> np.ndarray:
+    """Inverse of :func:`f64_to_ordered_i64`."""
+    s = np.ascontiguousarray(vals, np.int64).view(np.uint64)
+    neg = (s & _SIGN64) != 0  # negatives map to signed-negative images
+    bits = np.where(neg, ~(s ^ _SIGN64), s)
+    return bits.view(np.float64)
 
 
 class StringDictionary:

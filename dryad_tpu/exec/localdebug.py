@@ -198,13 +198,25 @@ class LocalDebugInterpreter:
         from dryad_tpu.columnar.schema import ColumnType, join64, split64
 
         for op, col, name in node.params["aggs"]:
+            ctype = (
+                in_schema.field(col).ctype if col is not None else None
+            )
+            if ctype is ColumnType.FLOAT64 and op in ("sum", "mean"):
+                raise ValueError(
+                    f"aggregate {op!r} unsupported on float64 column "
+                    f"{col!r}: cast to float32"
+                )
             if (
                 col is not None
                 and col not in t
-                and in_schema.field(col).ctype is ColumnType.INT64
-                and op in ("sum", "min", "max")
+                and (
+                    (ctype is ColumnType.INT64 and op in ("sum", "min", "max"))
+                    # FLOAT64 words are the order-preserving i64 image:
+                    # min/max commute with the monotone transform
+                    or (ctype is ColumnType.FLOAT64 and op in ("min", "max"))
+                )
             ):
-                # split int64 column: independent numpy-int64 oracle for
+                # split 64-bit column: independent numpy-int64 oracle for
                 # the engine's paired-word arithmetic (wrapping sum)
                 full = join64(
                     np.asarray(t[f"{col}#h0"]), np.asarray(t[f"{col}#h1"]),

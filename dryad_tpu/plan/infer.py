@@ -43,7 +43,17 @@ _DTYPE_TO_TYPE = {
 }
 
 
-def schema_from_physical(cols: Dict[str, jax.ShapeDtypeStruct]) -> Schema:
+def schema_from_physical(
+    cols: Dict[str, jax.ShapeDtypeStruct],
+    like: Schema = None,
+) -> Schema:
+    """Reconstruct a logical schema from physical columns.
+
+    A bare ``#h0/#h1`` word pair is ambiguous (INT64 and FLOAT64 share
+    the layout), so a surviving logical name inherits its type from
+    ``like`` (the input schema) when given; word pairs NEW to the output
+    default to INT64.
+    """
     names = set(cols.keys())
     fields: List[Tuple[str, ColumnType]] = []
     seen = set()
@@ -57,7 +67,14 @@ def schema_from_physical(cols: Dict[str, jax.ShapeDtypeStruct]) -> Schema:
             if has == {f"{base}#h0", f"{base}#h1", f"{base}#r0", f"{base}#r1"}:
                 fields.append((base, ColumnType.STRING))
             elif has == {f"{base}#h0", f"{base}#h1"}:
-                fields.append((base, ColumnType.INT64))
+                if (
+                    like is not None
+                    and base in like
+                    and like.field(base).ctype.is_split
+                ):
+                    fields.append((base, like.field(base).ctype))
+                else:
+                    fields.append((base, ColumnType.INT64))
             else:
                 raise ValueError(
                     f"incomplete split column set for {base!r}: {sorted(has)}"
@@ -75,7 +92,7 @@ def infer_select_schema(schema: Schema, fn) -> Schema:
     out = jax.eval_shape(lambda c: fn(c), shapes)
     if not isinstance(out, dict):
         raise TypeError("select fn must return a dict of physical columns")
-    return schema_from_physical(out)
+    return schema_from_physical(out, like=schema)
 
 
 def infer_select_many_schema(schema: Schema, fn, factor: int) -> Schema:
@@ -85,4 +102,4 @@ def infer_select_many_schema(schema: Schema, fn, factor: int) -> Schema:
         n: jax.ShapeDtypeStruct((s.shape[0] * factor,), s.dtype)
         for n, s in out_cols.items()
     }
-    return schema_from_physical(flat)
+    return schema_from_physical(flat, like=schema)

@@ -25,9 +25,7 @@ def equality_cols(schema: Schema, names: Sequence[str]) -> List[str]:
     out: List[str] = []
     for n in names:
         f = schema.field(n)
-        if f.ctype == ColumnType.STRING:
-            out += [f"{n}#h0", f"{n}#h1"]
-        elif f.ctype == ColumnType.INT64:
+        if f.ctype.is_split:
             out += [f"{n}#h0", f"{n}#h1"]
         else:
             out.append(n)
@@ -78,7 +76,10 @@ class OrderingOperands:
                 h1 = batch.data[f"{f.name}#h1"]
                 triple = [r0, r1, h1, h0]
                 ops.extend(~t if desc else t for t in triple)
-            elif f.ctype == ColumnType.INT64:
+            elif f.ctype in (ColumnType.INT64, ColumnType.FLOAT64):
+                # FLOAT64 words are the order-preserving signed-int64
+                # image of the double, so the int64 operand transform
+                # orders both types correctly
                 hi = batch.data[f"{f.name}#h1"] ^ jnp.uint32(0x80000000)
                 lo = batch.data[f"{f.name}#h0"]
                 ops.extend([~hi, ~lo] if desc else [hi, lo])
