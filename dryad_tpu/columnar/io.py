@@ -59,12 +59,29 @@ def parse_partition_bytes(buf: bytes) -> Dict[str, np.ndarray]:
     header = json.loads(buf[:nl].decode("utf-8"))
     out: Dict[str, np.ndarray] = {}
     at = nl + 1
+    # compressed columns inflate in parallel on native threads when the
+    # runtime is available (channelbuffernativereader analog)
+    comp_srcs: List[bytes] = []
+    comp_dsts: List[np.ndarray] = []
     for c in header["columns"]:
         data = buf[at : at + c["nbytes"]]
         at += c["nbytes"]
         if c["comp"] == "zlib":
-            data = zlib.decompress(data)
-        out[c["name"]] = np.frombuffer(data, dtype=np.dtype(c["dtype"])).copy()
+            dt = np.dtype(c["dtype"])
+            arr = np.empty(c["rows"], dt)
+            out[c["name"]] = arr
+            comp_srcs.append(data)
+            comp_dsts.append(arr)
+        else:
+            out[c["name"]] = np.frombuffer(
+                data, dtype=np.dtype(c["dtype"])
+            ).copy()
+    if comp_srcs:
+        from dryad_tpu.runtime.bindings import decompress_batch
+
+        if not decompress_batch(comp_srcs, comp_dsts):
+            for src, dst in zip(comp_srcs, comp_dsts):
+                dst[:] = np.frombuffer(zlib.decompress(src), dst.dtype)
     return out
 
 

@@ -109,6 +109,14 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_uint16), ctypes.POINTER(ctypes.c_uint64),
             ctypes.POINTER(ctypes.c_uint32),
         ]
+        if hasattr(lib, "dn_decompress_batch"):  # rebuilt lib only
+            lib.dn_decompress_batch.restype = ctypes.c_int32
+            lib.dn_decompress_batch.argtypes = [
+                ctypes.c_size_t, ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_uint64),
+            ]
         _lib = lib
         log.info("native runtime loaded from %s", _LIB_PATH)
         return _lib
@@ -116,6 +124,33 @@ def _load() -> Optional[ctypes.CDLL]:
 
 def native_available() -> bool:
     return _load() is not None
+
+
+def decompress_batch(srcs, dsts) -> bool:
+    """Inflate each zlib payload in ``srcs`` into the matching writable
+    buffer in ``dsts`` (numpy arrays), all columns in parallel on native
+    threads (the read half of the channel codec,
+    ``channelbuffernativereader.cpp`` analog).  Returns False when the
+    native runtime is unavailable (caller falls back to zlib)."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "dn_decompress_batch") or not srcs:
+        return False
+    n = len(srcs)
+    src_ptrs = (ctypes.c_void_p * n)()
+    src_lens = (ctypes.c_uint64 * n)()
+    dst_ptrs = (ctypes.c_void_p * n)()
+    dst_lens = (ctypes.c_uint64 * n)()
+    for i, (s, d) in enumerate(zip(srcs, dsts)):
+        # c_char_p points at the bytes object's buffer (no copy); srcs
+        # stays referenced by the caller for the duration of the call
+        src_ptrs[i] = ctypes.cast(ctypes.c_char_p(s), ctypes.c_void_p)
+        src_lens[i] = len(s)
+        dst_ptrs[i] = d.ctypes.data_as(ctypes.c_void_p)
+        dst_lens[i] = d.nbytes
+    rc = lib.dn_decompress_batch(n, src_ptrs, src_lens, dst_ptrs, dst_lens)
+    if rc != 0:
+        raise ValueError("corrupt compressed column payload")
+    return True
 
 
 def hash64(data: bytes) -> int:

@@ -118,6 +118,39 @@ size_t dn_decompress(const uint8_t* src, size_t src_len, uint8_t* dst,
 
 size_t dn_compress_bound(size_t src_len) { return compressBound(src_len); }
 
+// Threaded batch decompress: the read half of the channel codec
+// (reference async channel readers, channelbuffernativereader.cpp) —
+// every column payload of a partition file inflates in parallel into
+// caller-owned buffers (numpy arrays on the Python side, zero copy).
+// Returns 0 on success; 1 if any column fails to inflate to exactly
+// its declared size.
+int32_t dn_decompress_batch(size_t n, const uint8_t** srcs,
+                            const uint64_t* src_lens, uint8_t** dsts,
+                            const uint64_t* dst_lens) {
+  std::vector<int> ok(n, 1);
+  std::atomic<size_t> next{0};
+  auto work = [&]() {
+    for (;;) {
+      size_t i = next.fetch_add(1);
+      if (i >= n) return;
+      uLongf out = (uLongf)dst_lens[i];
+      int rc = uncompress(dsts[i], &out, srcs[i], (uLong)src_lens[i]);
+      if (rc != Z_OK || out != (uLongf)dst_lens[i]) ok[i] = 0;
+    }
+  };
+  size_t nt = std::thread::hardware_concurrency();
+  if (nt < 1) nt = 1;
+  if (nt > n) nt = n;
+  if (nt > 8) nt = 8;
+  std::vector<std::thread> pool;
+  for (size_t t = 0; t + 1 < nt; ++t) pool.emplace_back(work);
+  work();
+  for (auto& t : pool) t.join();
+  for (size_t i = 0; i < n; ++i)
+    if (!ok[i]) return 1;
+  return 0;
+}
+
 // --------------------------------------------- prefetch channel reader
 // Reads whole files on background threads, keeping up to `depth` blocks
 // queued.  Consumer pops blocks in file order.
