@@ -1,0 +1,71 @@
+"""TPU-only code paths on real hardware."""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def jaxmod():
+    import jax
+
+    assert jax.devices()[0].platform in ("tpu", "axon")
+    return jax
+
+
+def test_pallas_bucket_kernel_on_chip(jaxmod, ):
+    """The Pallas MXU kernel (not the XLA fallback) computes correct
+    bucket sums/counts on the chip."""
+    import jax.numpy as jnp
+
+    from dryad_tpu.ops.pallas_bucket import bucket_sum_count
+
+    rng = np.random.default_rng(0)
+    n, K = 1 << 16, 512
+    k = rng.integers(0, K, n).astype(np.int32)
+    v = rng.standard_normal(n).astype(np.float32)
+    sums, cnt = bucket_sum_count(
+        jnp.asarray(k), [jnp.asarray(v)], jnp.ones((n,), jnp.bool_), K,
+        interpret=None,  # Pallas path on TPU
+    )
+    ref_cnt = np.bincount(k, minlength=K)
+    ref_sum = np.bincount(k, weights=v, minlength=K)
+    np.testing.assert_array_equal(np.asarray(cnt), ref_cnt)
+    np.testing.assert_allclose(np.asarray(sums[0]), ref_sum, rtol=1e-4)
+
+
+def test_group_reduce_on_chip(jaxmod):
+    import jax.numpy as jnp
+
+    from dryad_tpu.columnar.batch import ColumnBatch
+    from dryad_tpu.ops.segmented import AggSpec, group_reduce
+
+    rng = np.random.default_rng(1)
+    n = 1 << 14
+    k = rng.integers(0, 64, n).astype(np.int32)
+    v = rng.standard_normal(n).astype(np.float32)
+    b = ColumnBatch(
+        {"k": jnp.asarray(k), "v": jnp.asarray(v)},
+        jnp.ones((n,), jnp.bool_),
+    )
+    out = group_reduce(b, ["k"], [AggSpec("sum", "v", "s"),
+                                  AggSpec("count", None, "c")])
+    valid = np.asarray(out.valid)
+    got = dict(zip(np.asarray(out.data["k"])[valid].tolist(),
+                   np.asarray(out.data["c"])[valid].tolist()))
+    ref = {int(key): int((k == key).sum()) for key in np.unique(k)}
+    assert got == ref
+
+
+def test_wordcount_end_to_end_on_chip(jaxmod):
+    from dryad_tpu import DryadContext
+
+    rng = np.random.default_rng(2)
+    words = np.array([f"w{i:03d}" for i in rng.integers(0, 100, 5000)], object)
+    ctx = DryadContext()
+    out = (
+        ctx.from_arrays({"w": words})
+        .group_by("w", {"c": ("count", None)})
+        .order_by([("c", True)])
+        .collect()
+    )
+    assert int(np.sum(out["c"])) == 5000
