@@ -19,6 +19,9 @@ def _rand_table(rng, n):
         "k": rng.integers(0, 9, n).astype(np.int32),
         "g": rng.integers(0, 4, n).astype(np.int32),
         "v": (rng.standard_normal(n) * 4).round(2).astype(np.float32),
+        # wide/exact types: int64 past 2^32, full-range float64
+        "w": rng.integers(-(2 ** 52), 2 ** 52, n).astype(np.int64),
+        "d": rng.standard_normal(n) * np.exp(rng.uniform(-100, 100, n)),
     }
 
 
@@ -52,13 +55,37 @@ _STEPS = {
         ).select(lambda c: {"k": c["k"], "g": c["g"],
                             "v": c["s"] + c["mn"] + c["c"]})
     ),
-    "order_take": (lambda q: q.order_by([("v", True), ("k", False)]).take(17)),
+    # project to the sort-key set FIRST: rows tying on (v, k, g) are
+    # then identical, so the topk rewrite's tie choice cannot diverge
+    # from the oracle's (w/d would otherwise distinguish tied rows)
+    "order_take": (
+        lambda q: q.project(["k", "g", "v"]).order_by(
+            [("v", True), ("k", False), ("g", False)]
+        ).take(17)
+    ),
     "skip": (lambda q: q.order_by([("k", False), ("v", False)]).skip(5)),
     "hash_partition": (lambda q: q.hash_partition("g")),
     "range_partition": (lambda q: q.range_partition("v")),
     "reverse": (lambda q: q.order_by([("v", False)]).reverse()),
     "tail": (lambda q: q.order_by([("v", False)]).tail(13)),
+    "group_wide": (  # terminal: exact int64 sum/min/max incl. >2^32
+        lambda q: q.group_by(
+            ["g"], {"ws": ("sum", "w"), "wl": ("min", "w"),
+                    "wh": ("max", "w"), "c": ("count", None)}
+        )
+    ),
+    "order_f64": (lambda q: q.order_by([("d", False), ("k", False)])),
+    "minmax_f64": (  # terminal: float64 totalOrder min/max
+        lambda q: q.group_by(
+            ["k"], {"lo": ("min", "d"), "hi": ("max", "d"),
+                    "c": ("count", None)}
+        )
+    ),
 }
+
+# steps touching the wide columns (w, d), dropped by "group_by"
+_WIDE_STEPS = {"group_wide", "order_f64", "minmax_f64"}
+_TERMINAL = {"distinct_k", "group_wide", "minmax_f64"}
 
 # group_by collapses the row space; cap how often it may appear so
 # pipelines keep data flowing.
@@ -69,15 +96,22 @@ def _build_pipeline(rng, depth):
     names = sorted(_STEPS)
     steps = []
     n_groups = 0
+    wide_ok = True  # w/d columns still present
     for _ in range(depth):
         name = names[int(rng.integers(0, len(names)))]
-        if name in ("group_by", "distinct_k"):
+        if name in _WIDE_STEPS and not wide_ok:
+            continue
+        if name in ("group_by", "distinct_k", "group_wide", "minmax_f64"):
             if n_groups >= _MAX_GROUPS:
                 continue
             n_groups += 1
+        # select/group/project steps rebuild the schema without w/d
+        if name in ("group_by", "select_double", "select_shift",
+                    "order_take"):
+            wide_ok = False
         steps.append(name)
-        if name == "distinct_k":
-            break  # schema narrows to (k, g); stop to keep grammar simple
+        if name in _TERMINAL:
+            break  # schema narrows; stop to keep the grammar simple
     return steps
 
 
