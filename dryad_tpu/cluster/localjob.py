@@ -36,7 +36,7 @@ import subprocess
 import sys
 import tempfile
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -126,6 +126,41 @@ class SubprocessLauncher(WorkerLauncher):
             handle.kill()
 
 
+class CommandLauncher(SubprocessLauncher):
+    """Launcher that wraps the worker argv in a host command template —
+    the remote-cluster seam (``YarnJobSubmission.cs:63-111`` composes
+    worker process groups the same way).  ``template`` is a list of
+    prefix tokens; ``{host}`` substitutes a per-worker host from
+    ``hosts`` (round-robin).
+
+    What this seam does and does NOT solve: the template only controls
+    HOW the worker command starts.  True off-machine launch (ssh /
+    kubectl exec) additionally needs (a) the wrapper to forward the
+    worker environment (spec["env"] applies to the local wrapper
+    process, so e.g. ssh needs ``env K=V ...`` tokens or a remote
+    profile), (b) an interpreter + checkout reachable at the same
+    paths on the remote host (shared filesystem or baked image), and
+    (c) the driver's ProcessService and coordinator bound on a
+    routable address — pass ``bind_host``/``advertise_host`` to
+    :class:`LocalJobSubmission` for that.  The template alone is
+    exercised in-tree with local prefixes (``env``, ``nice`` …).
+    """
+
+    def __init__(self, template: Optional[List[str]] = None,
+                 hosts: Optional[List[str]] = None):
+        self.template = list(template or [])
+        self.hosts = list(hosts or [])
+
+    def start(self, spec: Dict):
+        host = (
+            self.hosts[spec["index"] % len(self.hosts)]
+            if self.hosts else "localhost"
+        )
+        prefix = [t.replace("{host}", host) for t in self.template]
+        spec = dict(spec, argv=prefix + list(spec["argv"]))
+        return super().start(spec)
+
+
 class LocalJobSubmission:
     """Driver for N worker processes jointly executing submitted queries.
 
@@ -144,7 +179,13 @@ class LocalJobSubmission:
         worker_timeout: float = 300.0,
         launcher: Optional[WorkerLauncher] = None,
         defer_workers: int = 0,
+        bind_host: str = "127.0.0.1",
+        advertise_host: Optional[str] = None,
     ):
+        """``bind_host``/``advertise_host``: where the driver's service
+        and coordinator listen / how workers address them — loopback
+        for local gangs; bind "0.0.0.0" and advertise a routable name
+        when a :class:`CommandLauncher` starts workers off-machine."""
         from dryad_tpu.parallel.multihost import ControlPlane
 
         self.n = num_workers
@@ -152,7 +193,8 @@ class LocalJobSubmission:
         self.timeout = worker_timeout
         self.root = root or tempfile.mkdtemp(prefix="dryad-localjob-")
         self.job_id = f"job-{os.getpid()}-{int(time.time() * 1000)}"
-        self.service = ProcessService(self.root)
+        self.advertise = advertise_host or "127.0.0.1"
+        self.service = ProcessService(self.root, host=bind_host)
         self.launcher = launcher or SubprocessLauncher()
         # Computers register on ANNOUNCE (elastic membership), not at
         # construction — a late worker's slot must not accept tasks
@@ -162,13 +204,17 @@ class LocalJobSubmission:
         self.events = EventLog(os.path.join(self.root, "events.jsonl"))
         self._cp = ControlPlane(self.job_id, -1, mailbox=self.service.mailbox)
         self._status_ver: Dict[int, int] = {}
+        # per-plan-signature duration models: the outlier fit assumes
+        # repeated attempts of the SAME work (DrStageStatistics), so
+        # heterogeneous queries must not share one model
+        self._gang_stats: Dict[Tuple, StageStatistics] = {}
         self._seq = 0
         self._cseq = 0  # unique per driver command; echoed in statuses
         self._handles: Dict[int, object] = {}
         self._logs: Dict[int, str] = {}
         self._registered: set = set()
         self._dead: set = set()
-        self._coord = f"127.0.0.1:{_free_port()}"
+        self._coord = f"{self.advertise}:{_free_port()}"
         for i in range(self.n - max(defer_workers, 0)):
             self.start_worker(i)
 
@@ -188,6 +234,7 @@ class LocalJobSubmission:
         return {
             "argv": [
                 sys.executable, "-m", "dryad_tpu.cluster.worker",
+                "--service-host", self.advertise,
                 "--service-port", str(self.service.port),
                 "--job", self.job_id,
                 "--pid", str(i),
@@ -368,6 +415,7 @@ class LocalJobSubmission:
             "kind": "run", "package": pkg_rel,
             "result_dir": result_rel, "seq": seq, "cseq": self._next_cseq(),
         }
+        t_run0 = time.monotonic()
         procs = []
         for i in range(self.n):
             p = ClusterProcess(
@@ -385,6 +433,23 @@ class LocalJobSubmission:
         if failed:
             errs = "; ".join(f"{p.name}: {p.error}" for p in failed)
             raise RuntimeError(f"local job failed: {errs}")
+        # Gang runs are lockstep (a mid-program straggler cannot be
+        # duplicated), so the duration model here SURFACES outliers for
+        # the jobview diagnosis rather than acting (the stage-level half
+        # of DrStageStatistics; the acting half lives in
+        # submit_partitioned).  Keyed by plan structure: only repeats
+        # of the same pipeline feed one model.
+        from dryad_tpu.plan.nodes import walk
+
+        sig = tuple(nd.kind for nd in walk([query.node]))
+        st = self._gang_stats.setdefault(sig, StageStatistics())
+        dt = time.monotonic() - t_run0
+        if st.is_outlier(dt):
+            self.events.emit(
+                "gang_straggler", seq=seq, seconds=round(dt, 3),
+                threshold=round(st.outlier_threshold(), 3),
+            )
+        st.record(dt)
 
         part_ids = sorted(
             {g for p in procs for g in p.result.get("parts", [])}

@@ -303,14 +303,17 @@ class ProcessService:
         spill_dir: Optional[str] = None,
         memory_budget: int = 64 * 1024 * 1024,
         block_size: int = DEFAULT_BLOCK,
+        host: str = "127.0.0.1",
     ):
+        """``host``: bind address — loopback by default; "0.0.0.0" for
+        a service remote workers must reach (multi-host jobs)."""
         self.root = os.path.abspath(root)
         self.mailbox = Mailbox()
         self.cache = BlockCache(
             self.root, spill_dir, memory_budget, block_size
         )
         handler = type("BoundHandler", (_Handler,), {"service": self})
-        self._httpd = http.server.ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self._httpd = http.server.ThreadingHTTPServer((host, port), handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="dryad-psvc", daemon=True

@@ -173,6 +173,7 @@ def _run_command(cmd: Dict, args, client, cp) -> Dict:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
+    ap.add_argument("--service-host", default="127.0.0.1")
     ap.add_argument("--service-port", type=int, required=True)
     ap.add_argument("--job", required=True)
     ap.add_argument("--pid", type=int, required=True)
@@ -202,7 +203,7 @@ def main(argv=None) -> int:
 
     from dryad_tpu.cluster.service import ServiceClient
 
-    client = ServiceClient("127.0.0.1", args.service_port)
+    client = ServiceClient(args.service_host, args.service_port)
     cp = ControlPlane(args.job, args.pid, client=client)
     cp.announce({"devices": args.devices_per_proc, "ospid": os.getpid()})
     cp.start_heartbeat()
