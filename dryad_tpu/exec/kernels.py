@@ -201,7 +201,12 @@ def _k_exchange_hash(ctx: StageContext, p) -> None:
 def _k_exchange_range(ctx: StageContext, p) -> None:
     b = ctx.slots[p["slot"]]
     operands = p["operands_fn"](b)
-    m = min(128, max(16, b.capacity // 8))
+    # Splitter sample count = sample_rate fraction of the partition
+    # (reference 0.1% sampler, DryadLinqSampler.cs:38-42), clamped to
+    # [16, 512] so tiny partitions still elect meaningful splitters and
+    # huge ones bound the all_gather.
+    rate = float(p.get("rate", 0.001))
+    m = int(min(512, max(16, b.capacity * rate)))
     if p.get("spread"):
         # Skew-proof variant for pure ordering (order_by): splitters
         # elected over ALL sort operands plus a uniform synthetic

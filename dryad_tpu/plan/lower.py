@@ -548,6 +548,7 @@ class _Builder:
                     dict(
                         slot=slot, operands_fn=operands_fn,
                         spread=node.kind == "order_by",
+                        rate=self.config.sample_rate,
                     ),
                 )
             )

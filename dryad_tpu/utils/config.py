@@ -74,9 +74,6 @@ class DryadConfig:
     max_shuffle_retries: int = 3
     intermediate_compression: Optional[str] = None  # None | "zlib"
     sample_rate: float = 0.001
-    # Materialize stage outputs to host at shuffle boundaries for fault
-    # tolerance (the DCT_File channel analog); False keeps everything in HBM.
-    materialize_at_shuffle: bool = False
     # Event log directory (Calypso analog); None disables.
     event_log_dir: Optional[str] = None
     # XLA/JAX profiler output directory (SURVEY 5.1: profiler traces +

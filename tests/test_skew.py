@@ -147,3 +147,25 @@ def test_range_partition_keeps_colocation(rng):
         if op.kind == "exchange_range"
     ]
     assert ex and not ex[0].params.get("spread")
+
+
+def test_sample_rate_reaches_splitter_election(rng):
+    """config.sample_rate plumbs into the range-exchange op (the 0.1%
+    sampler knob, DryadLinqSampler.cs:38-42)."""
+    from dryad_tpu.plan.lower import lower
+    from dryad_tpu.utils.config import DryadConfig
+
+    ctx = DryadContext(
+        num_partitions_=8, config=DryadConfig(sample_rate=0.01)
+    )
+    q = ctx.from_arrays(
+        {"k": rng.integers(0, 99, 512).astype(np.int32)}
+    ).order_by(["k"])
+    ex = [
+        op for st in lower([q.node], ctx.config).stages
+        for op in st.ops if op.kind == "exchange_range"
+    ]
+    assert ex and ex[0].params["rate"] == 0.01
+    out = q.collect()
+    assert out["k"].tolist() == sorted(out["k"].tolist())
+    assert len(out["k"]) == 512
