@@ -218,6 +218,43 @@ def wordcount_metric(n: int, vocab_size: int = 1 << 14):
         os.unlink(path)
 
 
+def wordcount_dense_metric(n: int, vocab_size: int = 1 << 14):
+    """WordCount on the MXU path: REAL tokens dictionary-encode to
+    dense categorical codes at ingest (np.unique over the token array,
+    done ONCE — the same once-at-ingest policy as wordcount_metric's
+    tokenization), then the count reduces via the one-hot-matmul bucket
+    kernel + one psum_scatter (`group_by(dense=K)`) — no sort, no
+    shuffle.  Reps measure the post-ingest device pipeline.  The
+    roofline says this is the >=1e10 rows/s route (BASELINE.md)."""
+    from dryad_tpu import DryadContext
+
+    rng = np.random.default_rng(0)
+    words = np.array(
+        [f"w{int(i):05d}" for i in rng.integers(0, vocab_size, n)], object
+    )
+    _vocab, codes = np.unique(words, return_inverse=True)
+    codes = codes.astype(np.int32)
+    vocab_size = len(_vocab)
+    ctx = DryadContext()
+    q = ctx.from_arrays({"word": codes})
+
+    def run():
+        out = q.group_by(
+            "word", {"count": ("count", None)}, dense=vocab_size
+        ).collect()
+        assert int(np.sum(out["count"])) == n
+
+    t0 = time.perf_counter()
+    run()
+    compile_s = time.perf_counter() - t0
+    log(f"wordcount_dense compiled+warmed in {compile_s:.1f}s")
+    best, times = timed_reps(run)
+    return rep_record(
+        "wordcount_dense_rows_per_sec", n, times,
+        {"vocab": vocab_size, "compile_s": round(compile_s, 1)},
+    )
+
+
 def terasort_metric(n: int):
     """TeraSort end-to-end THROUGH DryadContext: random keys + payload ->
     sampled-splitter range partition -> local sort -> collect.
@@ -311,18 +348,21 @@ def main() -> None:
     plan = [
         ("group_reduce_rows_per_sec",
          lambda: group_reduce_metric(1 << 22 if accel else 1 << 19),
-         60 if accel else 30, True),
+         60 if accel else 15, True),
         ("wordcount_rows_per_sec",
          lambda: wordcount_metric(1 << 21 if accel else 1 << 16),
-         100 if accel else 40, False),
+         100 if accel else 25, False),
+        ("wordcount_dense_rows_per_sec",
+         lambda: wordcount_dense_metric(1 << 22 if accel else 1 << 17),
+         60 if accel else 15, False),
         ("terasort_rows_per_sec",
          lambda: terasort_metric(1 << 21 if accel else 1 << 16),
-         80 if accel else 30, False),
+         80 if accel else 15, False),
         ("dense_xla_rows_per_sec",
          lambda: dense_path_metric(
              "dense_xla_rows_per_sec", 1 << 22 if accel else 1 << 19,
              use_pallas=False),
-         45 if accel else 20, False),
+         45 if accel else 15, False),
     ]
     if platform in ("tpu", "axon"):
         # The Pallas kernel only truly runs on TPU; elsewhere the number
