@@ -1,0 +1,112 @@
+"""Kernel-strategy probe: measure the group-by implementation
+candidates on the current backend (the evidence behind BASELINE.md's
+roofline section and the sort-vs-scatter decision).
+
+Measures, single-call with block_until_ready, best of 5 reps:
+  A. group_reduce (sort + segmented reduce)  -- the general path
+  B. bare 2-operand lax.sort                 -- sort share of A
+  C. scatter-add (segment_sum on raw keys)   -- sortless alternative
+  D. dense bucket one-hot matmul (XLA scan)  -- MXU path
+  E. dense bucket Pallas kernel              -- MXU path, Pallas (TPU)
+
+Usage:
+  python probe_perf.py          # real accelerator (hangs if the axon
+                                # tunnel is down -- run under `timeout`)
+  python probe_perf.py --cpu    # host CPU backend
+
+CPU reference numbers (2026-07, this host, n=4M, 4096 keys):
+  A 2.0e6 rows/s   B 2.7e6   C 2.3e8   D 5.0e5
+"""
+import sys
+import time
+
+import numpy as np
+
+
+def log(m):
+    print(f"[probe] {m}", file=sys.stderr, flush=True)
+
+
+def best_of(fn, reps=5):
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return min(ts), ts
+
+
+def main():
+    if "--cpu" in sys.argv:
+        from dryad_tpu.parallel.mesh import force_cpu_backend
+
+        force_cpu_backend(1)
+    import jax
+    import jax.numpy as jnp
+
+    from dryad_tpu.columnar.batch import ColumnBatch
+    from dryad_tpu.ops.pallas_bucket import bucket_sum_count
+    from dryad_tpu.ops.segmented import AggSpec, group_reduce
+
+    d = jax.devices()[0]
+    log(f"device={d} platform={d.platform}")
+
+    for n in (1 << 20, 1 << 22):
+        rng = np.random.default_rng(0)
+        k = jnp.asarray(rng.integers(0, 4096, n).astype(np.int32))
+        v = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+        valid = jnp.ones((n,), jnp.bool_)
+
+        @jax.jit
+        def gr(k, v, valid):
+            b = ColumnBatch({"k": k, "v": v}, valid)
+            out = group_reduce(
+                b, ["k"],
+                [AggSpec("sum", "v", "s"), AggSpec("count", None, "c")],
+            )
+            return jnp.sum(jnp.where(out.valid, out.data["s"], 0.0))
+
+        @jax.jit
+        def bare_sort(k, v):
+            a, b = jax.lax.sort((k, v), num_keys=1)
+            return a[0] + b[0]
+
+        @jax.jit
+        def scatter(k, v, valid):
+            vv = jnp.where(valid, v, 0.0)
+            s = jax.ops.segment_sum(vv, k, 4096)
+            c = jax.ops.segment_sum(valid.astype(jnp.int32), k, 4096)
+            return jnp.sum(s) + jnp.sum(c)
+
+        @jax.jit
+        def dense_xla(k, v, valid):
+            s, c = bucket_sum_count(k, [v], valid, 4096, interpret=False)
+            return jnp.sum(s[0]) + jnp.sum(c)
+
+        @jax.jit
+        def dense_pl(k, v, valid):
+            s, c = bucket_sum_count(k, [v], valid, 4096, interpret=None)
+            return jnp.sum(s[0]) + jnp.sum(c)
+
+        cases = [
+            ("A group_reduce", lambda: float(gr(k, v, valid))),
+            ("B bare_sort", lambda: float(bare_sort(k, v))),
+            ("C scatter_add", lambda: float(scatter(k, v, valid))),
+            ("D dense_xla", lambda: float(dense_xla(k, v, valid))),
+        ]
+        if d.platform in ("tpu", "axon"):
+            cases.append(("E dense_pallas", lambda: float(dense_pl(k, v, valid))))
+        for name, fn in cases:
+            t0 = time.perf_counter()
+            fn()
+            log(f"n={n} {name}: compile+run {time.perf_counter()-t0:.1f}s")
+            b, ts = best_of(fn)
+            log(
+                f"n={n} {name}: best={b*1e3:.2f}ms reps={['%.1f' % (t*1e3) for t in ts]}ms"
+                f" -> {n/b:.3e} rows/s"
+            )
+    log("done")
+
+
+if __name__ == "__main__":
+    main()

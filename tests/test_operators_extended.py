@@ -497,3 +497,25 @@ def test_apply_host_localdebug_and_validation(rng):
 
     out = ctx.from_arrays({"v": v}).apply_host(listy_fn, schema=sch).collect()
     assert out["v"].dtype == np.float32 and len(out["v"]) == 16
+
+
+def test_empty_table_through_major_operators(rng):
+    """Zero-row inputs flow through every major operator class without
+    error (DryadLinq's empty-partition channels are a constant edge
+    case; here it exercises capacity-floor padding)."""
+    from dryad_tpu import DryadContext
+
+    ctx = DryadContext(num_partitions_=8)
+    empty = {"k": np.zeros(0, np.int32), "v": np.zeros(0, np.float32)}
+
+    def q():
+        return ctx.from_arrays(empty)
+
+    assert len(q().collect()["k"]) == 0
+    assert len(q().group_by("k", {"c": ("count", None)}).collect()["c"]) == 0
+    assert len(q().order_by(["k"]).collect()["k"]) == 0
+    assert len(q().order_by(["k"]).take(5).collect()["k"]) == 0
+    assert len(q().where(lambda c: c["k"] > 0).collect()["k"]) == 0
+    assert len(q().join(q(), "k").collect()["k"]) == 0
+    assert len(q().distinct(["k"]).collect()["k"]) == 0
+    assert q().count() == 0
