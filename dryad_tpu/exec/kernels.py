@@ -744,6 +744,24 @@ def _k_scalar_agg(ctx: StageContext, p) -> None:
             s = jax.lax.psum(jnp.sum(jnp.where(v, col, 0.0)), ctx.axes)
             c = jax.lax.psum(jnp.sum(v.astype(jnp.float32)), ctx.axes)
             out[a.out] = (s / jnp.maximum(c, 1.0))[None]
+        elif a.op in SEG.PAIR_OPS:
+            # 64-bit scalar over a split column: per-partition pair
+            # reduce, all_gather the P partial pairs (psum can't carry
+            # 64-bit), reduce the gathered pairs the same way.
+            lo_col = a.col
+            hi_col = lo_col[: -len("#h0")] + "#h1"
+            plo, phi = SEG.pair_scalar_reduce(
+                a.op, b.data[lo_col], b.data[hi_col], v
+            )
+            glo = jax.lax.all_gather(plo[None], ctx.axes, tiled=True)
+            ghi = jax.lax.all_gather(phi[None], ctx.axes, tiled=True)
+            # all-invalid partitions already contributed the identity
+            # pair (neutral), so the gathered reduce needs no validity
+            tlo, thi = SEG.pair_scalar_reduce(
+                a.op, glo, ghi, jnp.ones(glo.shape, jnp.bool_)
+            )
+            out[f"{a.out}#h0"] = tlo[None]
+            out[f"{a.out}#h1"] = thi[None]
         elif a.op == "any":
             col = b.data[a.col]
             loc = jnp.any(v & col).astype(jnp.int32)

@@ -228,6 +228,25 @@ class LocalDebugInterpreter:
                     )
                 out[f"{name}#h0"], out[f"{name}#h1"] = split64(vals64)
                 continue
+            if col is not None and col not in t and (
+                in_schema.field(col).ctype.is_split
+            ):
+                if op == "first":
+                    # per-word first, mirroring the device expansion
+                    # (plan/lower.py _phys_aggs)
+                    for dev in in_schema.field(col).device_names:
+                        word = dev.split("#", 1)[1]
+                        arr = np.asarray(t[dev])
+                        out[f"{name}#{word}"] = np.array(
+                            [arr[idx[0]] for idx in order], arr.dtype
+                        )
+                    continue
+                # mirror the device lowering error (plan/lower.py
+                # _phys_aggs) instead of a raw KeyError
+                raise ValueError(
+                    f"aggregate {op!r} unsupported on "
+                    f"{in_schema.field(col).ctype.value} column {col!r}"
+                )
             vals = []
             for idx in order:
                 a = np.asarray(t[col])[idx] if col is not None else None
@@ -407,10 +426,46 @@ class LocalDebugInterpreter:
 
     # -- aggregates ----------------------------------------------------------
     def _n_aggregate(self, node: Node) -> Table:
+        from dryad_tpu.columnar.schema import ColumnType, join64, split64
+
         t = self._in(node)
+        in_schema = node.inputs[0].schema
         n = _rows(t)
         out: Table = {}
         for op, col, name in node.params["aggs"]:
+            ctype = in_schema.field(col).ctype if col is not None else None
+            if ctype is ColumnType.FLOAT64 and op in ("sum", "mean"):
+                raise ValueError(
+                    f"aggregate {op!r} unsupported on float64 column "
+                    f"{col!r}: cast to float32"
+                )
+            if col is not None and col not in t and (
+                (ctype is ColumnType.INT64 and op in ("sum", "min", "max"))
+                or (ctype is ColumnType.FLOAT64 and op in ("min", "max"))
+            ):
+                # split 64-bit scalar: numpy-int64 oracle on the word
+                # pairs (ordered image for f64; wrapping sum for i64)
+                full = join64(
+                    np.asarray(t[f"{col}#h0"]), np.asarray(t[f"{col}#h1"]),
+                    signed=True,
+                )
+                if n == 0:
+                    v64 = np.zeros(1, np.int64)
+                else:
+                    with np.errstate(over="ignore"):
+                        v64 = np.array([getattr(full, op)()], np.int64)
+                out[f"{name}#h0"], out[f"{name}#h1"] = split64(v64)
+                continue
+            if col is not None and col not in t and (
+                ctype is not None and ctype.is_split
+            ):
+                # mirror the device engine's lowering error for
+                # unsupported aggregates on split columns (mean/any/all
+                # on int64, etc.) instead of a raw KeyError
+                raise ValueError(
+                    f"aggregate {op!r} unsupported on {ctype.value} "
+                    f"column {col!r}"
+                )
             a = np.asarray(t[col]) if col is not None else None
             if op == "count":
                 out[name] = np.array([n], np.int32)

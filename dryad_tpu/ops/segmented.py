@@ -68,6 +68,40 @@ def _first_scatter(
 PAIR_OPS = ("sum64", "min64", "max64")
 
 
+def _pair_combine(op: str):
+    """The 64-bit word-pair combine for ``op`` — the ONE source of truth
+    for the paired-u32 arithmetic (carry-propagating add for ``sum64``;
+    signed-lexicographic select — high word signed, low word unsigned —
+    for ``min64``/``max64``), shared by the segmented and scalar
+    reducers.  jax x64 stays off: int64/float64 live as two u32 device
+    words (``columnar/schema.py``); the reference's numeric aggregate
+    surface is ``DryadLinqQueryGen.cs:3439ff``."""
+    if op == "sum64":
+        def combine(alo, ahi, blo, bhi):
+            slo = alo + blo  # uint32 wraps mod 2^32
+            carry = (slo < blo).astype(jnp.uint32)
+            return slo, ahi + bhi + carry
+    else:
+        def combine(alo, ahi, blo, bhi):
+            ahs, bhs = ahi.astype(jnp.int32), bhi.astype(jnp.int32)
+            a_less = (ahs < bhs) | ((ahs == bhs) & (alo < blo))
+            take_a = a_less if op == "min64" else ~a_less
+            return (
+                jnp.where(take_a, alo, blo),
+                jnp.where(take_a, ahi, bhi),
+            )
+
+    return combine
+
+
+def _pair_identity(op: str) -> Tuple[jax.Array, jax.Array]:
+    if op == "sum64":
+        return jnp.uint32(0), jnp.uint32(0)
+    if op == "min64":  # +max signed-64 pair
+        return jnp.uint32(0xFFFFFFFF), jnp.uint32(0x7FFFFFFF)
+    return jnp.uint32(0), jnp.uint32(0x80000000)  # max64: min signed-64
+
+
 def _segmented_pair_reduce(
     op: str,
     lo: jax.Array,
@@ -77,42 +111,21 @@ def _segmented_pair_reduce(
     seg: jax.Array,
     cap: int,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Per-segment 64-bit reduce over a split (low, high) uint32 column.
-
-    jax x64 stays off (int64 lives as two u32 device words,
-    ``columnar/schema.py``), so the reduction is a flagged segmented
-    ``associative_scan`` whose combine does the 64-bit arithmetic on
-    word pairs: carry-propagating add for ``sum64``, signed-lexicographic
-    (high word signed, low word unsigned) select for ``min64``/``max64``.
-    The reference's full numeric aggregate surface is
-    ``DryadLinqQueryGen.cs:3439ff``.
-    """
+    """Per-segment 64-bit reduce over a split (low, high) uint32 column:
+    a flagged segmented ``associative_scan`` wrapping
+    :func:`_pair_combine`."""
     flags = start
+    base = _pair_combine(op)
 
-    if op == "sum64":
-        def combine(a, b):
-            fa, alo, ahi = a
-            fb, blo, bhi = b
-            slo = alo + blo  # uint32 wraps mod 2^32
-            carry = (slo < blo).astype(jnp.uint32)
-            shi = ahi + bhi + carry
-            return (
-                fa | fb,
-                jnp.where(fb, blo, slo),
-                jnp.where(fb, bhi, shi),
-            )
-    else:
-        def combine(a, b):
-            fa, alo, ahi = a
-            fb, blo, bhi = b
-            ahs, bhs = ahi.astype(jnp.int32), bhi.astype(jnp.int32)
-            a_less = (ahs < bhs) | ((ahs == bhs) & (alo < blo))
-            take_a = a_less if op == "min64" else ~a_less
-            return (
-                fa | fb,
-                jnp.where(fb, blo, jnp.where(take_a, alo, blo)),
-                jnp.where(fb, bhi, jnp.where(take_a, ahi, bhi)),
-            )
+    def combine(a, b):
+        fa, alo, ahi = a
+        fb, blo, bhi = b
+        mlo, mhi = base(alo, ahi, blo, bhi)
+        return (
+            fa | fb,
+            jnp.where(fb, blo, mlo),
+            jnp.where(fb, bhi, mhi),
+        )
 
     _, slo, shi = jax.lax.associative_scan(combine, (flags, lo, hi))
 
@@ -125,6 +138,28 @@ def _segmented_pair_reduce(
     out_lo = jnp.zeros((cap + 1,), lo.dtype).at[idx].set(slo)[:cap]
     out_hi = jnp.zeros((cap + 1,), hi.dtype).at[idx].set(shi)[:cap]
     return out_lo, out_hi
+
+
+def pair_scalar_reduce(
+    op: str, lo: jax.Array, hi: jax.Array, valid: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """Whole-array 64-bit reduce of a split (low, high) word column to
+    one (lo, hi) scalar pair — :func:`_pair_combine` without segment
+    flags (Sum/Min/Max over int64/float64 columns without x64).
+    Invalid rows are replaced by the op's identity, so an all-invalid
+    input reduces to the identity pair (neutral under further
+    combining), and the scan's last element is the total.
+    """
+    ilo, ihi = _pair_identity(op)
+    lo = jnp.where(valid, lo, ilo)
+    hi = jnp.where(valid, hi, ihi)
+    base = _pair_combine(op)
+
+    def combine(a, b):
+        return base(a[0], a[1], b[0], b[1])
+
+    slo, shi = jax.lax.associative_scan(combine, (lo, hi))
+    return slo[-1], shi[-1]
 
 
 def group_reduce(

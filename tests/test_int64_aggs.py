@@ -169,3 +169,62 @@ def test_float64_survives_select(rng):
     )
     assert out["x"].dtype == np.float64
     np.testing.assert_array_equal(np.sort(out["x"]), np.sort(vals))
+
+
+def test_scalar_aggregates_on_wide_types(rng):
+    """sum_/min_/max_ scalar aggregates over int64 (exact, past 2^32)
+    and float64 (totalOrder min/max), device vs LocalDebug oracle."""
+    n = 3000
+    tbl = {
+        "v": rng.integers(-(2 ** 55), 2 ** 55, n).astype(np.int64),
+        "d": rng.standard_normal(n) * np.exp(rng.uniform(-150, 150, n)),
+    }
+    dev = DryadContext(num_partitions_=8)
+    dbg = DryadContext(local_debug=True)
+    for ctx in (dev, dbg):
+        q = ctx.from_arrays(tbl)
+        assert q.sum_("v") == int(tbl["v"].sum())
+        assert q.min_("v") == int(tbl["v"].min())
+        assert q.max_("v") == int(tbl["v"].max())
+        assert q.min_("d") == tbl["d"].min()
+        assert q.max_("d") == tbl["d"].max()
+
+
+def test_scalar_f64_sum_rejected(rng):
+    ctx = DryadContext(num_partitions_=8)
+    with pytest.raises(ValueError, match="float32"):
+        ctx.from_arrays({"d": np.ones(8, np.float64)}).sum_("d")
+
+
+def test_first_on_split_columns_matches_device(rng):
+    """group_by first over STRING and INT64 columns: device expansion
+    (per-word AggSpecs) vs the oracle's per-word first."""
+    vocab = np.array(["aa", "bb", "cc", "dd"], object)
+    n = 400
+    tbl = {
+        "k": rng.integers(0, 5, n).astype(np.int32),
+        "s": vocab[rng.integers(0, 4, n)],
+        "w": rng.integers(-(2 ** 40), 2 ** 40, n).astype(np.int64),
+    }
+    aggs = {"fs": ("first", "s"), "fw": ("first", "w")}
+    dev = DryadContext(num_partitions_=8)
+    out = dev.from_arrays(tbl).group_by("k", aggs).order_by(["k"]).collect()
+    dbg = DryadContext(local_debug=True)
+    ref = dbg.from_arrays(tbl).group_by("k", aggs).order_by(["k"]).collect()
+    assert out["k"].tolist() == ref["k"].tolist()
+    # first is position-dependent and engines enumerate rows in
+    # different orders, so check TYPE fidelity + membership per group
+    assert out["fw"].dtype == np.int64 and ref["fw"].dtype == np.int64
+    for i, kk in enumerate(out["k"]):
+        members_w = set(tbl["w"][tbl["k"] == kk].tolist())
+        members_s = set(tbl["s"][tbl["k"] == kk].tolist())
+        assert int(out["fw"][i]) in members_w and int(ref["fw"][i]) in members_w
+        assert out["fs"][i] in members_s and ref["fs"][i] in members_s
+
+
+def test_unsupported_split_aggs_raise_in_both_engines():
+    tbl = {"k": np.zeros(8, np.int32), "w": np.ones(8, np.int64)}
+    for ctx in (DryadContext(num_partitions_=8), DryadContext(local_debug=True)):
+        q = ctx.from_arrays(tbl).group_by("k", {"m": ("mean", "w")})
+        with pytest.raises(ValueError, match="unsupported"):
+            q.collect()
