@@ -158,6 +158,11 @@ class Query:
 
         ``aggs``: out_name -> (op, col) with op in
         sum|count|min|max|mean|first|any|all (col None for count).
+        int64 columns aggregate exactly with 64-bit arithmetic;
+        sum/mean WRAP mod 2^64 when a group's true total exceeds the
+        int64 range (numpy int64 semantics — C# long Average instead
+        throws OverflowException there).  float64 supports
+        min/max/first (totalOrder); cast to float32 for sums.
 
         ``salt=S`` spreads each key over S shuffle destinations
         (partial-reduce on (key, salt), exchange, reduce, then exchange
@@ -204,6 +209,16 @@ class Query:
             if bad:
                 raise ValueError(
                     f"dense group_by supports sum/count/mean, got {bad}"
+                )
+            wide = [
+                c for _o, (_op, c) in aggs.items()
+                if c is not None and self.schema.field(c).ctype.is_split
+            ]
+            if wide:
+                raise ValueError(
+                    f"dense group_by aggregates f32 on the MXU; columns "
+                    f"{wide} are 64-bit/split types — use the default "
+                    f"sort-based path"
                 )
         fields: List[Tuple[str, ColumnType]] = [
             (k, self.schema.field(k).ctype) for k in keys

@@ -717,6 +717,25 @@ def _k_default_if_empty(ctx: StageContext, p) -> None:
     ctx.slots[p["slot"]] = ColumnBatch(data, valid)
 
 
+def _global_pair_reduce(
+    ctx: StageContext, op: str, b: ColumnBatch, lo_col: str, v: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """Mesh-wide 64-bit word-pair reduce: per-partition pair reduce,
+    all_gather the P partial pairs (psum can't carry 64 bits), reduce
+    the gathered pairs the same way.  All-invalid partitions contribute
+    the op identity (neutral), so the gathered reduce needs no
+    validity."""
+    hi_col = lo_col[: -len("#h0")] + "#h1"
+    plo, phi = SEG.pair_scalar_reduce(
+        op, b.data[lo_col], b.data[hi_col], v
+    )
+    glo = jax.lax.all_gather(plo[None], ctx.axes, tiled=True)
+    ghi = jax.lax.all_gather(phi[None], ctx.axes, tiled=True)
+    return SEG.pair_scalar_reduce(
+        op, glo, ghi, jnp.ones(glo.shape, jnp.bool_)
+    )
+
+
 def _k_scalar_agg(ctx: StageContext, p) -> None:
     b = ctx.slots[p["slot"]]
     v = b.valid
@@ -744,22 +763,16 @@ def _k_scalar_agg(ctx: StageContext, p) -> None:
             s = jax.lax.psum(jnp.sum(jnp.where(v, col, 0.0)), ctx.axes)
             c = jax.lax.psum(jnp.sum(v.astype(jnp.float32)), ctx.axes)
             out[a.out] = (s / jnp.maximum(c, 1.0))[None]
+        elif a.op == "mean64":
+            # Average over long: exact global sum64, f32 divide
+            tlo, thi = _global_pair_reduce(ctx, "sum64", b, a.col, v)
+            c = jax.lax.psum(jnp.sum(v.astype(jnp.float32)), ctx.axes)
+            out[a.out] = (
+                SEG.pair_to_f32(tlo, thi) / jnp.maximum(c, 1.0)
+            )[None]
         elif a.op in SEG.PAIR_OPS:
-            # 64-bit scalar over a split column: per-partition pair
-            # reduce, all_gather the P partial pairs (psum can't carry
-            # 64-bit), reduce the gathered pairs the same way.
-            lo_col = a.col
-            hi_col = lo_col[: -len("#h0")] + "#h1"
-            plo, phi = SEG.pair_scalar_reduce(
-                a.op, b.data[lo_col], b.data[hi_col], v
-            )
-            glo = jax.lax.all_gather(plo[None], ctx.axes, tiled=True)
-            ghi = jax.lax.all_gather(phi[None], ctx.axes, tiled=True)
-            # all-invalid partitions already contributed the identity
-            # pair (neutral), so the gathered reduce needs no validity
-            tlo, thi = SEG.pair_scalar_reduce(
-                a.op, glo, ghi, jnp.ones(glo.shape, jnp.bool_)
-            )
+            # 64-bit scalar over a split column
+            tlo, thi = _global_pair_reduce(ctx, a.op, b, a.col, v)
             out[f"{a.out}#h0"] = tlo[None]
             out[f"{a.out}#h1"] = thi[None]
         elif a.op == "any":

@@ -41,6 +41,15 @@ def _call(fn: Callable, cols: Table) -> Dict[str, np.ndarray]:
     return {k: np.asarray(v) for k, v in out.items()}
 
 
+def _join_split_col(t: Table, col: str) -> np.ndarray:
+    """Signed-int64 view of a split (#h0/#h1) column's word pairs."""
+    from dryad_tpu.columnar.schema import join64
+
+    return join64(
+        np.asarray(t[f"{col}#h0"]), np.asarray(t[f"{col}#h1"]), signed=True
+    )
+
+
 def _key_tuples(t: Table, cols: List[str]) -> List[tuple]:
     arrs = [np.asarray(t[c]) for c in cols]
     return list(zip(*[a.tolist() for a in arrs])) if arrs else [()] * _rows(t)
@@ -218,15 +227,22 @@ class LocalDebugInterpreter:
             ):
                 # split 64-bit column: independent numpy-int64 oracle for
                 # the engine's paired-word arithmetic (wrapping sum)
-                full = join64(
-                    np.asarray(t[f"{col}#h0"]), np.asarray(t[f"{col}#h1"]),
-                    signed=True,
-                )
+                full = _join_split_col(t, col)
                 with np.errstate(over="ignore"):
                     vals64 = np.array(
                         [getattr(full[idx], op)() for idx in order], np.int64
                     )
                 out[f"{name}#h0"], out[f"{name}#h1"] = split64(vals64)
+                continue
+            if (
+                col is not None and col not in t
+                and ctype is ColumnType.INT64 and op == "mean"
+            ):
+                full = _join_split_col(t, col)
+                out[name] = np.array(
+                    [full[idx].astype(np.float64).mean() for idx in order],
+                    np.float32,
+                )
                 continue
             if col is not None and col not in t and (
                 in_schema.field(col).ctype.is_split
@@ -444,17 +460,29 @@ class LocalDebugInterpreter:
                 or (ctype is ColumnType.FLOAT64 and op in ("min", "max"))
             ):
                 # split 64-bit scalar: numpy-int64 oracle on the word
-                # pairs (ordered image for f64; wrapping sum for i64)
-                full = join64(
-                    np.asarray(t[f"{col}#h0"]), np.asarray(t[f"{col}#h1"]),
-                    signed=True,
-                )
+                # pairs (ordered image for f64; wrapping sum for i64).
+                # Empty input yields the op IDENTITY, matching the
+                # device engine's pair-identity semantics.
+                full = _join_split_col(t, col)
                 if n == 0:
-                    v64 = np.zeros(1, np.int64)
+                    ident = {
+                        "sum": 0,
+                        "min": np.iinfo(np.int64).max,
+                        "max": np.iinfo(np.int64).min,
+                    }[op]
+                    v64 = np.array([ident], np.int64)
                 else:
                     with np.errstate(over="ignore"):
                         v64 = np.array([getattr(full, op)()], np.int64)
                 out[f"{name}#h0"], out[f"{name}#h1"] = split64(v64)
+                continue
+            if (
+                col is not None and col not in t
+                and ctype is ColumnType.INT64 and op == "mean"
+            ):
+                full = _join_split_col(t, col)
+                val = full.astype(np.float64).mean() if n else 0.0
+                out[name] = np.array([val], np.float32)
                 continue
             if col is not None and col not in t and (
                 ctype is not None and ctype.is_split

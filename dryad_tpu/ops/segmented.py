@@ -140,6 +140,16 @@ def _segmented_pair_reduce(
     return out_lo, out_hi
 
 
+def pair_to_f32(lo: jax.Array, hi: jax.Array) -> jax.Array:
+    """Approximate f32 value of a split signed-64 word pair
+    (hi signed * 2^32 + lo unsigned) — the ONE decode used by every
+    mean64 finalize."""
+    return (
+        hi.astype(jnp.int32).astype(jnp.float32) * jnp.float32(4294967296.0)
+        + lo.astype(jnp.float32)
+    )
+
+
 def pair_scalar_reduce(
     op: str, lo: jax.Array, hi: jax.Array, valid: jax.Array
 ) -> Tuple[jax.Array, jax.Array]:

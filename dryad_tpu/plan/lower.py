@@ -331,6 +331,11 @@ class _Builder:
                     # aggregate surface is DryadLinqQueryGen.cs:3439ff)
                     out.append(AggSpec(f"{op}64", f"{col}#h0", name))
                     continue
+                if f.ctype is ColumnType.INT64 and op == "mean":
+                    # Average over long: exact sum64 + count partials,
+                    # f32 divide at finalize
+                    out.append(AggSpec("mean64", f"{col}#h0", name))
+                    continue
                 if f.ctype is ColumnType.FLOAT64:
                     if op in ("min", "max"):
                         # the stored words are the order-preserving
@@ -672,6 +677,11 @@ def _decompose_aggs(aggs):
             # partial writes out#h0/out#h1; final re-reduces that pair
             partial.append(AggSpec(a.op, a.col, a.out))
             final.append(AggSpec(a.op, f"{a.out}#h0", a.out))
+        elif a.op == "mean64":
+            partial.append(AggSpec("sum64", a.col, f"{a.out}#s"))
+            partial.append(AggSpec("count", None, f"{a.out}#c"))
+            final.append(AggSpec("sum64", f"{a.out}#s#h0", f"{a.out}#s"))
+            final.append(AggSpec("sum", f"{a.out}#c", f"{a.out}#c"))
         elif a.op == "mean":
             partial.append(AggSpec("sum", a.col, f"{a.out}#s"))
             partial.append(AggSpec("count", None, f"{a.out}#c"))
@@ -705,17 +715,23 @@ class _AddSalt:
 
 
 class _FinalizeMeans:
-    """Post-shuffle mean finalize (sum/count -> mean); VALUE-equal so
-    re-lowering doesn't bust the compiled-stage cache."""
+    """Post-shuffle mean finalize (sum/count -> mean; 64-bit sums
+    decode their word pair to f32 first); VALUE-equal so re-lowering
+    doesn't bust the compiled-stage cache."""
 
-    def __init__(self, outs):
+    def __init__(self, outs, outs64=()):
         self.outs = tuple(outs)
+        self.outs64 = tuple(outs64)
 
     def __eq__(self, other) -> bool:
-        return type(other) is _FinalizeMeans and other.outs == self.outs
+        return (
+            type(other) is _FinalizeMeans
+            and other.outs == self.outs
+            and other.outs64 == self.outs64
+        )
 
     def __hash__(self) -> int:
-        return hash(("_FinalizeMeans", self.outs))
+        return hash(("_FinalizeMeans", self.outs, self.outs64))
 
     def __call__(self, cols):
         import jax.numpy as jnp
@@ -725,15 +741,23 @@ class _FinalizeMeans:
             s = out.pop(f"{name}#s").astype(jnp.float32)
             c = out.pop(f"{name}#c").astype(jnp.float32)
             out[name] = s / jnp.maximum(c, 1.0)
+        for name in self.outs64:
+            from dryad_tpu.ops.segmented import pair_to_f32
+
+            lo = out.pop(f"{name}#s#h0")
+            hi = out.pop(f"{name}#s#h1")
+            c = out.pop(f"{name}#c").astype(jnp.float32)
+            out[name] = pair_to_f32(lo, hi) / jnp.maximum(c, 1.0)
         return out
 
 
 def _finalize_fn(aggs):
     """Post-shuffle finalize for aggs whose partials differ (mean)."""
     means = [a.out for a in aggs if a.op == "mean"]
-    if not means:
+    means64 = [a.out for a in aggs if a.op == "mean64"]
+    if not means and not means64:
         return None
-    return _FinalizeMeans(means)
+    return _FinalizeMeans(means, means64)
 
 
 def _rewrite_topk(roots: Sequence[Node], limit: int) -> List[Node]:

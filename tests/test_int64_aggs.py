@@ -223,8 +223,57 @@ def test_first_on_split_columns_matches_device(rng):
 
 
 def test_unsupported_split_aggs_raise_in_both_engines():
-    tbl = {"k": np.zeros(8, np.int32), "w": np.ones(8, np.int64)}
+    tbl = {
+        "k": np.zeros(8, np.int32),
+        "w": np.ones(8, np.int64),
+        "s": np.array(["x"] * 8, object),
+    }
     for ctx in (DryadContext(num_partitions_=8), DryadContext(local_debug=True)):
-        q = ctx.from_arrays(tbl).group_by("k", {"m": ("mean", "w")})
+        q = ctx.from_arrays(tbl).group_by("k", {"a": ("any", "w")})
         with pytest.raises(ValueError, match="unsupported"):
             q.collect()
+        q2 = ctx.from_arrays(tbl).group_by("k", {"ss": ("sum", "s")})
+        with pytest.raises(ValueError, match="unsupported"):
+            q2.collect()
+
+
+def test_int64_mean_group_and_scalar(rng):
+    """Average over long (reference numeric overloads): exact sum64 +
+    count partials, f32 divide — group and scalar forms, both engines."""
+    n = 2000
+    tbl = {
+        "k": rng.integers(0, 6, n).astype(np.int32),
+        "v": rng.integers(-(2 ** 45), 2 ** 45, n).astype(np.int64),
+    }
+    out = _run_group_by(tbl, {"m": ("mean", "v")}, ["k"])
+    ref = _oracle(tbl, {"m": ("mean", "v")}, ["k"])
+    assert out["k"].tolist() == ref["k"].tolist()
+    np.testing.assert_allclose(out["m"], ref["m"], rtol=1e-5)
+    for i, kk in enumerate(out["k"]):
+        expect = tbl["v"][tbl["k"] == kk].astype(np.float64).mean()
+        np.testing.assert_allclose(out["m"][i], expect, rtol=1e-5)
+
+    dev = DryadContext(num_partitions_=8)
+    got = dev.from_arrays(tbl).mean("v")
+    np.testing.assert_allclose(
+        got, tbl["v"].astype(np.float64).mean(), rtol=1e-5
+    )
+
+
+def test_empty_minmax_identity_matches_across_engines():
+    """Empty-input 64-bit min/max via aggregate_as_query yields the op
+    identity in BOTH engines (device pair-identity semantics)."""
+    tbl = {"v": np.zeros(0, np.int64)}
+    for ctx in (DryadContext(num_partitions_=8), DryadContext(local_debug=True)):
+        out = ctx.from_arrays(tbl).aggregate_as_query(
+            {"lo": ("min", "v"), "hi": ("max", "v")}
+        ).collect()
+        assert out["lo"][0] == np.iinfo(np.int64).max
+        assert out["hi"][0] == np.iinfo(np.int64).min
+
+
+def test_dense_group_by_rejects_wide_columns():
+    tbl = {"k": np.zeros(8, np.int32), "w": np.ones(8, np.int64)}
+    ctx = DryadContext(num_partitions_=8)
+    with pytest.raises(ValueError, match="sort-based"):
+        ctx.from_arrays(tbl).group_by("k", {"m": ("mean", "w")}, dense=4)
