@@ -239,10 +239,15 @@ class LocalDebugInterpreter:
                 and ctype is ColumnType.INT64 and op == "mean"
             ):
                 full = _join_split_col(t, col)
-                out[name] = np.array(
-                    [full[idx].astype(np.float64).mean() for idx in order],
-                    np.float32,
-                )
+                # mirror the engine: WRAPPING int64 sum (mod 2^64, the
+                # documented contract) then f32 divide — a true-f64 mean
+                # here would diverge from the device on overflow
+                with np.errstate(over="ignore"):
+                    out[name] = np.array(
+                        [np.float64(full[idx].sum()) / len(idx)
+                         for idx in order],
+                        np.float32,
+                    )
                 continue
             if col is not None and col not in t and (
                 in_schema.field(col).ctype.is_split
@@ -481,7 +486,8 @@ class LocalDebugInterpreter:
                 and ctype is ColumnType.INT64 and op == "mean"
             ):
                 full = _join_split_col(t, col)
-                val = full.astype(np.float64).mean() if n else 0.0
+                with np.errstate(over="ignore"):  # wrapping, as device
+                    val = np.float64(full.sum()) / n if n else 0.0
                 out[name] = np.array([val], np.float32)
                 continue
             if col is not None and col not in t and (

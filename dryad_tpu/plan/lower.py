@@ -736,14 +736,14 @@ class _FinalizeMeans:
     def __call__(self, cols):
         import jax.numpy as jnp
 
+        from dryad_tpu.ops.segmented import pair_to_f32
+
         out = dict(cols)
         for name in self.outs:
             s = out.pop(f"{name}#s").astype(jnp.float32)
             c = out.pop(f"{name}#c").astype(jnp.float32)
             out[name] = s / jnp.maximum(c, 1.0)
         for name in self.outs64:
-            from dryad_tpu.ops.segmented import pair_to_f32
-
             lo = out.pop(f"{name}#s#h0")
             hi = out.pop(f"{name}#s#h1")
             c = out.pop(f"{name}#c").astype(jnp.float32)
