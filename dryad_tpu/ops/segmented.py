@@ -9,6 +9,14 @@ The machine→pod→overall tree becomes: per-chip partial reduce (this
 module, pre-shuffle) + post-shuffle final reduce — the
 Seed/Accumulate/RecursiveAccumulate/FinalReduce decomposition of
 ``LinqToDryad/IDecomposable.cs:35-71``.
+
+Kernel-strategy note (BASELINE.md roofline; ``probe_perf.py``): the
+sort is the dominant cost here — a raw scatter-add (``segment_sum`` on
+unsorted keys) measures ~100x faster on CPU, but XLA:TPU scatters have
+historically serialized, so switching the general path (or adding an
+auto dense/scatter selection for bounded int keys) awaits the on-chip
+probe numbers.  The bounded-key fast path already exists:
+``group_by(dense=K)`` (``ops/pallas_bucket.py``).
 """
 
 from __future__ import annotations
