@@ -416,6 +416,7 @@ class LocalJobSubmission:
             "result_dir": result_rel, "seq": seq, "cseq": self._next_cseq(),
         }
         t_run0 = time.monotonic()
+        self.events.emit("gang_run_start", seq=seq, workers=self.n)
         procs = []
         for i in range(self.n):
             p = ClusterProcess(
@@ -450,6 +451,9 @@ class LocalJobSubmission:
                 threshold=round(st.outlier_threshold(), 3),
             )
         st.record(dt)
+        self.events.emit(
+            "gang_run_complete", seq=seq, seconds=round(dt, 3)
+        )
 
         part_ids = sorted(
             {g for p in procs for g in p.result.get("parts", [])}

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import dataclasses
 import sys
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from dryad_tpu.exec.events import EventLog
 
@@ -386,12 +386,61 @@ color:#fff;background:{color};font-weight:600}}
 </body></html>"""
 
 
+def build_gang_runs(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Per-seq model of gang SPMD submissions: one entry per run, with
+    completion and straggler status folded together (localjob emits
+    gang_straggler AND gang_run_complete for an outlier run)."""
+    runs: Dict[Any, Dict[str, Any]] = {}
+    for ev in events:
+        seq = ev.get("seq")
+        if ev["kind"] == "gang_run_start":
+            runs[seq] = {"seq": seq, "completed": False, "straggler": None}
+        elif ev["kind"] == "gang_run_complete":
+            r = runs.setdefault(
+                seq, {"seq": seq, "completed": False, "straggler": None}
+            )
+            r["completed"] = True
+            r["seconds"] = ev.get("seconds", 0.0)
+        elif ev["kind"] == "gang_straggler":
+            r = runs.setdefault(
+                seq, {"seq": seq, "completed": False, "straggler": None}
+            )
+            r["straggler"] = ev.get("threshold", 0.0)
+    return list(runs.values())
+
+
+def _render_gang_run(r: Dict[str, Any]) -> str:
+    if not r["completed"]:
+        # started but never completed: the submit raised mid-run
+        return f"gang run r{r['seq']}: FAILED/INCOMPLETE"
+    line = f"gang run r{r['seq']}: OK  {r.get('seconds', 0.0):.3f}s"
+    if r["straggler"] is not None:
+        line += f"  (STRAGGLER: threshold {r['straggler']:.3f}s)"
+    return line
+
+
+def fold_submission(
+    events: List[Dict[str, Any]],
+) -> Tuple[str, bool]:
+    """(rendered text, ok) for a LocalJobSubmission event stream —
+    ONE fold shared by rendering and the exit code."""
+    gang = build_gang_runs(events)
+    vjobs = build_vertex_jobs(events)
+    parts = []
+    if gang:
+        parts.append("\n".join(_render_gang_run(r) for r in gang))
+    parts.extend(render_vertex_job(vj) for vj in vjobs)
+    ok = all(r["completed"] for r in gang) and all(
+        vj.completed for vj in vjobs
+    )
+    return "\n\n".join(parts), ok
+
+
 def _render_stream(events: List[Dict[str, Any]]) -> str:
     """Render whichever job model the stream holds."""
-    if any(e["kind"] == "vertex_job_start" for e in events):
-        return "\n\n".join(
-            render_vertex_job(vj) for vj in build_vertex_jobs(events)
-        )
+    kinds = {e["kind"] for e in events}
+    if kinds & {"vertex_job_start", "gang_run_start"}:
+        return fold_submission(events)[0]
     return render(build_job(events))
 
 
@@ -468,21 +517,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         follow(argv[0])
         return 0
     events = EventLog.load(argv[0])
-    if any(e["kind"] == "vertex_job_start" for e in events):
-        vjobs = build_vertex_jobs(events)
-        text = "\n\n".join(render_vertex_job(vj) for vj in vjobs)
+    if {e["kind"] for e in events} & {"vertex_job_start", "gang_run_start"}:
+        text, ok = fold_submission(events)
         if html_out:
             import html as H
 
             with open(html_out, "w") as fh:
                 fh.write(
                     "<!doctype html><html><head><meta charset='utf-8'>"
-                    "<title>dryad_tpu vertex jobs</title></head><body>"
+                    "<title>dryad_tpu submission log</title></head><body>"
                     f"<pre>{H.escape(text)}</pre></body></html>"
                 )
             print(f"wrote {html_out}")
         print(text)
-        return 0 if all(vj.completed for vj in vjobs) else 1
+        return 0 if ok else 1
     job = build_job(events)
     if html_out:
         with open(html_out, "w") as fh:

@@ -267,3 +267,34 @@ def test_jobview_tolerant_load(tmp_path):
     )
     events = _load_tolerant(str(p))
     assert len(events) == 1 and events[0]["kind"] == "job_start"
+
+
+def test_submission_log_renders_gang_and_vertex_jobs():
+    """A mixed submission log (gang runs + vertex jobs) renders both."""
+    from dryad_tpu.tools.jobview import _render_stream
+
+    events = [
+        {"ts": 1, "kind": "worker_joined", "worker": 0},
+        {"ts": 2, "kind": "gang_run_start", "seq": 1, "workers": 2},
+        {"ts": 3, "kind": "gang_run_complete", "seq": 1, "seconds": 1.25},
+        {"ts": 4, "kind": "vertex_job_start", "seq": 2, "nparts": 1},
+        {"ts": 5, "kind": "vertex_complete", "part": 0, "seconds": 0.2,
+         "computer": "worker0"},
+        {"ts": 6, "kind": "vertex_job_complete", "seq": 2},
+        # the REAL straggler emit pattern: straggler + complete, same seq
+        {"ts": 7, "kind": "gang_run_start", "seq": 3, "workers": 2},
+        {"ts": 8, "kind": "gang_straggler", "seq": 3, "seconds": 9.0,
+         "threshold": 2.0},
+        {"ts": 9, "kind": "gang_run_complete", "seq": 3, "seconds": 9.0},
+        # started but never completed (submit raised)
+        {"ts": 10, "kind": "gang_run_start", "seq": 4, "workers": 2},
+    ]
+    from dryad_tpu.tools.jobview import fold_submission
+
+    text, ok = fold_submission(events)
+    assert "gang run r1: OK" in text
+    assert text.count("gang run r3") == 1  # ONE line, folded status
+    assert "STRAGGLER" in text
+    assert "gang run r4: FAILED/INCOMPLETE" in text
+    assert "vertex job r2: OK" in text
+    assert not ok  # run 4 crashed -> nonzero exit
