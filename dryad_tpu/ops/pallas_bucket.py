@@ -4,16 +4,34 @@ The fast path for GroupBy over *dense integer* keys (key in [0, K) with
 K known at trace time — categorical codes, dictionary ranks): instead of
 the general sort + segmented-reduce + shuffle pipeline
 (``ops/segmented.py``, the TPU analog of the reference's GroupBy
-machinery), each row block is one-hot encoded and reduced as a matmul on
-the MXU, accumulating per-bucket sums/counts in a VMEM-resident
-accumulator across the row-block grid.  Cross-partition combination is
-then a single ``psum_scatter`` — the aggregation *tree* of the reference
+machinery), the bucket histogram is computed as a **factorized one-hot
+matmul**.  Split each key into ``hi = k // 128`` and ``lo = k % 128``;
+then for every value column
+
+    acc[hi, lo] += v   ==   acc += one_hot(hi)^T @ (one_hot(lo) * v)
+
+which is a real (rows x A) @ (rows x 128) MXU contraction.  The VPU
+builds only ``A + 128`` one-hot lanes per row (vs K for a direct
+one-hot), the one-hot factors live in VMEM for the lifetime of a row
+block, and the (A, 128) accumulator IS the bucket table — reshaped to
+(K,) at the end.  Cross-partition combination is then a single
+``psum_scatter`` — the aggregation *tree* of the reference
 (``DrDynamicAggregateManager.h:35-168``) becomes one XLA collective and
 the shuffle disappears entirely.
 
+Block shapes obey the Mosaic tiling rule (last two dims divisible by
+(8, 128) or equal to the array): rows are fed as (1, R) lane vectors
+with R a multiple of 128 (rows ride the lane dim, so the one-hot
+factors are generated directly in contraction orientation), and
+accumulators are (A, 128) with A a multiple of 8.  The round-2 kernel
+used (1, block) row blocks against a (nb, block) array, which fails
+the sublane rule and would not lower on a real chip.
+
 The kernel runs under Pallas on TPU (or in interpret mode, used on CPU
 in tests); elsewhere ``bucket_sum_count`` falls back to a pure-XLA scan
-of one-hot matmuls with identical semantics.
+over row chunks of the identical factorized math — which also keeps the
+fallback HBM traffic at ~(A+256)·4 bytes/row instead of the 4·K
+bytes/row a materialized one-hot pays.
 """
 
 from __future__ import annotations
@@ -29,18 +47,52 @@ except Exception:  # pragma: no cover - pallas always present in-tree
     pl = None
 
 DEFAULT_BLOCK = 1024
+_LO = 128  # lane factor: lo = key % _LO indexes the accumulator lanes
+_LO_SHIFT = 7  # hi = key >> _LO_SHIFT
+assert 1 << _LO_SHIFT == _LO
+# VMEM working-set budget per grid step (bytes); v5e VMEM ~16MB/core,
+# and the step's live set is the transposed one-hot factors — (128, R)
+# lo plane, one (128, R) rhs plane per value column, an (A, R) hi
+# plane — plus the resident (A, 128) accumulators.  Budget under half
+# of VMEM to leave room for double buffering and dot scratch.
+_VMEM_BUDGET = 6 * 1024 * 1024
 
 
-def _pad_rows(n: int, block: int) -> int:
-    return ((n + block - 1) // block) * block
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
 
 
-def _pad_buckets(k: int) -> int:
-    return max(128, ((k + 127) // 128) * 128)
+def _hi_width(num_buckets: int) -> int:
+    """Sublane extent A of the accumulator: ceil(K/128), padded to 8."""
+    return _round_up(max(1, -(-num_buckets // _LO)), 8)
 
 
-def _make_kernel(n_vals: int, K: int):
-    """Kernel over refs (k, mask, v_0..v_{n-1}, cnt, sum_0..sum_{n-1})."""
+def _row_block(a_pad: int, n_vals: int = 1) -> Optional[int]:
+    """Rows per grid step, multiple of 128 (rows ride the lane dim),
+    sized to the VMEM budget: per-row cost is the hi one-hot plus the
+    lo one-hot plus one rhs plane per value column; the (A, 128)
+    accumulators are resident off the top.  None when the accumulators
+    alone blow the budget (huge num_buckets) — callers must use the
+    XLA fallback, which has no VMEM ceiling."""
+    acc_bytes = a_pad * _LO * 4 * (1 + n_vals)
+    left = _VMEM_BUDGET - acc_bytes
+    if left <= 0:
+        return None
+    r = left // (4 * (a_pad + (1 + n_vals) * _LO + 4))
+    if r < 128:
+        return None
+    return min(8192, (r // 128) * 128)
+
+
+def _make_kernel(n_vals: int, a_pad: int):
+    """Kernel over refs (k, mask, v_0..v_{n-1}, cnt, sum_0..sum_{n-1}).
+
+    Row refs are (1, R) lane vectors; accumulators are (A, 128) tables
+    addressed as [hi, lo].  Both one-hot factors are generated directly
+    in contraction orientation — (A, R) and (128, R), rows on lanes —
+    so the dots are plain NT matmuls with no data-dependent transposes
+    (a dim-0 contraction here costs a Mosaic relayout of the whole
+    one-hot; measured 2x slower end-to-end)."""
 
     def kernel(*refs):
         k_ref, m_ref = refs[0], refs[1]
@@ -49,29 +101,39 @@ def _make_kernel(n_vals: int, K: int):
         sum_refs = refs[3 + n_vals :]
 
         i = pl.program_id(0)
-        kb = k_ref[0, :]  # (B,) int32
-        mb = m_ref[0, :]  # (B,) bool
-        B = kb.shape[0]
-        iota = jax.lax.broadcasted_iota(jnp.int32, (B, K), 1)
-        oh = ((kb[:, None] == iota) & mb[:, None]).astype(jnp.float32)
+        kb = k_ref[...]  # (1, R) int32
+        mb = m_ref[...]  # (1, R) bool
+        R = kb.shape[1]
+
+        lo_iota = jax.lax.broadcasted_iota(jnp.int32, (_LO, R), 0)
+        # mask folded into the lo factor zeroes invalid rows out of both
+        # the counts and every sum in one place.
+        oh_lo = (((kb & (_LO - 1)) == lo_iota) & mb).astype(jnp.float32)
+        hi_iota = jax.lax.broadcasted_iota(jnp.int32, (a_pad, R), 0)
+        oh_hi = ((kb >> _LO_SHIFT) == hi_iota).astype(jnp.float32)
 
         @pl.when(i == 0)
         def _init():
-            cnt_ref[:] = jnp.zeros((K,), jnp.float32)
+            cnt_ref[...] = jnp.zeros((a_pad, _LO), jnp.float32)
             for s in sum_refs:
-                s[:] = jnp.zeros((K,), jnp.float32)
+                s[...] = jnp.zeros((a_pad, _LO), jnp.float32)
 
-        ones = jnp.ones((B,), jnp.float32)
-        # (B,) . (B, K) -> (K,) rides the MXU.
-        cnt_ref[:] += jax.lax.dot_general(
-            ones, oh, (((0,), (0,)), ((), ())),
+        contract_lanes = (((1,), (1,)), ((), ()))
+        # (A, R) . (128, R)^T -> (A, 128) rides the MXU.  Counts run at
+        # default (bf16) MXU precision — 0/1 products are exact there.
+        # Value sums use HIGHEST: the default would round each v to
+        # bf16 (~4e-3 relative error); HIGHEST keeps f32-equivalent
+        # products at ~3x the matmul passes, still MXU-bound.
+        cnt_ref[...] += jax.lax.dot_general(
+            oh_hi, oh_lo, contract_lanes,
             preferred_element_type=jnp.float32,
         )
         for v_ref, s_ref in zip(v_refs, sum_refs):
-            vb = v_ref[0, :].astype(jnp.float32)
-            s_ref[:] += jax.lax.dot_general(
-                vb, oh, (((0,), (0,)), ((), ())),
+            rhs = oh_lo * v_ref[...].astype(jnp.float32)  # (1,R) bcast
+            s_ref[...] += jax.lax.dot_general(
+                oh_hi, rhs, contract_lanes,
                 preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
             )
 
     return kernel
@@ -103,59 +165,84 @@ def bucket_sum_count(
     ``([sum per value col], counts)``, each of shape (num_buckets,) f32.
     ``interpret``: force Pallas interpret mode (CPU testing); default
     picks the Pallas kernel on TPU and the XLA fallback elsewhere.
+    ``block`` caps the rows-per-step of the XLA fallback's scan.
     """
     n = keys.shape[0]
-    K = _pad_buckets(num_buckets)
-    npad = _pad_rows(max(n, block), block)
-    if npad != n:
-        pad = npad - n
-        keys = jnp.concatenate([keys, jnp.zeros((pad,), keys.dtype)])
-        valid = jnp.concatenate([valid, jnp.zeros((pad,), jnp.bool_)])
-        values = [
-            jnp.concatenate([v, jnp.zeros((pad,), v.dtype)]) for v in values
-        ]
-    keys = jnp.clip(jnp.where(valid, keys, 0).astype(jnp.int32), 0, K - 1)
-    nb = npad // block
-    k2 = keys.reshape(nb, block)
-    m2 = valid.reshape(nb, block)
-    v2 = [v.reshape(nb, block) for v in values]
+    a_pad = _hi_width(num_buckets)
+    k_full = a_pad * _LO  # accumulator capacity >= num_buckets
+    keys = jnp.clip(
+        jnp.where(valid, keys, 0).astype(jnp.int32), 0, k_full - 1
+    )
 
-    use_pallas = pl is not None and (
+    def pad_to(npad):
+        nonlocal keys, valid, values
+        if npad != n:
+            pad = npad - n
+            keys = jnp.concatenate([keys, jnp.zeros((pad,), keys.dtype)])
+            valid = jnp.concatenate([valid, jnp.zeros((pad,), jnp.bool_)])
+            values = [
+                jnp.concatenate([v, jnp.zeros((pad,), v.dtype)])
+                for v in values
+            ]
+
+    R = _row_block(a_pad, len(values))
+    use_pallas = pl is not None and R is not None and (
         interpret is True or (interpret is None and _on_tpu())
     )
     if use_pallas:
-        row_spec = pl.BlockSpec((1, block), lambda i: (i, 0))
-        out_spec = pl.BlockSpec((K,), lambda i: (0,))
+        npad = _round_up(max(n, R), R)
+        pad_to(npad)
+        row = lambda x: x.reshape(1, npad)
+        row_spec = pl.BlockSpec((1, R), lambda i: (0, i))
+        out_spec = pl.BlockSpec((a_pad, _LO), lambda i: (0, 0))
         outs = pl.pallas_call(
-            _make_kernel(len(values), K),
-            grid=(nb,),
+            _make_kernel(len(values), a_pad),
+            grid=(npad // R,),
             in_specs=[row_spec] * (2 + len(values)),
             out_specs=[out_spec] * (1 + len(values)),
-            out_shape=[jax.ShapeDtypeStruct((K,), jnp.float32)]
+            out_shape=[jax.ShapeDtypeStruct((a_pad, _LO), jnp.float32)]
             * (1 + len(values)),
             interpret=bool(interpret),
-        )(k2, m2, *v2)
+        )(row(keys), row(valid), *[row(v) for v in values])
         cnt, sums = outs[0], list(outs[1:])
     else:
-        # Pure-XLA fallback: scan of one-hot matmuls (same math).
+        # Pure-XLA fallback: scan over row chunks of the same
+        # factorized math (identical semantics).
+        chunk = max(8, min(32768, _round_up(block, 8)))
+        npad = _round_up(max(n, chunk), chunk)
+        pad_to(npad)
+        nb = npad // chunk
+        k2 = keys.reshape(nb, chunk)
+        m2 = valid.reshape(nb, chunk)
+        v2 = [v.reshape(nb, chunk) for v in values]
+        lo_iota = jnp.arange(_LO, dtype=jnp.int32)[None, :]
+        hi_iota = jnp.arange(a_pad, dtype=jnp.int32)[None, :]
+
         def body(acc, xs):
             kb, mb, *vbs = xs
-            oh = (
-                (kb[:, None] == jnp.arange(K, dtype=jnp.int32)[None, :])
-                & mb[:, None]
+            oh_lo = (
+                ((kb[:, None] & (_LO - 1)) == lo_iota) & mb[:, None]
+            ).astype(jnp.float32)
+            oh_hi = (
+                (kb[:, None] >> _LO_SHIFT) == hi_iota
             ).astype(jnp.float32)
             cnt_a, sums_a = acc
-            cnt_a = cnt_a + oh.sum(axis=0)
+            cnt_a = cnt_a + oh_hi.T @ oh_lo
             sums_a = [
-                s + vb.astype(jnp.float32) @ oh
+                s + jnp.matmul(
+                    oh_hi.T,
+                    oh_lo * vb[:, None].astype(jnp.float32),
+                    precision=jax.lax.Precision.HIGHEST,
+                )
                 for s, vb in zip(sums_a, vbs)
             ]
             return (cnt_a, sums_a), None
 
         init = (
-            jnp.zeros((K,), jnp.float32),
-            [jnp.zeros((K,), jnp.float32) for _ in values],
+            jnp.zeros((a_pad, _LO), jnp.float32),
+            [jnp.zeros((a_pad, _LO), jnp.float32) for _ in values],
         )
         (cnt, sums), _ = jax.lax.scan(body, init, (k2, m2, *v2))
 
-    return [s[:num_buckets] for s in sums], cnt[:num_buckets]
+    flat = lambda t: t.reshape(k_full)[:num_buckets]
+    return [flat(s) for s in sums], flat(cnt)

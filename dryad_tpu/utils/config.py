@@ -122,3 +122,15 @@ class DryadConfig:
             raise ValueError("shuffle_slack must be >= 1.0")
         if self.intermediate_compression not in (None, "zlib"):
             raise ValueError("intermediate_compression must be None or 'zlib'")
+        if not 0.0 < self.sample_rate <= 1.0:
+            raise ValueError("sample_rate must be in (0, 1]")
+        if self.max_shuffle_retries < 0:
+            raise ValueError("max_shuffle_retries must be >= 0")
+        if self.max_stage_failures < 1:
+            raise ValueError("max_stage_failures must be >= 1")
+        if self.outlier_sigmas <= 0:
+            raise ValueError("outlier_sigmas must be > 0")
+        if self.io_threads < 1:
+            raise ValueError("io_threads must be >= 1")
+        if self.rows_per_vertex < 1:
+            raise ValueError("rows_per_vertex must be >= 1")

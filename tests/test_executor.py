@@ -332,3 +332,25 @@ def test_rebuilt_query_hits_compile_cache(rng):
         "rebuilt query recompiled stages"
     )
     assert first["k"].tolist() == second["k"].tolist()
+
+
+def test_config_validation_rejects_bad_knobs():
+    """validate() covers every numeric knob (verify-drive regression:
+    sample_rate=-1 used to pass silently)."""
+    import pytest
+
+    from dryad_tpu.utils.config import DryadConfig
+
+    for kw in (
+        dict(sample_rate=-1.0),
+        dict(sample_rate=0.0),
+        dict(sample_rate=1.5),
+        dict(max_shuffle_retries=-1),
+        dict(max_stage_failures=0),
+        dict(outlier_sigmas=0.0),
+        dict(io_threads=0),
+        dict(rows_per_vertex=0),
+    ):
+        with pytest.raises(ValueError):
+            DryadConfig(**kw)
+    DryadConfig(sample_rate=1.0)  # boundary is legal

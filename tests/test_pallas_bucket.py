@@ -109,3 +109,22 @@ def test_dense_output_is_key_ordered(rng):
     b = base.order_by([("k", False)]).collect()
     assert a["k"].tolist() == b["k"].tolist()
     assert a["c"].tolist() == b["c"].tolist()
+
+
+def test_huge_bucket_count_uses_fallback(rng):
+    """When the (A,128) accumulators alone exceed the VMEM budget,
+    _row_block returns None and the XLA fallback runs — same math, no
+    VMEM ceiling (code-review regression)."""
+    from dryad_tpu.ops.pallas_bucket import _hi_width, _row_block
+
+    assert _row_block(_hi_width(300)) is not None
+    big = 1 << 20
+    assert _row_block(_hi_width(big), n_vals=2) is None
+    n = 2000
+    k = rng.integers(0, big, n).astype(np.int32)
+    v = np.ones(n, np.float32)
+    sums, cnt = bucket_sum_count(
+        k, [v], np.ones(n, bool), big, interpret=True
+    )
+    assert float(cnt.sum()) == n
+    np.testing.assert_allclose(np.asarray(sums[0]), np.asarray(cnt))
