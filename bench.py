@@ -223,13 +223,17 @@ def wordcount_metric(n: int, vocab_size: int = 1 << 14):
             assert int(np.sum(out["count"])) == n
 
         t0 = time.perf_counter()
-        run()  # compile (structural cache takes every later rep)
+        run()  # compile + first ingest (both cached for later reps)
         compile_s = time.perf_counter() - t0
         log(f"wordcount compiled+warmed in {compile_s:.1f}s")
+        # Warm reps reuse the device-resident ingest (context device
+        # cache): they measure dispatch + device pipeline + egress, the
+        # steady-state of repeated queries over a resident table.
         best, times = timed_reps(run)
         return rep_record(
             "wordcount_rows_per_sec", n, times,
-            {"vocab": vocab_size, "compile_s": round(compile_s, 1)},
+            {"vocab": vocab_size, "compile_s": round(compile_s, 1),
+             "ingest_cached": True},
         )
     finally:
         os.unlink(path)
@@ -289,12 +293,13 @@ def terasort_metric(n: int):
         assert len(out["key"]) == n
 
     t0 = time.perf_counter()
-    run()
+    run()  # compile + first ingest (both cached for later reps)
     compile_s = time.perf_counter() - t0
     log(f"terasort compiled+warmed in {compile_s:.1f}s")
     best, times = timed_reps(run)
     return rep_record(
-        "terasort_rows_per_sec", n, times, {"compile_s": round(compile_s, 1)}
+        "terasort_rows_per_sec", n, times,
+        {"compile_s": round(compile_s, 1), "ingest_cached": True},
     )
 
 

@@ -16,6 +16,7 @@ import enum
 import math
 import os
 import time
+from collections import OrderedDict
 from typing import Dict, Optional
 
 import numpy as np
@@ -90,6 +91,14 @@ class DryadContext:
         # IDryadLinqSerializer hook, columnar/codecs.py).
         self._codecs: Dict[str, object] = {}
         self._binding_fp_cache: Dict[int, Optional[str]] = {}
+        # Device-resident ingest cache: input node id -> (binding tuple
+        # the batch was ingested from, sharded batch, bytes), LRU by
+        # insertion order (see config.device_cache_bytes).  The stored
+        # binding identity self-invalidates the entry when a binding is
+        # rebound (worker _run_part rebinds per-part slices on a reused
+        # context); in-place mutation of arrays passed to from_arrays is
+        # NOT tracked — inputs snapshot at first execution.
+        self._device_cache: "OrderedDict[int, tuple]" = OrderedDict()
         if local_debug:
             self.mesh = None
             self.executor = None
@@ -142,6 +151,8 @@ class DryadContext:
         self._bindings = {
             nid: b for nid, b in self._bindings.items() if b[0] != "device"
         }
+        # Cached ingests are sharded over the OLD mesh — drop them.
+        self._device_cache.clear()
         self.executor = GraphExecutor(
             self.mesh, self.config, self.events,
             subquery_runner=self._run_subquery,
@@ -270,6 +281,27 @@ class DryadContext:
         kind, *rest = self._bindings[node.id]
         if kind == "device":
             return rest[0]
+        binding = self._bindings[node.id]
+        budget = self.config.device_cache_bytes
+        if budget and node.id in self._device_cache:
+            src, batch, _ = self._device_cache[node.id]
+            if src is binding:  # rebound nodes miss (stale entry)
+                self._device_cache.move_to_end(node.id)
+                return batch
+            del self._device_cache[node.id]
+        batch = self._ingest_binding(kind, rest, node)
+        if budget:
+            nbytes = sum(
+                a.size * a.dtype.itemsize for a in batch.data.values()
+            ) + batch.valid.size
+            self._device_cache[node.id] = (binding, batch, nbytes)
+            total = sum(e[2] for e in self._device_cache.values())
+            while total > budget and len(self._device_cache) > 1:
+                _, (_, _, freed) = self._device_cache.popitem(last=False)
+                total -= freed
+        return batch
+
+    def _ingest_binding(self, kind, rest, node: Node) -> ColumnBatch:
         if kind == "host":
             arrays, cap = rest
             return D.from_host_table(

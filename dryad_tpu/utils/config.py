@@ -100,6 +100,15 @@ class DryadConfig:
     # n is at or below this (each partition gathers P*n head rows);
     # larger takes keep the full range-exchange sort.
     topk_limit: int = _env_int("DRYAD_TPU_TOPK_LIMIT", 1024)
+    # Device-resident input cache budget in bytes (0 disables): ingested
+    # host/store tables stay sharded in HBM across submits, LRU-evicted
+    # by size — the on-device analog of the ProcessService LRU block
+    # cache (Cache.cs:32) applied to ingest instead of channel files.
+    # Repeated queries over one table skip the host->device transfer
+    # (through a tunneled chip that transfer dominates end-to-end time).
+    device_cache_bytes: int = _env_int(
+        "DRYAD_TPU_DEVICE_CACHE", 2 * 1024 * 1024 * 1024
+    )
     # Target rows per independent vertex task: when a partitioned
     # submission doesn't pin nparts, the fan-out is computed from the
     # OBSERVED input size (the data-size-driven consumer-count
@@ -134,3 +143,5 @@ class DryadConfig:
             raise ValueError("io_threads must be >= 1")
         if self.rows_per_vertex < 1:
             raise ValueError("rows_per_vertex must be >= 1")
+        if self.device_cache_bytes < 0:
+            raise ValueError("device_cache_bytes must be >= 0")

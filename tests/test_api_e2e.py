@@ -292,3 +292,57 @@ def test_query_iteration_triggers_job(ctx):
     tbl = {"k": np.arange(10, dtype=np.int32)}
     rows = list(ctx.from_arrays(tbl).where(lambda c: c["k"] < 3))
     assert sorted(r["k"] for r in rows) == [0, 1, 2]
+
+
+def test_device_ingest_cache_reuse_and_eviction(rng):
+    """Repeated submits over one table reuse the device-resident ingest
+    (LRU by bytes, ProcessService Cache.cs:32 analog); a tiny budget
+    evicts; 0 disables."""
+    from dryad_tpu import DryadContext
+    from dryad_tpu.utils.config import DryadConfig
+
+    tbl = {"k": rng.integers(0, 9, 512).astype(np.int32)}
+    ctx = DryadContext(num_partitions_=8)
+    q = ctx.from_arrays(tbl)
+    a = q.group_by("k", {"c": ("count", None)}).collect()
+    cached = ctx._device_cache[q.node.id][1]
+    b = q.group_by("k", {"s": ("count", None)}).collect()
+    assert ctx._device_cache[q.node.id][1] is cached  # reused, not re-ingested
+    assert sorted(a["k"].tolist()) == sorted(b["k"].tolist())
+
+    small = DryadContext(
+        num_partitions_=8, config=DryadConfig(device_cache_bytes=1)
+    )
+    q1 = small.from_arrays(tbl)
+    q2 = small.from_arrays({"k": np.arange(512, dtype=np.int32)})
+    q1.count(); q2.count()
+    assert len(small._device_cache) == 1  # budget of 1 byte keeps only newest
+
+    off = DryadContext(
+        num_partitions_=8, config=DryadConfig(device_cache_bytes=0)
+    )
+    q3 = off.from_arrays(tbl)
+    q3.count()
+    assert len(off._device_cache) == 0
+
+
+def test_device_cache_invalidated_on_rebinding(rng):
+    """Rebinding a node (the worker _run_part per-part slice pattern)
+    must MISS the device cache — a stale part-0 ingest served for every
+    part would duplicate rows (code-review regression)."""
+    from dryad_tpu import DryadContext
+    from dryad_tpu.exec.jobpackage import slice_binding
+
+    ctx = DryadContext(num_partitions_=8)
+    k = np.arange(64, dtype=np.int32)
+    q = ctx.from_arrays({"k": k})
+    pristine = dict(ctx._bindings)
+    seen = []
+    for part in range(2):
+        for nid, binding in pristine.items():
+            ctx._bindings[nid] = slice_binding(binding, part, 2)
+        ctx._binding_fp_cache.clear()
+        out = q.collect()
+        seen.append(sorted(out["k"].tolist()))
+    assert seen[0] == list(range(32))
+    assert seen[1] == list(range(32, 64))
