@@ -315,7 +315,6 @@ class DryadContext:
             parts, schema = rest
             P = num_partitions(self.mesh)
             phys = schema.device_names()
-            import jax.numpy as jnp
 
             # Fold store partitions onto mesh partitions (store partition
             # i concatenates into mesh partition i % P) so a store written
@@ -328,24 +327,21 @@ class DryadContext:
                 for group in folded
             ]
             cap = math.ceil(max(max(rows_per, default=1), 1) / 8) * 8
-            batches = []
-            for group in folded:
-                data = {c: np.zeros(cap, _phys_dtype(c, schema)) for c in phys}
-                valid = np.zeros(cap, np.bool_)
-                at = 0
+            # Host-side (P * cap) layout + one device_put per column
+            # (same no-jitted-ingest policy as from_physical_table).
+            data = {
+                c: np.zeros(P * cap, _phys_dtype(c, schema)) for c in phys
+            }
+            valid = np.zeros(P * cap, np.bool_)
+            for p, group in enumerate(folded):
+                at = p * cap
                 for cols in group:
                     n = len(next(iter(cols.values()))) if cols else 0
                     for c in phys:
                         data[c][at : at + n] = cols[c]
                     valid[at : at + n] = True
                     at += n
-                batches.append(
-                    ColumnBatch(
-                        {c: jnp.asarray(v) for c, v in data.items()},
-                        jnp.asarray(valid),
-                    )
-                )
-            return D.shard_batch(ColumnBatch.concatenate(batches), self.mesh)
+            return D.shard_host_padded(data, valid, self.mesh)
         raise RuntimeError(f"unknown binding kind {kind}")
 
     def _binding_fp(self, node: Node):

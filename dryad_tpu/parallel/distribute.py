@@ -27,6 +27,20 @@ def shard_batch(batch: ColumnBatch, mesh: Mesh) -> ColumnBatch:
     return ColumnBatch(data, jax.device_put(batch.valid, sh))
 
 
+def shard_host_padded(
+    data: Dict[str, np.ndarray], valid: np.ndarray, mesh: Mesh
+) -> ColumnBatch:
+    """One device_put per already-laid-out (P * cap) host column onto
+    the row sharding — the ingest edge for host-side layouts.  No
+    jitted concatenate/slice programs run (through a tunneled chip each
+    such compile is ~30s)."""
+    sh = partition_sharding(mesh)
+    return ColumnBatch(
+        {c: jax.device_put(v, sh) for c, v in data.items()},
+        jax.device_put(valid, sh),
+    )
+
+
 def from_host_table(
     schema: Schema,
     arrays: Dict[str, np.ndarray],
@@ -68,23 +82,24 @@ def from_physical_table(
     cap = partition_capacity if partition_capacity is not None else per
     if cap < per:
         raise ValueError(f"partition_capacity {cap} < required {per}")
-    import jax.numpy as jnp
-
-    batches = []
-    for p in range(P):
-        lo = min(p * per, n)
-        hi = min((p + 1) * per, n)
-        m = hi - lo
-        data = {}
-        for c in names:
-            a = np.asarray(phys[c])
-            pad = np.zeros((cap,) + a.shape[1:], a.dtype)
-            pad[:m] = a[lo:hi]
-            data[c] = jnp.asarray(pad)
-        valid = np.zeros(cap, np.bool_)
-        valid[:m] = True
-        batches.append(ColumnBatch(data, jnp.asarray(valid)))
-    return shard_batch(ColumnBatch.concatenate(batches), mesh)
+    # Lay out the (P * cap) global buffer entirely on the host (this
+    # path used to build per-partition device arrays and compile four
+    # concatenate/slice programs).
+    sizes = [
+        min((p + 1) * per, n) - min(p * per, n) for p in range(P)
+    ]
+    data = {}
+    for c in names:
+        a = np.asarray(phys[c])
+        pad = np.zeros((P * cap,) + a.shape[1:], a.dtype)
+        for p, m in enumerate(sizes):
+            lo = min(p * per, n)
+            pad[p * cap : p * cap + m] = a[lo : lo + m]
+        data[c] = pad
+    valid = np.zeros(P * cap, np.bool_)
+    for p, m in enumerate(sizes):
+        valid[p * cap : p * cap + m] = True
+    return shard_host_padded(data, valid, mesh)
 
 
 def to_host_table(

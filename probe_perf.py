@@ -2,11 +2,16 @@
 candidates on the current backend (the evidence behind BASELINE.md's
 roofline section and the sort-vs-scatter decision).
 
-Measures, single-call with block_until_ready, best of 5 reps:
+Timing forces a scalar READBACK (float(...)) per call — through the
+axon tunnel ``block_until_ready`` returns without waiting, so
+readback is the only honest sync (BASELINE.md "Discrepancy RESOLVED").
+Each case reports best-of-5 single calls (includes the ~70ms tunnel
+dispatch) AND a 16-iteration fori_loop amortized time (dispatch cost
+/16, the device-side number that decides kernel strategy):
   A. group_reduce (sort + segmented reduce)  -- the general path
   B. bare 2-operand lax.sort                 -- sort share of A
   C. scatter-add (segment_sum on raw keys)   -- sortless alternative
-  D. dense bucket one-hot matmul (XLA scan)  -- MXU path
+  D. dense bucket factorized matmul (XLA)    -- MXU path
   E. dense bucket Pallas kernel              -- MXU path, Pallas (TPU)
 
 Usage:
@@ -57,8 +62,11 @@ def main():
         v = jnp.asarray(rng.standard_normal(n).astype(np.float32))
         valid = jnp.ones((n,), jnp.bool_)
 
-        @jax.jit
-        def gr(k, v, valid):
+        # ONE body per case; the single-call variant is jit(body) and
+        # the amortized variant wraps the same body in a fori_loop
+        # (key mixed with the iteration index to defeat CSE — i < 16
+        # only flips low bits, so k ^ i stays inside [0, 4096)).
+        def gr_body(k, v, valid):
             b = ColumnBatch({"k": k, "v": v}, valid)
             out = group_reduce(
                 b, ["k"],
@@ -66,37 +74,49 @@ def main():
             )
             return jnp.sum(jnp.where(out.valid, out.data["s"], 0.0))
 
-        @jax.jit
-        def bare_sort(k, v):
-            a, b = jax.lax.sort((k, v), num_keys=1)
-            return a[0] + b[0]
-
-        @jax.jit
-        def scatter(k, v, valid):
+        def scatter_body(k, v, valid):
             vv = jnp.where(valid, v, 0.0)
             s = jax.ops.segment_sum(vv, k, 4096)
             c = jax.ops.segment_sum(valid.astype(jnp.int32), k, 4096)
             return jnp.sum(s) + jnp.sum(c)
 
-        @jax.jit
-        def dense_xla(k, v, valid):
-            s, c = bucket_sum_count(k, [v], valid, 4096, interpret=False)
-            return jnp.sum(s[0]) + jnp.sum(c)
+        def dense_body(interp):
+            def f(k, v, valid):
+                s, c = bucket_sum_count(k, [v], valid, 4096, interpret=interp)
+                return jnp.sum(s[0]) + jnp.sum(c)
+
+            return f
 
         @jax.jit
-        def dense_pl(k, v, valid):
-            s, c = bucket_sum_count(k, [v], valid, 4096, interpret=None)
-            return jnp.sum(s[0]) + jnp.sum(c)
+        def bare_sort(k, v):
+            a, b = jax.lax.sort((k, v), num_keys=1)
+            return a[0] + b[0]
+
+        def looped(body16):
+            @jax.jit
+            def f(k, v, valid):
+                def body(i, acc):
+                    return acc + body16(k ^ i, v, valid)
+
+                return jax.lax.fori_loop(0, 16, body, jnp.float32(0.0))
+
+            return f
+
+        def single(body):
+            jf = jax.jit(body)
+            return lambda: float(jf(k, v, valid))
 
         cases = [
-            ("A group_reduce", lambda: float(gr(k, v, valid))),
-            ("B bare_sort", lambda: float(bare_sort(k, v))),
-            ("C scatter_add", lambda: float(scatter(k, v, valid))),
-            ("D dense_xla", lambda: float(dense_xla(k, v, valid))),
+            ("A group_reduce", single(gr_body), gr_body),
+            ("B bare_sort", lambda: float(bare_sort(k, v)), None),
+            ("C scatter_add", single(scatter_body), scatter_body),
+            ("D dense_xla", single(dense_body(False)), dense_body(False)),
         ]
         if d.platform in ("tpu", "axon"):
-            cases.append(("E dense_pallas", lambda: float(dense_pl(k, v, valid))))
-        for name, fn in cases:
+            cases.append(
+                ("E dense_pallas", single(dense_body(None)), dense_body(None))
+            )
+        for name, fn, body16 in cases:
             t0 = time.perf_counter()
             fn()
             log(f"n={n} {name}: compile+run {time.perf_counter()-t0:.1f}s")
@@ -104,6 +124,15 @@ def main():
             log(
                 f"n={n} {name}: best={b*1e3:.2f}ms reps={['%.1f' % (t*1e3) for t in ts]}ms"
                 f" -> {n/b:.3e} rows/s"
+            )
+            if body16 is None:
+                continue
+            lf = looped(body16)
+            float(lf(k, v, valid))  # compile
+            lb, _ = best_of(lambda: float(lf(k, v, valid)), reps=3)
+            log(
+                f"n={n} {name}: amortized16 {lb/16*1e3:.2f}ms/iter"
+                f" -> {16*n/lb:.3e} rows/s"
             )
     log("done")
 
