@@ -227,7 +227,10 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                     ver, val = got
                     self._send(200, val, {"X-Version": str(ver)})
             elif parts[0] == "file" and len(parts) >= 2:
-                rel = "/".join(parts[1:])
+                # per-segment unquote (clients percent-encode reserved
+                # chars; the root realpath check below still contains
+                # any reintroduced separators)
+                rel = "/".join(urllib.parse.unquote(p) for p in parts[1:])
                 offset = int(q.get("offset", ["0"])[0])
                 length = int(
                     q.get("length", [str(self.service.cache.block_size)])[0]
@@ -283,7 +286,10 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                     import zlib
 
                     body = zlib.decompress(body)
-                self.service.write_file("/".join(parts[1:]), body)
+                self.service.write_file(
+                    "/".join(urllib.parse.unquote(p) for p in parts[1:]),
+                    body,
+                )
                 self._send(200, b"")
             else:
                 self._send(404, b"not found")
@@ -410,7 +416,8 @@ class ServiceClient:
         accounting for observability."""
         c = self._conn()
         try:
-            url = f"/file/{rel}?offset={offset}&length={length}"
+            quoted = urllib.parse.quote(rel, safe="/")
+            url = f"/file/{quoted}?offset={offset}&length={length}"
             if compress:
                 url += "&compress=1"
             c.request("GET", url)
@@ -454,7 +461,11 @@ class ServiceClient:
             headers["X-Encoding"] = "deflate"
         c = self._conn()
         try:
-            c.request("PUT", f"/file/{rel}", body=body, headers=headers)
+            c.request(
+                "PUT",
+                f"/file/{urllib.parse.quote(rel, safe='/')}",
+                body=body, headers=headers,
+            )
             r = c.getresponse()
             msg = r.read()
             if r.status != 200:

@@ -10,9 +10,14 @@ The reference maps URI schemes to pluggable storage providers
 - ``mem://<name>``    — in-process named table registry (the
   LocalDebug-style test provider).
 - ``http://host:port/<rel>`` — a store served by a remote node's
-  ProcessService file server (``cluster/service.py``), read with 2MB
-  range reads like the reference's HTTP channel readers
-  (``managedchannel/HttpReader.cs:78-110``).  Read-only.
+  ProcessService file server (``cluster/service.py``): 2MB range
+  reads like the reference's HTTP channel readers
+  (``managedchannel/HttpReader.cs:78-110``), PUT writes, zlib wire
+  compression.
+- ``hdfs://``, ``wasb://``, ``abfs://`` — cloud-DFS schemes routed
+  through a file gateway (``DRYAD_TPU_DFS_GATEWAY``, or the URI
+  authority itself) speaking the same file-plane protocol — the
+  WebHDFS/Azure-REST bridge pattern of ``DrHdfsClient.cpp:32-69``.
 
 Register custom providers with ``register_provider``.
 """
@@ -233,7 +238,46 @@ class HttpStoreProvider(DataProvider):
             shutil.rmtree(tmp, ignore_errors=True)
 
 
+class DfsGatewayProvider(DataProvider):
+    """Cloud-DFS scheme adapter: ``hdfs://``, ``wasb://``, ``abfs://``
+    URIs route through a cluster file gateway speaking the
+    ProcessService file-plane protocol (2MB range reads + zlib wire
+    compression).  The reference reads these schemes through a managed
+    WebHDFS/Azure REST bridge (``DrHdfsClient.cpp:32-69``,
+    ``DrAzureBlobClient.h:25``) — the same gateway-REST pattern; here
+    the gateway is any ProcessService-compatible file server.
+
+    Routing: with ``DRYAD_TPU_DFS_GATEWAY=host:port`` set, the store
+    lives under ``<gateway>/<scheme>/<authority>/<path>`` (one gateway
+    fronts many DFS namespaces); without it, the URI authority itself
+    must be a reachable ``host:port`` file server (an "HDFS namenode"
+    that IS the gateway)."""
+
+    def __init__(self, scheme: str, via: "HttpStoreProvider"):
+        self.scheme = scheme
+        self.via = via
+
+    def _route(self, rest: str) -> str:
+        gw = os.environ.get("DRYAD_TPU_DFS_GATEWAY")
+        if not gw:
+            return rest
+        netloc, _, rel = rest.partition("/")
+        path = f"{self.scheme}/{netloc}/{rel}".rstrip("/")
+        return f"{gw}/{path}"
+
+    def read(self, rest: str) -> ReadResult:
+        return self.via.read(self._route(rest))
+
+    def write(self, rest, partitions, schema, dictionary, compression):
+        self.via.write(
+            self._route(rest), partitions, schema, dictionary, compression
+        )
+
+
+_HTTP = HttpStoreProvider()
 register_provider("partfile", PartfileProvider())
 register_provider("file", TextFileProvider())
 register_provider("mem", MemProvider())
-register_provider("http", HttpStoreProvider())
+register_provider("http", _HTTP)
+for _scheme in ("hdfs", "wasb", "abfs"):
+    register_provider(_scheme, DfsGatewayProvider(_scheme, _HTTP))

@@ -83,3 +83,42 @@ def test_terasort_from_to_remote_store(service):
     out = ctx3.from_store(f"{base}/stores/sorted").collect()
     np.testing.assert_array_equal(out["key"], np.sort(tbl["key"]))
     assert len(out["payload"]) == n
+
+
+def test_dfs_scheme_roundtrip_via_gateway(service, monkeypatch, rng):
+    """hdfs:// (and wasb://, abfs://) route through the configured file
+    gateway: write + read a partitioned store under a DFS URI whose
+    namespace is carried in the gateway path (DrHdfsClient.h:29 role)."""
+    monkeypatch.setenv(
+        "DRYAD_TPU_DFS_GATEWAY", f"127.0.0.1:{service.port}"
+    )
+    ctx = DryadContext(num_partitions_=8)
+    tbl = {"k": rng.integers(0, 50, 400).astype(np.int32)}
+    uri = "hdfs://nn.example:9000/warehouse/t1"
+    ctx.from_arrays(tbl).group_by("k", {"c": ("count", None)}).to_store(uri)
+    out = DryadContext(num_partitions_=8).from_store(uri).collect()
+    ref = np.bincount(tbl["k"], minlength=50)
+    got = dict(zip(out["k"].tolist(), out["c"].tolist()))
+    assert got == {int(k): int(c) for k, c in enumerate(ref) if c}
+
+
+def test_dfs_scheme_without_gateway_uses_authority(service, monkeypatch, rng):
+    """Without DRYAD_TPU_DFS_GATEWAY, the URI authority itself is the
+    file server (a namenode that IS the gateway)."""
+    monkeypatch.delenv("DRYAD_TPU_DFS_GATEWAY", raising=False)
+    ctx = DryadContext(num_partitions_=8)
+    tbl = {"v": np.arange(64, dtype=np.int32)}
+    uri = f"wasb://127.0.0.1:{service.port}/container/blob"
+    ctx.from_arrays(tbl).to_store(uri)
+    out = DryadContext(num_partitions_=8).from_store(uri).collect()
+    assert sorted(out["v"].tolist()) == list(range(64))
+
+
+def test_file_paths_with_reserved_characters(service):
+    """Paths with spaces/'?'/'#' percent-encode on the wire and
+    round-trip exactly (code-review regression: unquoted splice
+    truncated at '?')."""
+    client = ServiceClient("127.0.0.1", service.port)
+    for rel in ("dir with space/t1.bin", "odd?name.bin", "hash#part.bin"):
+        client.write_file(rel, b"payload-" + rel.encode())
+        assert client.read_whole_file(rel) == b"payload-" + rel.encode()
