@@ -195,10 +195,12 @@ def dense_path_metric(
 
 def wordcount_metric(n: int, vocab_size: int = 1 << 14):
     """WordCount end-to-end THROUGH DryadContext on the device: token
-    table (native-tokenized STRING column) -> hash-shuffle group_by count
-    -> order_by count -> collect.  Ingest text is tokenized ONCE by the
-    native runtime (the real ingest path); each rep re-runs host->device
-    transfer + the full device pipeline + device->host egress.
+    table (native-tokenized STRING column) -> group_by count ->
+    order_by count -> collect.  The STRING group_by auto-lowers to the
+    dense MXU bucket path (dictionary codes, no shuffle —
+    ops/stringcode.py) when the vocabulary fits auto_dense_limit, which
+    this shape does; ingest text is tokenized ONCE by the native
+    runtime, and warm reps reuse the device-resident ingest.
     Reference shape: ``DryadLinqTests/WordCount.cs:58-61``."""
     import tempfile
 

@@ -178,6 +178,19 @@ class DryadContext:
             arrays = expand_arrays(arrays, codecs)
             self._codecs.update(codecs)
         schema = schema or _infer_schema(arrays)
+        # Register string values at DEFINITION time (unique-first, so
+        # the pass is vocabulary-sized): the auto-dense STRING group_by
+        # codes against the dictionary at lowering, which runs before
+        # ingest would otherwise populate it.  Skipped when the feature
+        # is off — ingest registers the same strings at bind time.
+        if getattr(self.config, "auto_dense_strings", True):
+            for name in schema.names:
+                if (
+                    schema.field(name).ctype is ColumnType.STRING
+                    and name in arrays
+                ):
+                    for s in np.unique(np.asarray(arrays[name]).astype(str)):
+                        self.dictionary.add(str(s))
         node = Node(
             "input", [], schema, PartitionInfo.roundrobin(),
             source="host",
@@ -370,7 +383,7 @@ class DryadContext:
         return fp
 
     def _execute_device(self, query: Query) -> ColumnBatch:
-        graph = lower([query.node], self.config)
+        graph = lower([query.node], self.config, self.dictionary)
         bindings = {
             nid: self._bind_device(n) for nid, n in graph.inputs.items()
         }
@@ -445,7 +458,7 @@ class DryadContext:
         falls back to the driver loop."""
         q0 = self._from_device_batch(example, schema)
         out_q = plan_fn(q0)
-        graph = lower([out_q.node], self.config)
+        graph = lower([out_q.node], self.config, self.dictionary)
         if len(graph.stages) != 1:
             raise ValueError(
                 f"subplan lowers to {len(graph.stages)} stages; device "

@@ -248,12 +248,47 @@ class Query:
                 keys=keys, aggs=agg_list, dense=int(dense),
             )
         else:
+            auto = self._auto_dense_eligible(keys, agg_list, salt)
+            # The auto-dense path physically partitions output by
+            # dictionary CODE range, which matches neither a hash nor a
+            # key-order range claim — so the node claims NOTHING and
+            # downstream consumers re-exchange (a stale hashed claim
+            # would elide a join's left exchange and drop matches).
+            part = (
+                PartitionInfo() if auto else PartitionInfo.hashed(keys)
+            )
             node = Node(
-                "group_by", [self.node], Schema(fields),
-                PartitionInfo.hashed(keys), keys=keys, aggs=agg_list,
-                salt=salt,
+                "group_by", [self.node], Schema(fields), part,
+                keys=keys, aggs=agg_list, salt=salt, auto_dense=auto,
             )
         return Query(self.ctx, node)
+
+    def _auto_dense_eligible(self, keys, agg_list, salt) -> bool:
+        """Build-time gate for the auto-dense STRING group_by lowering
+        (``plan/lower.py`` re-checks the dictionary size at lowering;
+        a vocabulary grown past the limit falls back to the sort path,
+        which the claim-free partition metadata keeps correct)."""
+        cfg = self.ctx.config
+        if salt or not getattr(cfg, "auto_dense_strings", True):
+            return False
+        d = getattr(self.ctx, "dictionary", None)
+        limit = getattr(cfg, "auto_dense_limit", 1 << 17)
+        if d is None or not 0 < len(d) <= limit:
+            return False
+        if len(keys) != 1:
+            return False
+        if self.schema.field(keys[0]).ctype is not ColumnType.STRING:
+            return False
+        plain = (
+            ColumnType.INT32, ColumnType.UINT32,
+            ColumnType.FLOAT32, ColumnType.BOOL,
+        )
+        for op, col, _name in agg_list:
+            if op not in ("sum", "count", "mean"):
+                return False
+            if col is not None and self.schema.field(col).ctype not in plain:
+                return False
+        return True
 
     def distinct(self, keys: Optional[KeyArg] = None) -> "Query":
         keys = _keys(keys) if keys is not None else self.schema.names

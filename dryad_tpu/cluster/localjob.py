@@ -492,7 +492,7 @@ class LocalJobSubmission:
 
         self._reap_dead_workers()
         self._sync_membership(gang=False)
-        graph = lower([query.node], query.ctx.config)
+        graph = lower([query.node], query.ctx.config, query.ctx.dictionary)
         for st in graph.stages:
             bad = [
                 op.kind for op in st.ops

@@ -190,7 +190,7 @@ def _do_resize(ctx: StageContext, slot: int, factor: float) -> None:
 NON_OVERFLOW_OPS = frozenset({
     "select", "where", "project", "select_many", "apply", "fork",
     "group_reduce", "group_combine", "group_reduce_dense", "distinct",
-    "local_sort", "concat", "scalar_agg", "topk",
+    "local_sort", "concat", "scalar_agg", "topk", "string_code",
 })
 
 
@@ -307,8 +307,20 @@ def _k_group_reduce_dense(ctx: StageContext, p) -> None:
     by_col = {c: scat(s) for c, s in by_col.items()}
 
     me = jax.lax.axis_index(ctx.axes)
-    kcol = (me * per + jnp.arange(per, dtype=jnp.int32)).astype(key.dtype)
-    out: Dict[str, jax.Array] = {p["key"]: kcol}
+    codes = me * per + jnp.arange(per, dtype=jnp.int32)
+    decode = p.get("decode")
+    if decode is None:
+        out: Dict[str, jax.Array] = {p["key"]: codes.astype(key.dtype)}
+    else:
+        # auto-dense STRING key: gather this partition's code range from
+        # the dictionary decode table to reconstruct the physical
+        # (#h0, #h1, #r0, #r1) words (ops/stringcode.py)
+        words = decode.slice_rows(me * per, per)  # (per, 4) uint32
+        okey = p["out_key"]
+        out = {
+            f"{okey}#{w}": words[:, i]
+            for i, w in enumerate(("h0", "h1", "r0", "r1"))
+        }
     for a in p["aggs"]:
         if a.op == "count":
             out[a.out] = cnt
@@ -325,8 +337,20 @@ def _k_group_reduce_dense(ctx: StageContext, p) -> None:
             )
         else:  # guarded at the API layer
             raise ValueError(f"dense group_by cannot compute {a.op!r}")
-    valid = (cnt > 0) & (kcol < K)
+    valid = (cnt > 0) & (codes < K)
     ctx.slots[p["slot"]] = ColumnBatch(out, valid)
+
+
+def _k_string_code(ctx: StageContext, p) -> None:
+    """Map a STRING column's Hash64 words to dense dictionary codes
+    (``ops/stringcode.py``) — the bridge that lets a plain group_by
+    over strings ride the MXU dense path.  Misses map to num_codes,
+    which the dense kernel's range mask drops."""
+    b = ctx.slots[p["slot"]]
+    codes = p["table"].lookup(b.data[p["h0"]], b.data[p["h1"]])
+    ctx.slots[p["slot"]] = ColumnBatch(
+        {**b.data, p["out"]: codes}, b.valid
+    )
 
 
 def _k_distinct(ctx: StageContext, p) -> None:
@@ -825,6 +849,7 @@ _KERNELS = {
     "resize": _k_resize,
     "group_reduce": _k_group_reduce,
     "group_reduce_dense": _k_group_reduce_dense,
+    "string_code": _k_string_code,
     "group_combine": _k_group_combine,
     "distinct": _k_distinct,
     "local_sort": _k_local_sort,

@@ -1,0 +1,178 @@
+"""Dictionary-code lookup for STRING keys — the auto-dense bridge.
+
+A STRING device column is Hash64 word pairs (``columnar/schema.py``);
+the context ``StringDictionary`` knows every distinct string a context
+ever ingested.  That makes a plain ``group_by`` over a string column a
+*dense* problem in disguise: assign each dictionary entry a dense code
+(its insertion rank), map rows (h0, h1) -> code on device, and the
+whole GroupBy rides the MXU bucket kernel (``ops/pallas_bucket.py``)
+with no shuffle — the reference pays a full hash repartition for the
+same query (``DryadLinqQueryNode.cs:3581``).
+
+The mapping table is host-built open addressing over the 64-bit hash
+(linear probing, power-of-two slots, load <= 0.5), shipped to the
+device as three constant arrays; lookup is ``max_probe`` unrolled
+vectorized gathers.  Tables are wrapped in VALUE-equal objects so the
+executor's structural compile cache keys on table *content* — a grown
+dictionary recompiles, a rebuilt identical pipeline does not.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+def _mix(h0: np.ndarray, h1: np.ndarray) -> np.ndarray:
+    """Slot hash from the two Hash64 words (uint32)."""
+    return (h0 ^ (h1 * np.uint32(0x9E3779B9))).astype(np.uint32)
+
+
+class CodeTable:
+    """Open-addressing (h0, h1) -> dense code map; VALUE-equal.
+
+    ``slots_h0/h1``: uint32 hash words per slot; ``slots_code``: int32
+    code or -1 for empty; ``num_codes`` = K; misses map to K (the dense
+    kernel's out-of-range drop)."""
+
+    def __init__(self, pairs: np.ndarray):
+        """``pairs``: (K, 2) uint32 — (h0, h1) per code, in code order."""
+        K = len(pairs)
+        S = 8
+        while S < 2 * max(K, 1):
+            S *= 2
+        h0 = pairs[:, 0].astype(np.uint32)
+        h1 = pairs[:, 1].astype(np.uint32)
+        slots_h0 = np.zeros(S, np.uint32)
+        slots_h1 = np.zeros(S, np.uint32)
+        slots_code = np.full(S, -1, np.int32)
+        start = _mix(h0, h1) & np.uint32(S - 1)
+        max_probe = 1
+        for code in range(K):
+            j = int(start[code])
+            probe = 1
+            while slots_code[j] >= 0:
+                j = (j + 1) & (S - 1)
+                probe += 1
+            slots_h0[j] = h0[code]
+            slots_h1[j] = h1[code]
+            slots_code[j] = code
+            max_probe = max(max_probe, probe)
+        self.num_slots = S
+        self.num_codes = K
+        self.max_probe = max_probe
+        self.slots_h0 = slots_h0
+        self.slots_h1 = slots_h1
+        self.slots_code = slots_code
+        self._fp = hash(
+            (S, K, max_probe, slots_h0.tobytes(), slots_h1.tobytes())
+        )
+
+    def __eq__(self, other) -> bool:
+        return (
+            type(other) is CodeTable
+            and other._fp == self._fp
+            and other.num_slots == self.num_slots
+            and np.array_equal(other.slots_h0, self.slots_h0)
+            and np.array_equal(other.slots_h1, self.slots_h1)
+            and np.array_equal(other.slots_code, self.slots_code)
+        )
+
+    def __hash__(self) -> int:
+        return self._fp
+
+    def lookup(self, h0, h1):
+        """Device lookup: (n,) uint32 words -> (n,) int32 codes, misses
+        -> num_codes (dropped by the dense kernel's range mask)."""
+        import jax.numpy as jnp
+
+        S = self.num_slots
+        th0 = jnp.asarray(self.slots_h0)
+        th1 = jnp.asarray(self.slots_h1)
+        tco = jnp.asarray(self.slots_code)
+        idx = (h0 ^ (h1 * jnp.uint32(0x9E3779B9))).astype(jnp.uint32) & jnp.uint32(S - 1)
+        idx = idx.astype(jnp.int32)
+        code = jnp.full(h0.shape, -1, jnp.int32)
+        for p in range(self.max_probe):
+            j = (idx + p) & (S - 1)
+            hit = (th0[j] == h0) & (th1[j] == h1) & (tco[j] >= 0)
+            code = jnp.where(hit & (code < 0), tco[j], code)
+        return jnp.where(code < 0, jnp.int32(self.num_codes), code)
+
+
+class DecodeTable:
+    """Dense code -> STRING physical words (h0, h1, r0, r1); VALUE-equal.
+
+    ``words``: (K, 4) uint32 in code order; the dense kernel gathers its
+    partition's row range to reconstruct the key columns."""
+
+    def __init__(self, words: np.ndarray):
+        self.words = np.ascontiguousarray(words, np.uint32)
+        self._fp = hash(self.words.tobytes())
+
+    def __eq__(self, other) -> bool:
+        return (
+            type(other) is DecodeTable
+            and other._fp == self._fp
+            and np.array_equal(other.words, self.words)
+        )
+
+    def __hash__(self) -> int:
+        return self._fp
+
+    def slice_rows(self, start, count: int):
+        """Device gather of ``count`` code rows from ``start`` (dynamic):
+        returns a (count, 4) uint32 block, rows past K zero-filled."""
+        import jax
+        import jax.numpy as jnp
+
+        K = len(self.words)
+        pad = max(0, count - 1)
+        tab = jnp.asarray(
+            np.concatenate([self.words, np.zeros((pad, 4), np.uint32)])
+            if pad
+            else self.words
+        )
+        return jax.lax.dynamic_slice_in_dim(
+            tab, jnp.clip(start, 0, max(K - 1, 0)), count, axis=0
+        )
+
+
+def build_tables(dictionary) -> Tuple[CodeTable, DecodeTable]:
+    """Build the (code, decode) pair from a context StringDictionary in
+    insertion order (stable per context; the job package ships the
+    driver's lowered plan, so one table serves the whole job).
+
+    Memoized on the dictionary keyed by its length — entries are
+    append-only, so length is a valid version stamp; repeated lowers of
+    a warm pipeline skip the O(vocabulary) Python build.
+
+    Known granularity limit: the table covers the whole CONTEXT
+    dictionary, not the key column's own vocabulary — a context that
+    ingested unrelated string columns pays proportionally more buckets
+    (correctness unaffected; empty buckets drop at the validity mask).
+    """
+    cached = getattr(dictionary, "_stringcode_cache", None)
+    if cached is not None and cached[0] == len(dictionary):
+        return cached[1]
+    from dryad_tpu.columnar.schema import split64, string_prefix_rank
+
+    hashes = []
+    strings = []
+    for h, s in dictionary.items():
+        hashes.append(h)
+        strings.append(s)
+    K = len(hashes)
+    arr = np.asarray(hashes, np.uint64)
+    lo, hi = split64(arr)
+    sarr = np.asarray(strings, object)
+    r0 = string_prefix_rank(sarr, 0) if K else np.zeros(0, np.uint32)
+    r1 = string_prefix_rank(sarr, 4) if K else np.zeros(0, np.uint32)
+    pairs = np.stack([lo, hi], axis=1) if K else np.zeros((0, 2), np.uint32)
+    words = (
+        np.stack([lo, hi, r0, r1], axis=1) if K else np.zeros((0, 4), np.uint32)
+    )
+    tables = CodeTable(pairs), DecodeTable(words)
+    dictionary._stringcode_cache = (K, tables)
+    return tables

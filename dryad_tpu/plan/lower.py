@@ -65,8 +65,9 @@ class StageGraph:
 
 
 class _Builder:
-    def __init__(self, config) -> None:
+    def __init__(self, config, dictionary=None) -> None:
         self.config = config
+        self.dictionary = dictionary
         self.stages: List[Stage] = []
         self.open: Dict[int, Stage] = {}  # stage id -> stage (not yet closed)
         # node id -> ("open", stage, slot) | ("closed", stage_id, out_idx)
@@ -315,6 +316,24 @@ class _Builder:
             self._materialize(node)
 
     # -- keyed (hash) ops --------------------------------------------------
+    def _auto_dense_ok(self, node: Node, in_schema: Schema, keys) -> bool:
+        """Gate for the auto-dense STRING group_by rewrite: one STRING
+        key, dense-supported aggs over plain numeric columns, and a
+        bounded context dictionary to code against."""
+        # Eligibility is decided at node-creation time (Query
+        # _auto_dense_eligible, which also drops the partition claim —
+        # the rewrite's output is code-range partitioned, matching no
+        # claimable scheme); here only the dictionary gate re-checks,
+        # because the vocabulary may have grown between build and
+        # lowering.  A late fallback to the sort path stays correct
+        # precisely because the node claims nothing.
+        if not node.params.get("auto_dense"):
+            return False
+        if self.dictionary is None:
+            return False
+        limit = getattr(self.config, "auto_dense_limit", 1 << 17)
+        return 0 < len(self.dictionary) <= limit
+
     def _phys_aggs(self, schema: Schema, aggs) -> List:
         from dryad_tpu.ops.segmented import AggSpec
 
@@ -414,6 +433,34 @@ class _Builder:
                     ),
                 )
             )
+            want = K.group_carry_cols(node.schema, node.schema.names)
+            stage.ops.append(StageOp("project", dict(slot=slot, cols=want)))
+            self.cursor[node.id] = ("open", stage, slot)
+            return
+
+        # auto-dense STRING fast path: a plain group_by over one STRING
+        # key whose domain is the (bounded) context dictionary maps
+        # rows to dense codes on device and reduces on the MXU with no
+        # shuffle (ops/stringcode.py); codes decode back to the string
+        # physical words per partition.  The reference pays a full hash
+        # repartition for this query shape (DryadLinqQueryNode.cs:3581).
+        if node.kind == "group_by" and self._auto_dense_ok(node, in_schema, keys):
+            from dryad_tpu.ops.stringcode import build_tables
+
+            code_t, dec_t = build_tables(self.dictionary)
+            aggs = self._phys_aggs(in_schema, node.params["aggs"])
+            key = keys[0]
+            stage.ops.append(StageOp(
+                "string_code",
+                dict(slot=slot, h0=f"{key}#h0", h1=f"{key}#h1",
+                     out="#code", table=code_t),
+            ))
+            stage.ops.append(StageOp(
+                "group_reduce_dense",
+                dict(slot=slot, key="#code", aggs=aggs,
+                     num_buckets=code_t.num_codes, decode=dec_t,
+                     out_key=key),
+            ))
             want = K.group_carry_cols(node.schema, node.schema.names)
             stage.ops.append(StageOp("project", dict(slot=slot, cols=want)))
             self.cursor[node.id] = ("open", stage, slot)
@@ -802,9 +849,12 @@ def _rewrite_topk(roots: Sequence[Node], limit: int) -> List[Node]:
     return [rb(r) for r in roots]
 
 
-def lower(roots: Sequence[Node], config) -> StageGraph:
-    """Lower a logical DAG to a stage graph (Phase 2+3)."""
-    b = _Builder(config)
+def lower(roots: Sequence[Node], config, dictionary=None) -> StageGraph:
+    """Lower a logical DAG to a stage graph (Phase 2+3).
+
+    ``dictionary``: the context StringDictionary, enabling the
+    auto-dense STRING group_by rewrite (codes against its entries)."""
+    b = _Builder(config, dictionary)
     rewritten = _rewrite_topk(roots, getattr(config, "topk_limit", 1024))
     fanout = consumers(rewritten)
     for node in walk(rewritten):

@@ -82,7 +82,7 @@ def explain_dot(query) -> str:
     any renderer can draw it; exchanges are marked on the node)."""
     from dryad_tpu.plan.lower import lower
 
-    graph = lower([query.node], query.ctx.config)
+    graph = lower([query.node], query.ctx.config, query.ctx.dictionary)
     lines = [
         "digraph stages {",
         "  rankdir=TB; node [shape=box, fontname=\"monospace\", fontsize=10];",
@@ -111,5 +111,5 @@ def explain(query) -> str:
     """Full explain text for an API ``Query`` (logical + fused stages)."""
     from dryad_tpu.plan.lower import lower
 
-    graph = lower([query.node], query.ctx.config)
+    graph = lower([query.node], query.ctx.config, query.ctx.dictionary)
     return explain_logical([query.node]) + "\n\n" + explain_stages(graph)

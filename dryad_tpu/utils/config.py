@@ -100,6 +100,13 @@ class DryadConfig:
     # n is at or below this (each partition gathers P*n head rows);
     # larger takes keep the full range-exchange sort.
     topk_limit: int = _env_int("DRYAD_TPU_TOPK_LIMIT", 1024)
+    # Auto-dense STRING group_by: a single-STRING-key group_by with
+    # sum/count/mean aggs lowers to the MXU bucket path keyed on dense
+    # dictionary codes (ops/stringcode.py) when the context dictionary
+    # holds at most auto_dense_limit distinct strings — no shuffle at
+    # all, vs the reference's full hash repartition for the same query.
+    auto_dense_strings: bool = True
+    auto_dense_limit: int = _env_int("DRYAD_TPU_AUTO_DENSE_LIMIT", 1 << 17)
     # Device-resident input cache budget in bytes (0 disables): ingested
     # host/store tables stay sharded in HBM across submits, LRU-evicted
     # by size — the on-device analog of the ProcessService LRU block

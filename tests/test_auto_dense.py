@@ -1,0 +1,206 @@
+"""Auto-dense STRING group_by: a plain group_by over one string key
+rides the MXU bucket path keyed on dense dictionary codes — no shuffle
+(``ops/stringcode.py``; the reference pays a full hash repartition for
+the same query, ``DryadLinqQueryNode.cs:3581``)."""
+
+import numpy as np
+import pytest
+
+from dryad_tpu import DryadContext
+from dryad_tpu.plan.lower import lower
+from dryad_tpu.utils.config import DryadConfig
+
+
+def _vocab_table(rng, n=4000, vocab=97):
+    words = np.array([f"tok{i:04d}" for i in range(vocab)], object)
+    w = words[rng.integers(0, vocab, n)]
+    v = rng.standard_normal(n).astype(np.float32)
+    return {"word": w, "v": v}
+
+
+def _ops(graph):
+    return [op.kind for st in graph.stages for op in st.ops]
+
+
+def test_wordcount_auto_dense_no_shuffle(rng):
+    ctx = DryadContext(num_partitions_=8)
+    tbl = _vocab_table(rng)
+    q = ctx.from_arrays(tbl).group_by(
+        "word", {"c": ("count", None), "s": ("sum", "v"), "m": ("mean", "v")}
+    )
+    kinds = _ops(lower([q.node], ctx.config, ctx.dictionary))
+    assert "string_code" in kinds and "group_reduce_dense" in kinds
+    assert "exchange_hash" not in kinds
+
+    out = q.collect()
+    words = tbl["word"]
+    uniq, counts = np.unique(words.astype(str), return_counts=True)
+    got = dict(zip([str(w) for w in out["word"]], out["c"].tolist()))
+    assert got == dict(zip(uniq.tolist(), counts.tolist()))
+    sums = {u: float(tbl["v"][words.astype(str) == u].sum()) for u in uniq}
+    for w, s, m, c in zip(out["word"], out["s"], out["m"], out["c"]):
+        assert abs(s - sums[str(w)]) < 1e-2 * max(1.0, abs(sums[str(w)]))
+        assert abs(m - s / c) < 1e-4 * max(1.0, abs(m))
+
+
+def test_auto_dense_matches_sort_path(rng):
+    """Differential: auto-dense result == the sort-path result."""
+    tbl = _vocab_table(rng, n=3000, vocab=53)
+    on = DryadContext(num_partitions_=8)
+    off = DryadContext(
+        num_partitions_=8, config=DryadConfig(auto_dense_strings=False)
+    )
+    build = lambda c: c.from_arrays(tbl).group_by(  # noqa: E731
+        "word", {"c": ("count", None), "s": ("sum", "v")}
+    ).collect()
+    a, b = build(on), build(off)
+    ka = sorted(zip([str(w) for w in a["word"]], a["c"].tolist()))
+    kb = sorted(zip([str(w) for w in b["word"]], b["c"].tolist()))
+    assert ka == kb
+    kinds = _ops(lower(
+        [off.from_arrays(tbl).group_by("word", {"c": ("count", None)}).node],
+        off.config, off.dictionary,
+    ))
+    assert "string_code" not in kinds and "exchange_hash" in kinds
+
+
+def test_auto_dense_downstream_ops(rng):
+    """order_by / join after an auto-dense group_by stay correct (the
+    decoded key columns are real string physical words)."""
+    ctx = DryadContext(num_partitions_=8)
+    tbl = _vocab_table(rng, n=2000, vocab=31)
+    top = (
+        ctx.from_arrays(tbl)
+        .group_by("word", {"c": ("count", None)})
+        .order_by([("c", True), ("word", False)])
+        .collect()
+    )
+    counts = list(top["c"])
+    assert counts == sorted(counts, reverse=True)
+    uniq, ref = np.unique(tbl["word"].astype(str), return_counts=True)
+    assert sorted(str(w) for w in top["word"]) == sorted(uniq.tolist())
+    assert int(np.sum(top["c"])) == len(tbl["word"])
+
+    # join the aggregate back against a string table
+    right = ctx.from_arrays({"word": uniq[:10].astype(object)})
+    j = (
+        ctx.from_arrays(tbl)
+        .group_by("word", {"c": ("count", None)})
+        .join(right, "word")
+        .collect()
+    )
+    assert sorted(str(w) for w in j["word"]) == sorted(uniq[:10].tolist())
+
+
+def test_auto_dense_gates(rng):
+    """Non-dense aggs, multi-key, salt, and over-limit vocabularies all
+    fall back to the sort path."""
+    tbl = _vocab_table(rng, n=500, vocab=11)
+    tbl["k2"] = rng.integers(0, 3, 500).astype(np.int32)
+
+    def kinds_for(ctx, q):
+        return _ops(lower([q.node], ctx.config, ctx.dictionary))
+
+    ctx = DryadContext(num_partitions_=8)
+    t = ctx.from_arrays(tbl)
+    assert "string_code" not in kinds_for(
+        ctx, t.group_by("word", {"m": ("min", "v")})
+    )
+    assert "string_code" not in kinds_for(
+        ctx, t.group_by(["word", "k2"], {"c": ("count", None)})
+    )
+    assert "string_code" not in kinds_for(
+        ctx, t.group_by("word", {"s": ("sum", "v")}, salt=4)
+    )
+    small = DryadContext(
+        num_partitions_=8, config=DryadConfig(auto_dense_limit=4)
+    )
+    ts = small.from_arrays(tbl)
+    assert "string_code" not in kinds_for(
+        small, ts.group_by("word", {"c": ("count", None)})
+    )
+    # int keys are untouched by the auto path (explicit dense= exists)
+    assert "string_code" not in kinds_for(
+        ctx, t.group_by("k2", {"c": ("count", None)})
+    )
+
+
+def test_code_table_lookup_roundtrip(rng):
+    """CodeTable maps every dictionary entry to its insertion rank;
+    unknown hashes map to num_codes."""
+    from dryad_tpu.columnar.schema import StringDictionary
+    from dryad_tpu.ops.stringcode import build_tables
+
+    import jax.numpy as jnp
+
+    d = StringDictionary()
+    words = [f"w{i}" for i in range(300)]
+    for w in words:
+        d.add(w)
+    code_t, dec_t = build_tables(d)
+    assert code_t.num_codes == 300
+    h0 = jnp.asarray(dec_t.words[:, 0])
+    h1 = jnp.asarray(dec_t.words[:, 1])
+    codes = np.asarray(code_t.lookup(h0, h1))
+    assert codes.tolist() == list(range(300))
+    miss = np.asarray(
+        code_t.lookup(jnp.full((4,), 0xDEAD, jnp.uint32),
+                      jnp.full((4,), 0xBEEF, jnp.uint32))
+    )
+    assert miss.tolist() == [300] * 4
+
+
+def test_from_text_wordcount_auto_dense(rng, tmp_path):
+    """The flagship from_text wordcount shape takes the auto-dense path
+    end-to-end (tokens register in the context dictionary at ingest)."""
+    ids = rng.integers(0, 200, 3000)
+    path = tmp_path / "t.txt"
+    path.write_text(" ".join(f"w{int(i):03d}" for i in ids))
+    ctx = DryadContext(num_partitions_=8)
+    q = ctx.from_text(str(path), column="word")
+    g = q.group_by("word", {"c": ("count", None)})
+    kinds = _ops(lower([g.node], ctx.config, ctx.dictionary))
+    assert "string_code" in kinds and "exchange_hash" not in kinds
+    out = g.order_by([("c", True)]).collect()
+    assert int(np.sum(out["c"])) == 3000
+    uniq, counts = np.unique([f"w{int(i):03d}" for i in ids], return_counts=True)
+    got = dict(zip([str(w) for w in out["word"]], out["c"].tolist()))
+    assert got == dict(zip(uniq.tolist(), counts.tolist()))
+
+
+def test_auto_dense_then_shuffle_join_correct(rng):
+    """SHUFFLE-strategy join after an auto-dense group_by: the output
+    is code-range partitioned, so the node must NOT claim hash
+    partitioning — a stale claim would elide the left exchange and
+    silently drop matches (code-review regression)."""
+    ctx = DryadContext(num_partitions_=8)
+    tbl = _vocab_table(rng, n=2000, vocab=41)
+    g = ctx.from_arrays(tbl).group_by("word", {"c": ("count", None)})
+    assert g.node.partition.scheme not in ("hash", "range")
+    uniq = np.unique(tbl["word"].astype(str))
+    right = ctx.from_arrays(
+        {"word": uniq.astype(object),
+         "tag": np.arange(len(uniq), dtype=np.int32)}
+    )
+    j = g.join(right, "word", strategy="shuffle").collect()
+    assert sorted(str(w) for w in j["word"]) == sorted(uniq.tolist())
+    counts = {str(w): int(c) for w, c in zip(j["word"], j["c"])}
+    ref = {
+        str(u): int((tbl["word"].astype(str) == u).sum()) for u in uniq
+    }
+    assert counts == ref
+
+
+def test_auto_dense_table_cache_reused(rng):
+    """build_tables memoizes on the dictionary until it grows."""
+    from dryad_tpu.ops.stringcode import build_tables
+
+    ctx = DryadContext(num_partitions_=8)
+    ctx.from_arrays(_vocab_table(rng, n=100, vocab=7))
+    a = build_tables(ctx.dictionary)
+    b = build_tables(ctx.dictionary)
+    assert a[0] is b[0] and a[1] is b[1]
+    ctx.dictionary.add("brand-new-token")
+    c = build_tables(ctx.dictionary)
+    assert c[0] is not a[0]
+    assert c[0].num_codes == a[0].num_codes + 1
