@@ -69,3 +69,28 @@ def test_wordcount_end_to_end_on_chip(jaxmod):
         .collect()
     )
     assert int(np.sum(out["c"])) == 5000
+
+
+def test_auto_dense_wordcount_on_chip(jaxmod):
+    """The auto-dense STRING group_by (string_code + Pallas bucket +
+    decode) lowers and computes correctly on the chip, and the plan is
+    shuffle-free."""
+    from dryad_tpu import DryadContext
+    from dryad_tpu.plan.lower import lower
+
+    rng = np.random.default_rng(3)
+    words = np.array(
+        [f"tok{i:04d}" for i in rng.integers(0, 300, 8000)], object
+    )
+    ctx = DryadContext()
+    q = ctx.from_arrays({"w": words}).group_by("w", {"c": ("count", None)})
+    kinds = [
+        op.kind
+        for st in lower([q.node], ctx.config, ctx.dictionary).stages
+        for op in st.ops
+    ]
+    assert "string_code" in kinds and "exchange_hash" not in kinds
+    out = q.collect()
+    uniq, counts = np.unique(words.astype(str), return_counts=True)
+    got = dict(zip([str(w) for w in out["w"]], out["c"].tolist()))
+    assert got == dict(zip(uniq.tolist(), counts.tolist()))
