@@ -204,9 +204,14 @@ def _k_exchange_range(ctx: StageContext, p) -> None:
     # Splitter sample count = sample_rate fraction of the partition
     # (reference 0.1% sampler, DryadLinqSampler.cs:38-42), clamped to
     # [16, 512] so tiny partitions still elect meaningful splitters and
-    # huge ones bound the all_gather.
-    rate = float(p.get("rate", 0.001))
-    m = int(min(512, max(16, b.capacity * rate)))
+    # huge ones bound the all_gather.  An overflow retry REFINES the
+    # election alongside the capacity boost — rate and clamp scale with
+    # ctx.boost, so a retry caused by unlucky splitters (a dense value
+    # cluster the small sample missed) converges by better splitters,
+    # not just by doubling every partition's memory (the data-size
+    # recomputation of DrDynamicRangeDistributor.cpp:54-110).
+    rate = float(p.get("rate", 0.001)) * ctx.boost
+    m = int(min(512 * ctx.boost, max(16 * ctx.boost, b.capacity * rate)))
     if p.get("spread"):
         # Skew-proof variant for pure ordering (order_by): splitters
         # elected over ALL sort operands plus a uniform synthetic

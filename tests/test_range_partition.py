@@ -133,3 +133,53 @@ def test_string_order_beyond_four_byte_prefix(ctx):
     )
     out = ctx.from_arrays({"w": words}).order_by([("w", False)]).collect()
     assert out["w"].tolist() == sorted(words.tolist())
+
+
+def test_splitter_sample_count_scales_with_boost(mesh8):
+    """An overflow retry refines the splitter election: the compiled
+    retry stage samples boost-times more keys, not just boost-times the
+    capacity (DrDynamicRangeDistributor.cpp:54-110 analog)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dryad_tpu.exec.kernels import StageContext, _k_exchange_range
+    from dryad_tpu.columnar.batch import ColumnBatch
+    from dryad_tpu.ops import sort as SORT
+
+    seen = []
+    orig = SORT.sample_splitters
+
+    def spy(op, valid, P, m, axes):
+        seen.append(m)
+        return orig(op, valid, P, m, axes)
+
+    cap = 8 << 20  # per-partition 2^20: rate*cap = 1048 > 512 clamp
+    from unittest import mock
+
+    from jax.sharding import PartitionSpec as P_
+
+    shard_map = jax.shard_map
+    mesh = mesh8
+    with mock.patch.object(SORT, "sample_splitters", spy):
+        for boost in (1, 2):
+            ctx = StageContext(8, 1.2, boost)
+
+            def run(k):
+                b = ColumnBatch({"k": k}, jnp.ones((cap // 8,), jnp.bool_))
+                ctx.slots[0] = b
+                ctx.entry_caps[0] = b.capacity
+                _k_exchange_range(
+                    ctx, dict(slot=0, operands_fn=lambda bb: [bb.data["k"]],
+                              rate=0.001),
+                )
+                return ctx.slots[0].data["k"]
+
+            k = jnp.zeros((cap,), jnp.uint32)
+            jax.eval_shape(
+                lambda kk: shard_map(
+                    run, mesh=mesh, in_specs=P_("p"), out_specs=P_("p"),
+                    check_vma=False,
+                )(kk),
+                k,
+            )
+    assert seen[0] == 512 and seen[1] == 1024
