@@ -284,13 +284,48 @@ class DryadContext:
         self._bindings[node.id] = ("store", parts, schema)
         return Query(self, node)
 
-    def _from_device_batch(self, batch: ColumnBatch, schema: Schema) -> Query:
-        node = Node("input", [], schema, PartitionInfo(), source="device")
+    def _from_device_batch(
+        self, batch: ColumnBatch, schema: Schema, partition=None
+    ) -> Query:
+        """``partition``: the producing node's PartitionInfo — the batch
+        physically has that layout, so propagating it lets downstream
+        consumers elide exchanges the producer already paid for."""
+        node = Node(
+            "input", [], schema, partition or PartitionInfo(),
+            source="device",
+        )
         self._bindings[node.id] = ("device", batch)
         return Query(self, node)
 
+    def release(self, query: Query) -> None:
+        """Drop a cached device-resident table (the pin created by
+        ``Query.cache()``); later use of the query raises the
+        stale-binding error rather than recomputing silently.  Only
+        device-bound input queries qualify — releasing a source table
+        or a derived query is a caller bug, surfaced loudly."""
+        binding = self._bindings.get(query.node.id)
+        if (
+            query.node.kind != "input"
+            or binding is None
+            or binding[0] != "device"
+        ):
+            raise ValueError(
+                "release() takes the query returned by cache(); got a "
+                f"{query.node.kind!r} node bound as "
+                f"{binding[0] if binding else None!r}"
+            )
+        del self._bindings[query.node.id]
+        self._device_cache.pop(query.node.id, None)
+
     # -- execution ----------------------------------------------------------
     def _bind_device(self, node: Node) -> ColumnBatch:
+        if node.id not in self._bindings:
+            raise RuntimeError(
+                f"input node {node.id} has no binding: its device-"
+                "resident table was dropped (rebuild_mesh clears cached "
+                "tables; release() drops them explicitly) — re-run "
+                ".cache() or re-ingest"
+            )
         kind, *rest = self._bindings[node.id]
         if kind == "device":
             return rest[0]

@@ -292,9 +292,19 @@ class Query:
 
     def distinct(self, keys: Optional[KeyArg] = None) -> "Query":
         keys = _keys(keys) if keys is not None else self.schema.names
+        # Distinct over exactly one STRING column (the whole schema) is
+        # the vocabulary query — the auto-dense rewrite computes it as a
+        # shuffle-free bucket count>0 + decode; like auto-dense group_by
+        # the output is code-range partitioned, so the node claims
+        # nothing (see _auto_dense_eligible).
+        auto = (
+            self.schema.names == list(keys)
+            and self._auto_dense_eligible(keys, [("count", None, "#c")], None)
+        )
         node = Node(
             "distinct", [self.node], self.schema,
-            PartitionInfo.hashed(keys), keys=keys,
+            PartitionInfo() if auto else PartitionInfo.hashed(keys),
+            keys=keys, auto_dense=auto,
         )
         return Query(self.ctx, node)
 
@@ -963,6 +973,24 @@ class Query:
         """Execute and persist as a partitioned store (reference ToStore,
         ``DryadLinqQueryable.cs:3909``)."""
         return self.ctx.to_store(self, path)
+
+    def cache(self) -> "Query":
+        """Execute now and return a query over the DEVICE-RESIDENT
+        result: downstream queries branch from the materialized batch
+        instead of recomputing this pipeline (the reference's temp-table
+        materialization — ``ToStoreInternal`` isTemp,
+        ``DryadLinqQueryable.cs:3948`` — kept in HBM instead of DFS).
+        The cached table carries this query's partition claim, so a
+        downstream consumer with matching keys elides its exchange.
+        It does not survive ``rebuild_mesh`` (clear error on use);
+        ``ctx.release(cached)`` drops the HBM pin explicitly."""
+        if self.ctx.local_debug:
+            out = self.ctx.run_to_host(self)
+            return self.ctx.from_arrays(out, schema=self.schema)
+        batch = self.ctx._execute_device(self)
+        return self.ctx._from_device_batch(
+            batch, self.schema, partition=self.node.partition
+        )
 
 
 class JobHandle:

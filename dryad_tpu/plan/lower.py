@@ -316,6 +316,28 @@ class _Builder:
             self._materialize(node)
 
     # -- keyed (hash) ops --------------------------------------------------
+    def _emit_auto_dense(self, node: Node, stage, slot, key: str, aggs) -> None:
+        """Shared emission for auto-dense STRING rewrites (group_by and
+        vocabulary distinct): string_code -> dense bucket reduce with
+        decode -> project to the node's schema."""
+        from dryad_tpu.ops.stringcode import build_tables
+
+        code_t, dec_t = build_tables(self.dictionary)
+        stage.ops.append(StageOp(
+            "string_code",
+            dict(slot=slot, h0=f"{key}#h0", h1=f"{key}#h1",
+                 out="#code", table=code_t),
+        ))
+        stage.ops.append(StageOp(
+            "group_reduce_dense",
+            dict(slot=slot, key="#code", aggs=aggs,
+                 num_buckets=code_t.num_codes, decode=dec_t,
+                 out_key=key),
+        ))
+        want = K.group_carry_cols(node.schema, node.schema.names)
+        stage.ops.append(StageOp("project", dict(slot=slot, cols=want)))
+        self.cursor[node.id] = ("open", stage, slot)
+
     def _auto_dense_ok(self, node: Node, in_schema: Schema, keys) -> bool:
         """Gate for the auto-dense STRING group_by rewrite: one STRING
         key, dense-supported aggs over plain numeric columns, and a
@@ -404,6 +426,15 @@ class _Builder:
             self.cursor[node.id] = ("open", stage, slot)
             return
 
+        if node.kind == "distinct" and self._auto_dense_ok(node, in_schema, keys):
+            # vocabulary distinct: bucket count>0 + decode, no shuffle
+            from dryad_tpu.ops.segmented import AggSpec
+
+            self._emit_auto_dense(
+                node, stage, slot, keys[0], [AggSpec("count", None, "#c")]
+            )
+            return
+
         if node.kind == "distinct":
             if need_exchange:
                 stage.ops.append(StageOp("distinct", dict(slot=slot, keys=eq_cols)))
@@ -445,25 +476,8 @@ class _Builder:
         # physical words per partition.  The reference pays a full hash
         # repartition for this query shape (DryadLinqQueryNode.cs:3581).
         if node.kind == "group_by" and self._auto_dense_ok(node, in_schema, keys):
-            from dryad_tpu.ops.stringcode import build_tables
-
-            code_t, dec_t = build_tables(self.dictionary)
             aggs = self._phys_aggs(in_schema, node.params["aggs"])
-            key = keys[0]
-            stage.ops.append(StageOp(
-                "string_code",
-                dict(slot=slot, h0=f"{key}#h0", h1=f"{key}#h1",
-                     out="#code", table=code_t),
-            ))
-            stage.ops.append(StageOp(
-                "group_reduce_dense",
-                dict(slot=slot, key="#code", aggs=aggs,
-                     num_buckets=code_t.num_codes, decode=dec_t,
-                     out_key=key),
-            ))
-            want = K.group_carry_cols(node.schema, node.schema.names)
-            stage.ops.append(StageOp("project", dict(slot=slot, cols=want)))
-            self.cursor[node.id] = ("open", stage, slot)
+            self._emit_auto_dense(node, stage, slot, keys[0], aggs)
             return
 
         # group_by with builtin aggs or a Decomposable

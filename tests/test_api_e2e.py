@@ -346,3 +346,97 @@ def test_device_cache_invalidated_on_rebinding(rng):
         seen.append(sorted(out["k"].tolist()))
     assert seen[0] == list(range(32))
     assert seen[1] == list(range(32, 64))
+
+
+def test_cache_materializes_and_branches(rng):
+    """q.cache() executes once and downstream queries branch from the
+    device-resident result (temp-table materialization,
+    DryadLinqQueryable.cs:3948 isTemp analog)."""
+    from dryad_tpu import DryadContext
+
+    ctx = DryadContext(num_partitions_=8)
+    n = 4000
+    tbl = {"k": rng.integers(0, 100, n).astype(np.int32),
+           "v": rng.standard_normal(n).astype(np.float32)}
+    base = ctx.from_arrays(tbl).group_by(
+        "k", {"s": ("sum", "v"), "c": ("count", None)}
+    )
+    cached = base.cache()
+    jobs_after_cache = len(
+        [e for e in ctx.executor.events.events() if e["kind"] == "job_complete"]
+    )
+    a = cached.where(lambda cols: cols["c"] > 1).count()
+    b = cached.order_by([("s", True)]).take(5).collect()
+    top = cached.aggregate_as_query({"m": ("max", "s")}).collect()
+    ref_c = np.bincount(tbl["k"], minlength=100)
+    ref_s = np.bincount(tbl["k"], weights=tbl["v"], minlength=100)
+    assert a == int((ref_c[ref_c > 0] > 1).sum())
+    np.testing.assert_allclose(
+        b["s"], np.sort(ref_s[ref_c > 0])[::-1][:5], rtol=1e-4
+    )
+    assert abs(float(top["m"][0]) - ref_s[ref_c > 0].max()) < 1e-3
+    # each downstream run starts from the device binding, not the
+    # original pipeline: the group_by stage ran exactly once
+    kinds = [e["kind"] for e in ctx.executor.events.events()]
+    assert kinds.count("job_complete") >= jobs_after_cache + 3
+    starts = [
+        e for e in ctx.executor.events.events()
+        if e["kind"] == "stage_start" and "group_by" in e.get("name", "")
+    ]
+    assert len(starts) == 1
+
+
+def test_cache_local_debug(rng):
+    from dryad_tpu import DryadContext
+
+    dbg = DryadContext(local_debug=True)
+    tbl = {"k": rng.integers(0, 10, 200).astype(np.int32)}
+    c = dbg.from_arrays(tbl).group_by("k", {"n": ("count", None)}).cache()
+    out = c.order_by(["k"]).collect()
+    ref = np.bincount(tbl["k"], minlength=10)
+    assert out["n"].tolist() == [int(x) for x in ref[ref > 0]]
+
+
+def test_cache_partition_claim_elides_downstream_exchange(rng):
+    """A cached hash-partitioned result carries its claim: a downstream
+    group_by on the same key skips the shuffle."""
+    from dryad_tpu import DryadContext
+    from dryad_tpu.plan.lower import lower
+    from dryad_tpu.utils.config import DryadConfig
+
+    ctx = DryadContext(
+        num_partitions_=8, config=DryadConfig(auto_dense_strings=False)
+    )
+    tbl = {"k": rng.integers(0, 50, 2000).astype(np.int32),
+           "v": rng.standard_normal(2000).astype(np.float32)}
+    cached = ctx.from_arrays(tbl).group_by("k", {"s": ("sum", "v")}).cache()
+    follow = cached.group_by("k", {"m": ("max", "s")})
+    kinds = [
+        op.kind
+        for st in lower([follow.node], ctx.config, ctx.dictionary).stages
+        for op in st.ops
+    ]
+    assert "exchange_hash" not in kinds
+    out = follow.collect()
+    assert len(out["k"]) == len(np.unique(tbl["k"]))
+
+
+def test_cache_release_and_stale_binding_error(rng):
+    from dryad_tpu import DryadContext
+
+    ctx = DryadContext(num_partitions_=8)
+    q = ctx.from_arrays(
+        {"k": rng.integers(0, 5, 100).astype(np.int32)}
+    ).group_by("k", {"c": ("count", None)})
+    cached = q.cache()
+    assert len(cached.collect()["k"]) <= 5
+    ctx.release(cached)
+    with pytest.raises(RuntimeError, match="no binding"):
+        cached.collect()
+    # releasing a source table or a derived query is a loud error
+    src = ctx.from_arrays({"k": np.zeros(8, np.int32)})
+    with pytest.raises(ValueError, match="release"):
+        ctx.release(src)
+    c2 = src.group_by("k", {"c": ("count", None)}).cache()
+    with pytest.raises(ValueError, match="release"):
+        ctx.release(c2.where(lambda cols: cols["c"] > 0))

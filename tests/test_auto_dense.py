@@ -204,3 +204,23 @@ def test_auto_dense_table_cache_reused(rng):
     c = build_tables(ctx.dictionary)
     assert c[0] is not a[0]
     assert c[0].num_codes == a[0].num_codes + 1
+
+
+def test_distinct_auto_dense_vocabulary(rng):
+    """distinct() over a single STRING column is the vocabulary query:
+    shuffle-free bucket count>0 + decode."""
+    ctx = DryadContext(num_partitions_=8)
+    tbl = _vocab_table(rng, n=3000, vocab=67)
+    q = ctx.from_arrays({"word": tbl["word"]}).distinct()
+    kinds = _ops(lower([q.node], ctx.config, ctx.dictionary))
+    assert "string_code" in kinds and "exchange_hash" not in kinds
+    out = q.collect()
+    uniq = np.unique(tbl["word"].astype(str))
+    assert sorted(str(w) for w in out["word"]) == sorted(uniq.tolist())
+
+    # multi-column table: dense distinct does NOT apply (schema != keys)
+    q2 = ctx.from_arrays(tbl).distinct(["word"])
+    kinds2 = _ops(lower([q2.node], ctx.config, ctx.dictionary))
+    assert "string_code" not in kinds2
+    out2 = q2.collect()
+    assert sorted(str(w) for w in out2["word"]) == sorted(uniq.tolist())
