@@ -304,10 +304,11 @@ class DryadContext:
         device-bound input queries qualify — releasing a source table
         or a derived query is a caller bug, surfaced loudly."""
         binding = self._bindings.get(query.node.id)
+        cached_marker = query.node.params.get("cached")  # local_debug pin
         if (
             query.node.kind != "input"
             or binding is None
-            or binding[0] != "device"
+            or (binding[0] != "device" and not cached_marker)
         ):
             raise ValueError(
                 "release() takes the query returned by cache(); got a "

@@ -986,7 +986,11 @@ class Query:
         ``ctx.release(cached)`` drops the HBM pin explicitly."""
         if self.ctx.local_debug:
             out = self.ctx.run_to_host(self)
-            return self.ctx.from_arrays(out, schema=self.schema)
+            q = self.ctx.from_arrays(out, schema=self.schema)
+            # mark so release() honors the documented contract in the
+            # debug interpreter too (there is no HBM pin to drop)
+            q.node.params["cached"] = True
+            return q
         batch = self.ctx._execute_device(self)
         return self.ctx._from_device_batch(
             batch, self.schema, partition=self.node.partition

@@ -96,6 +96,11 @@ class LocalDebugInterpreter:
 
     # -- inputs -------------------------------------------------------------
     def _n_input(self, node: Node) -> Table:
+        if node.id not in self.ctx._bindings:
+            raise RuntimeError(
+                f"input node {node.id} has no binding: the cached table "
+                "was released — re-run .cache() or re-ingest"
+            )
         kind, *rest = self.ctx._bindings[node.id]
         if kind == "host":
             arrays, _cap = rest

@@ -207,8 +207,13 @@ def bucket_sum_count(
         cnt, sums = outs[0], list(outs[1:])
     else:
         # Pure-XLA fallback: scan over row chunks of the same
-        # factorized math (identical semantics).
-        chunk = max(8, min(32768, _round_up(block, 8)))
+        # factorized math (identical semantics).  The chunk shrinks
+        # with the hi-factor width so the per-step (chunk, a_pad)
+        # one-hot stays ~<=64MB — a huge num_buckets (the path Pallas
+        # refuses on VMEM grounds) would otherwise materialize
+        # multi-GB intermediates per scan step.
+        cap = max(8, ((64 << 20) // (4 * a_pad)) // 8 * 8)
+        chunk = max(8, min(32768, _round_up(block, 8), cap))
         npad = _round_up(max(n, chunk), chunk)
         pad_to(npad)
         nb = npad // chunk

@@ -395,6 +395,9 @@ def test_cache_local_debug(rng):
     out = c.order_by(["k"]).collect()
     ref = np.bincount(tbl["k"], minlength=10)
     assert out["n"].tolist() == [int(x) for x in ref[ref > 0]]
+    dbg.release(c)  # documented contract holds in debug mode too
+    with pytest.raises(RuntimeError, match="no binding"):
+        c.collect()
 
 
 def test_cache_partition_claim_elides_downstream_exchange(rng):
