@@ -22,6 +22,11 @@ def _rand_table(rng, n):
         # wide/exact types: int64 past 2^32, full-range float64
         "w": rng.integers(-(2 ** 52), 2 ** 52, n).astype(np.int64),
         "d": rng.standard_normal(n) * np.exp(rng.uniform(-100, 100, n)),
+        # STRING column: exercises the auto-dense dictionary-code path
+        # on device vs the host interpreter
+        "s": np.array(
+            [f"str{int(i):02d}" for i in rng.integers(0, 23, n)], object
+        ),
     }
 
 
@@ -75,6 +80,14 @@ _STEPS = {
         )
     ),
     "order_f64": (lambda q: q.order_by([("d", False), ("k", False)])),
+    "group_str": (  # terminal: auto-dense STRING group_by
+        lambda q: q.group_by(
+            "s", {"c": ("count", None), "sv": ("sum", "v")}
+        )
+    ),
+    "distinct_str": (  # terminal: vocabulary distinct (dense path)
+        lambda q: q.project(["s"]).distinct()
+    ),
     "minmax_f64": (  # terminal: float64 totalOrder min/max
         lambda q: q.group_by(
             ["k"], {"lo": ("min", "d"), "hi": ("max", "d"),
@@ -84,8 +97,10 @@ _STEPS = {
 }
 
 # steps touching the wide columns (w, d), dropped by "group_by"
-_WIDE_STEPS = {"group_wide", "order_f64", "minmax_f64"}
-_TERMINAL = {"distinct_k", "group_wide", "minmax_f64"}
+_WIDE_STEPS = {"group_wide", "order_f64", "minmax_f64",
+               "group_str", "distinct_str"}
+_TERMINAL = {"distinct_k", "group_wide", "minmax_f64",
+             "group_str", "distinct_str"}
 
 # group_by collapses the row space; cap how often it may appear so
 # pipelines keep data flowing.
@@ -101,7 +116,8 @@ def _build_pipeline(rng, depth):
         name = names[int(rng.integers(0, len(names)))]
         if name in _WIDE_STEPS and not wide_ok:
             continue
-        if name in ("group_by", "distinct_k", "group_wide", "minmax_f64"):
+        if name in ("group_by", "distinct_k", "group_wide", "minmax_f64",
+                    "group_str", "distinct_str"):
             if n_groups >= _MAX_GROUPS:
                 continue
             n_groups += 1
