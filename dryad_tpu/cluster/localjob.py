@@ -150,6 +150,7 @@ class CommandLauncher(SubprocessLauncher):
                  hosts: Optional[List[str]] = None):
         self.template = list(template or [])
         self.hosts = list(hosts or [])
+        self.forward_env = False
 
     def start(self, spec: Dict):
         host = (
@@ -157,8 +158,40 @@ class CommandLauncher(SubprocessLauncher):
             if self.hosts else "localhost"
         )
         prefix = [t.replace("{host}", host) for t in self.template]
-        spec = dict(spec, argv=prefix + list(spec["argv"]))
+        tail = list(spec["argv"])
+        if self.forward_env:
+            # materialize the worker env as `env K=V ...` argv tokens so
+            # a remote shell (ssh) starts the worker with the same
+            # environment the local launcher would have injected; every
+            # token is shell-quoted because ssh joins argv with spaces
+            # and the REMOTE shell re-parses the line — unquoted values
+            # like XLA_FLAGS='--a --b' would split, and metacharacters
+            # (PS1 with $(...), LESSOPEN with |) would execute remotely
+            import shlex
+
+            tail = ["env"] + [
+                f"{k}={v}" for k, v in sorted(spec.get("env", {}).items())
+            ] + tail
+            tail = [shlex.quote(t) for t in tail]
+        spec = dict(spec, argv=prefix + tail)
         return super().start(spec)
+
+    @classmethod
+    def ssh(cls, hosts: List[str], ssh_args: Optional[List[str]] = None):
+        """Preset for ssh-launched workers — the YARN/Peloponnese
+        remote process-group shape (``YarnJobSubmission.cs:63-111``):
+        ``ssh -tt <args> {host} env K=V ... python -m dryad_tpu.cluster.worker ...``.
+        ``-tt`` forces a remote tty so that killing the local ssh
+        client (the launcher's stop/kill escalation for a wedged
+        worker) hangs up the remote side and the worker dies with it —
+        without it sshd leaves the remote process running.
+        Requirements (interpreter + checkout on the remote path, driver
+        services bound on a routable address) are in the class
+        docstring.  The env-forwarding argv form is what the in-tree
+        template test exercises with a local stand-in."""
+        out = cls(["ssh", "-tt", *(ssh_args or []), "{host}"], hosts)
+        out.forward_env = True
+        return out
 
 
 class LocalJobSubmission:

@@ -96,7 +96,7 @@ _STEPS = {
     ),
 }
 
-# steps touching the wide columns (w, d), dropped by "group_by"
+# steps needing columns (w, d, s) that schema-rebuilding steps drop
 _WIDE_STEPS = {"group_wide", "order_f64", "minmax_f64",
                "group_str", "distinct_str"}
 _TERMINAL = {"distinct_k", "group_wide", "minmax_f64",
@@ -116,8 +116,7 @@ def _build_pipeline(rng, depth):
         name = names[int(rng.integers(0, len(names)))]
         if name in _WIDE_STEPS and not wide_ok:
             continue
-        if name in ("group_by", "distinct_k", "group_wide", "minmax_f64",
-                    "group_str", "distinct_str"):
+        if name == "group_by" or name in _TERMINAL:
             if n_groups >= _MAX_GROUPS:
                 continue
             n_groups += 1

@@ -91,3 +91,42 @@ def test_command_launcher_template():
         assert out["c"].tolist() == [20] * 5
     assert seen[0] == ["env", "DRYAD_VIA_TEMPLATE=hostA"]
     assert seen[1] == ["env", "DRYAD_VIA_TEMPLATE=hostB"]
+
+
+def test_ssh_preset_launches_gang_via_stand_in(tmp_path):
+    """CommandLauncher.ssh(): full worker env is materialized as `env
+    K=V` argv tokens behind the ssh prefix, so a remote shell boots the
+    worker identically.  A local fake-ssh (drops the hostname, execs
+    the rest) stands in for the real transport."""
+    import stat
+
+    from dryad_tpu.cluster.localjob import CommandLauncher
+
+    # emulate ssh semantics: drop option args + hostname, then join the
+    # rest with spaces and hand it to a REMOTE shell — this is exactly
+    # what makes unquoted env values split/execute, so the stand-in
+    # validates the launcher's shlex quoting end-to-end
+    fake = tmp_path / "fake_ssh"
+    fake.write_text(
+        '#!/bin/sh\n'
+        'while [ "${1#-}" != "$1" ]; do shift; done\n'
+        'shift\n'
+        'exec sh -c "$*"\n'
+    )
+    fake.chmod(fake.stat().st_mode | stat.S_IEXEC)
+
+    launcher = CommandLauncher.ssh(["nodeA", "nodeB"])
+    assert launcher.template[0] == "ssh" and launcher.forward_env
+    assert "-tt" in launcher.template
+    launcher.template[0] = str(fake)  # transport stand-in
+
+    with LocalJobSubmission(
+        num_workers=2, devices_per_worker=1, launcher=launcher
+    ) as sub:
+        ctx = DryadContext(num_partitions_=2)
+        tbl = {"k": (np.arange(60) % 3).astype(np.int32)}
+        out = sub.submit(
+            ctx.from_arrays(tbl).group_by("k", {"c": ("count", None)})
+            .order_by(["k"])
+        )
+        assert out["c"].tolist() == [20] * 3
