@@ -16,6 +16,7 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         ("wordcount.py", [], "the"),
         ("terasort.py", ["20000"], "sorted 20000 rows"),
         ("join_groupby.py", [], "region 0:"),
+        ("analytics_cached.py", [], "distinct users: 2000"),
     ],
 )
 def test_sample_runs(script, args, expect):
