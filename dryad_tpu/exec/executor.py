@@ -77,6 +77,8 @@ class GraphExecutor:
         self.events = events or EventLog(None)
         self.P = num_partitions(mesh)
         self._compiled: Dict[Tuple, Any] = {}
+        # do_while loop-state compaction programs (see _compact_loop_state)
+        self._compact_cache: Dict[Tuple, Any] = {}
         self.stats: Dict[str, StageStatistics] = {}
         # Callback used by do_while stages to run body/cond subplans.
         self.subquery_runner = subquery_runner
@@ -360,6 +362,16 @@ class GraphExecutor:
                     "do_while_device_fallback", stage=stage.id, reason=str(e)
                 )
         max_iter = p["max_iter"]
+        # Compact the loop state back to a STABLE capacity after every
+        # body round: body plans grow capacity by their slack factors,
+        # so feeding the output straight back re-compiles every
+        # iteration against monotonically growing shapes (by iteration
+        # ~20 the compiles dominate by orders of magnitude).  With
+        # compaction, iteration 2+ reuse iteration 1's compiled stages;
+        # a state that genuinely outgrows the capacity boosts it through
+        # the bounded palette, same as stage overflow retries.
+        base_pp = max(8, -(-current.capacity // self.P))
+        boost = 1
         it = 0
         while True:
             it += 1
@@ -368,10 +380,58 @@ class GraphExecutor:
                 break
             self.events.emit("do_while_iter", stage=stage.id, iter=it)
             current = self.subquery_runner(p["body"], p["schema"], current)
+            while True:
+                compacted, ovf = self._compact_loop_state(
+                    current, base_pp * boost
+                )
+                if not ovf:
+                    current = compacted
+                    break
+                if boost >= 2 ** self.config.max_shuffle_retries:
+                    raise RuntimeError(
+                        f"do_while state exceeded compaction capacity at "
+                        f"boost {boost} (base {base_pp} rows/partition)"
+                    )
+                boost *= 2
+                self.events.emit(
+                    "do_while_state_boost", stage=stage.id, boost=boost
+                )
             cont = self.subquery_runner(p["cond"], p["schema"], current, scalar=True)
             if not bool(cont):
                 break
         results[(stage.id, 0)] = current
+
+    def _compact_loop_state(self, batch: ColumnBatch, target_pp: int):
+        """One cached SPMD program per (columns signature, target):
+        per-partition compaction of valid rows to a fixed capacity,
+        returning (batch, overflowed)."""
+        import jax.numpy as jnp
+
+        from dryad_tpu.exec.kernels import _round8
+        from dryad_tpu.ops import shuffle as SH
+
+        target_pp = _round8(target_pp)
+        sig = (
+            tuple(
+                (n, str(a.dtype), a.shape[1:])
+                for n, a in sorted(batch.data.items())
+            ),
+            batch.capacity, target_pp,
+        )
+        if sig not in self._compact_cache:
+            axes = mesh_axes(self.mesh)
+
+            def fn(shard, _rep):
+                out, ovf = SH.resize(shard, target_pp)
+                # reduce across the mesh: a device-local flag would
+                # silently drop rows when only a non-primary partition
+                # overflows (same rule as build_stage_fn's psum)
+                ovf = jax.lax.psum(ovf.astype(jnp.int32), axes) > 0
+                return out, (ovf,)
+
+            self._compact_cache[sig] = compile_stage(self.mesh, fn)
+        out, (ovf,) = self._compact_cache[sig](batch, ())
+        return out, bool(ovf)
 
     def _run_apply_host(self, stage, bindings, results) -> None:
         """Host-callback Apply: pull each partition to host, run the

@@ -354,3 +354,37 @@ def test_config_validation_rejects_bad_knobs():
         with pytest.raises(ValueError):
             DryadConfig(**kw)
     DryadConfig(sample_rate=1.0)  # boundary is legal
+
+
+def _dup2(cols):
+    import jax.numpy as jnp
+
+    x = cols["x"]
+    n = x.shape[0]
+    out = jnp.stack([x, x + 1000], axis=1)
+    return {"x": out}, jnp.ones((n, 2), jnp.bool_)
+
+
+def test_do_while_growing_state_boosts_compaction(mesh8):
+    """A body that doubles the state each round outgrows the stable
+    loop capacity: compaction must BOOST (cross-mesh-reduced overflow
+    flag) and keep every row — a device-local flag would silently drop
+    rows on whichever partition overflowed first."""
+    import numpy as np
+
+    from dryad_tpu import DryadContext
+
+    ctx = DryadContext(num_partitions_=8)
+    q = ctx.from_arrays({"x": np.arange(16, dtype=np.int32)})
+
+    def body(qq):
+        return qq.select_many(_dup2, 2)
+
+    def cond(qq):
+        return qq.count_as_query().select(lambda c: {"go": c["count"] < 100})
+
+    out = q.do_while(body, cond, max_iter=10).collect()
+    # 16 -> 32 -> 64 -> 128 rows (cond false at 128)
+    assert len(out["x"]) == 128
+    kinds = [e["kind"] for e in ctx.executor.events.events()]
+    assert "do_while_state_boost" in kinds

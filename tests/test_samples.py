@@ -17,6 +17,7 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         ("terasort.py", ["20000"], "sorted 20000 rows"),
         ("join_groupby.py", [], "region 0:"),
         ("analytics_cached.py", [], "distinct users: 2000"),
+        ("pagerank_dowhile.py", [], "top node matches numpy PageRank: OK"),
     ],
 )
 def test_sample_runs(script, args, expect):
