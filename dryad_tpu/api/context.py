@@ -524,9 +524,22 @@ class DryadContext:
         input_node_id, out_q = cached[cache_key]
         self._bindings[input_node_id] = ("device", current)
         if scalar:
-            table = self.run_to_host(out_q)
-            col = next(iter(table.values()))
-            return bool(col[0]) if len(col) else False
+            # The cond output is ROW-SHARDED (its one valid row lives on
+            # one partition); in a multi-controller gang a plain host
+            # fetch of a cross-process array raises, so gather the tiny
+            # column through the collective path first.
+            batch = self._execute_device(out_q)
+            col = next(iter(batch.data.values()))
+            valid = batch.valid
+            import jax as _jax
+
+            if _jax.process_count() > 1:
+                from jax.experimental import multihost_utils as _mh
+
+                col = _mh.process_allgather(col, tiled=True)
+                valid = _mh.process_allgather(valid, tiled=True)
+            vals = np.asarray(col)[np.asarray(valid)]
+            return bool(vals[0]) if len(vals) else False
         return self._execute_device(out_q)
 
 

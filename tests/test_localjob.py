@@ -117,3 +117,35 @@ def test_persistent_fault_surfaces_as_job_failure(submission):
         driver_ctx.from_arrays(tbl).group_by("k", {"c": ("count", None)}).order_by(["k"])
     )
     assert table["c"].tolist() == [8, 8]
+
+
+def _pow2_body(q):
+    return q.select(lambda c: {"x": c["x"] * 2.0})
+
+
+def _pow2_cond(q):
+    return q.aggregate_as_query({"m": ("max", "x")}).select(
+        lambda c: {"go": c["m"] < 500.0}
+    )
+
+
+def test_do_while_across_gang(submission):
+    """DoWhile in gang mode: every worker drives the loop in lockstep
+    (deterministic cond readback + compaction boosts on its mesh
+    slice); result matches the debug interpreter."""
+    driver_ctx = DryadContext(num_partitions_=4)
+    xt = {"x": np.arange(1.0, 17.0, dtype=np.float32)}
+    q = driver_ctx.from_arrays(xt).do_while(
+        _pow2_body, _pow2_cond, max_iter=30
+    ).order_by(["x"])
+    table = submission.submit(q)
+
+    dbg = DryadContext(local_debug=True)
+    expected = (
+        dbg.from_arrays(xt)
+        .do_while(_pow2_body, _pow2_cond, max_iter=30)
+        .order_by(["x"])
+        .collect()
+    )
+    assert table["x"].tolist() == expected["x"].tolist()
+    assert float(np.max(table["x"])) >= 500.0
