@@ -448,8 +448,21 @@ class GraphExecutor:
         self.events.emit("apply_host_start", stage=stage.id)
         P = self.P
         cap = b.capacity // P
-        valid = np.asarray(b.valid)
-        host_cols = {n: np.asarray(v) for n, v in b.data.items()}
+        if jax.process_count() > 1:
+            # a plain host fetch of a cross-process array raises in a
+            # multi-controller gang; gather the batch first (apply_host
+            # is already the documented device->host perf cliff) — every
+            # process then computes all partitions deterministically
+            from jax.experimental import multihost_utils as _mh
+
+            valid = np.asarray(_mh.process_allgather(b.valid, tiled=True))
+            host_cols = {
+                n: np.asarray(_mh.process_allgather(v, tiled=True))
+                for n, v in b.data.items()
+            }
+        else:
+            valid = np.asarray(b.valid)
+            host_cols = {n: np.asarray(v) for n, v in b.data.items()}
         schema = p["schema"]
         phys = schema.device_names()
         expected = {n: _phys_np_dtype(n, schema) for n in phys}

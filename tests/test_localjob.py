@@ -149,3 +149,21 @@ def test_do_while_across_gang(submission):
     )
     assert table["x"].tolist() == expected["x"].tolist()
     assert float(np.max(table["x"])) >= 500.0
+
+
+def _square_part(part, i):
+    return {"x": part["x"] * part["x"]}
+
+
+def test_apply_host_across_gang(submission):
+    """The host-callback escape hatch works in a multi-controller gang
+    (batch gathered before the host fetch)."""
+    from dryad_tpu.columnar.schema import ColumnType, Schema
+
+    driver_ctx = DryadContext(num_partitions_=4)
+    xt = {"x": np.arange(16, dtype=np.float32)}
+    q = driver_ctx.from_arrays(xt).apply_host(
+        _square_part, Schema([("x", ColumnType.FLOAT32)])
+    ).order_by(["x"])
+    table = submission.submit(q)
+    assert table["x"].tolist() == [float(i * i) for i in range(16)]
