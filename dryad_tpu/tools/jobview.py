@@ -48,6 +48,7 @@ class JobInfo:
     completed: bool
     failed: bool
     do_while_iters: int
+    do_while_state_boost: int  # max loop-state capacity boost reached
     wall_seconds: float
 
     @property
@@ -92,6 +93,7 @@ def _fold_job(events: List[Dict[str, Any]]) -> JobInfo:
     declared = 0
     started = completed = failed = False
     iters = 0
+    state_boost = 0
     t0 = t1 = None
 
     def stage(ev) -> StageInfo:
@@ -136,8 +138,13 @@ def _fold_job(events: List[Dict[str, Any]]) -> JobInfo:
             stage(ev).stragglers += 1
         elif kind in ("do_while_iter",):
             iters = max(iters, ev.get("iter", 0))
+        elif kind == "do_while_state_boost":
+            state_boost = max(state_boost, ev.get("boost", 0))
     wall = (t1 - t0) if (t0 is not None and t1 is not None) else 0.0
-    return JobInfo(stages, declared, started, completed, failed, iters, wall)
+    return JobInfo(
+        stages, declared, started, completed, failed, iters, state_boost,
+        wall,
+    )
 
 
 def diagnose(job: JobInfo) -> List[str]:
@@ -195,6 +202,13 @@ def diagnose(job: JobInfo) -> List[str]:
     if n_ckpt:
         out.append(
             f"{n_ckpt} stage(s) served from checkpoint (resumed run)"
+        )
+    if job.do_while_state_boost >= 2:
+        out.append(
+            f"do_while loop state outgrew its capacity (boost reached "
+            f"{job.do_while_state_boost}x) — the iteration accumulates "
+            f"rows; expected for growing workloads, but repeated boosts "
+            f"recompile the loop stages"
         )
     if job.completed and not job.failed and not out:
         out.append("job completed cleanly; no anomalies")

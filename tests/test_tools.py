@@ -298,3 +298,39 @@ def test_submission_log_renders_gang_and_vertex_jobs():
     assert "gang run r4: FAILED/INCOMPLETE" in text
     assert "vertex job r2: OK" in text
     assert not ok  # run 4 crashed -> nonzero exit
+
+
+def test_jobview_reports_do_while_state_boost(rng, tmp_path):
+    """A growing DoWhile state surfaces in the diagnosis."""
+    import numpy as np
+
+    from dryad_tpu import DryadContext
+    import json
+
+    from dryad_tpu.tools.jobview import build_jobs, diagnose
+    from dryad_tpu.utils.config import DryadConfig
+    from tests.test_executor import _dup2
+
+    cfg = DryadConfig(event_log_dir=str(tmp_path))
+    ctx = DryadContext(num_partitions_=8, config=cfg)
+    q = ctx.from_arrays({"x": np.arange(16, dtype=np.int32)})
+    out = q.do_while(
+        lambda qq: qq.select_many(_dup2, 2),
+        lambda qq: qq.count_as_query().select(
+            lambda c: {"go": c["count"] < 100}
+        ),
+        max_iter=10,
+    ).collect()
+    assert len(out["x"]) == 128
+    ctx.events.close()
+    import os
+
+    path = os.path.join(str(tmp_path), sorted(os.listdir(tmp_path))[0])
+    with open(path) as fh:
+        events = [json.loads(line) for line in fh if line.strip()]
+    jobs = build_jobs(events)
+    boosted = [j for j in jobs if j.do_while_state_boost >= 2]
+    assert boosted, [j.do_while_state_boost for j in jobs]
+    assert any(
+        "outgrew its capacity" in d for j in boosted for d in diagnose(j)
+    )
