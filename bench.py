@@ -359,6 +359,19 @@ def main() -> None:
         emit({"metric": "host_baseline_rows_per_sec", "error": str(e)})
 
     try:
+        import subprocess
+
+        rev = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        ).stdout.strip()
+        if rev:
+            SUMMARY["rev"] = rev
+    except Exception:  # noqa: BLE001
+        pass
+
+    try:
         platform = init_backend()
         SUMMARY["platform"] = platform
     except Exception as e:  # noqa: BLE001
