@@ -65,9 +65,14 @@ class CodeTable:
         self.slots_h0 = slots_h0
         self.slots_h1 = slots_h1
         self.slots_code = slots_code
+        import hashlib
+
         self._fp = hash(
             (S, K, max_probe, slots_h0.tobytes(), slots_h1.tobytes())
         )
+        self._sha = hashlib.sha1(
+            slots_h0.tobytes() + slots_h1.tobytes() + slots_code.tobytes()
+        ).hexdigest()[:12]
 
     def __eq__(self, other) -> bool:
         return (
@@ -81,6 +86,15 @@ class CodeTable:
 
     def __hash__(self) -> int:
         return self._fp
+
+    def __repr__(self) -> str:
+        # content-addressed and PROCESS-STABLE (checkpoint fingerprints
+        # embed repr(param); Python hash() is per-process salted);
+        # digest frozen at init — the arrays are immutable
+        return (
+            f"CodeTable(S={self.num_slots},K={self.num_codes},"
+            f"probe={self.max_probe},sha={self._sha})"
+        )
 
     def lookup(self, h0, h1):
         """Device lookup: (n,) uint32 words -> (n,) int32 codes, misses
@@ -108,8 +122,11 @@ class DecodeTable:
     partition's row range to reconstruct the key columns."""
 
     def __init__(self, words: np.ndarray):
+        import hashlib
+
         self.words = np.ascontiguousarray(words, np.uint32)
         self._fp = hash(self.words.tobytes())
+        self._sha = hashlib.sha1(self.words.tobytes()).hexdigest()[:12]
 
     def __eq__(self, other) -> bool:
         return (
@@ -120,6 +137,9 @@ class DecodeTable:
 
     def __hash__(self) -> int:
         return self._fp
+
+    def __repr__(self) -> str:
+        return f"DecodeTable(K={len(self.words)},sha={self._sha})"
 
     def slice_rows(self, start, count: int):
         """Device gather of ``count`` code rows from ``start`` (dynamic):

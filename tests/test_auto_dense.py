@@ -224,3 +224,25 @@ def test_distinct_auto_dense_vocabulary(rng):
     assert "string_code" not in kinds2
     out2 = q2.collect()
     assert sorted(str(w) for w in out2["word"]) == sorted(uniq.tolist())
+
+
+def test_auto_dense_checkpoint_resume(rng, tmp_path):
+    """A fresh context with the same checkpoint dir restores the
+    auto-dense stage without recompute — table reprs are
+    content-addressed, not object-address-based (regression: id-based
+    repr made every context's fingerprint unique)."""
+    words = np.array([f"w{i%50:02d}" for i in range(4000)], object)
+    cfg = DryadConfig(checkpoint_dir=str(tmp_path))
+    build = lambda: (  # noqa: E731
+        DryadContext(num_partitions_=8, config=cfg)
+        .from_arrays({"w": words})
+        .group_by("w", {"c": ("count", None)})
+        .order_by(["w"])
+    )
+    r1 = build().collect()
+    q2 = build()
+    r2 = q2.collect()
+    assert [str(x) for x in r1["w"]] == [str(x) for x in r2["w"]]
+    assert r1["c"].tolist() == r2["c"].tolist()
+    kinds = [e["kind"] for e in q2.ctx.executor.events.events()]
+    assert "stage_checkpoint_hit" in kinds
