@@ -189,7 +189,11 @@ class DryadContext:
                     schema.field(name).ctype is ColumnType.STRING
                     and name in arrays
                 ):
-                    for s in np.unique(np.asarray(arrays[name]).astype(str)):
+                    # Unique the object array directly: .astype(str)
+                    # would materialize a fixed-width unicode copy of
+                    # the whole column (width = longest string) just to
+                    # throw it away.
+                    for s in np.unique(np.asarray(arrays[name], object)):
                         self.dictionary.add(str(s))
         node = Node(
             "input", [], schema, PartitionInfo.roundrobin(),

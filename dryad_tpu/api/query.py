@@ -694,13 +694,73 @@ class Query:
         defaults: Optional[Dict[str, Any]] = None,
         expansion: float = 4.0,
         strategy: str = "auto",
+        selector: Optional[Callable[["Query"], "Query"]] = None,
+        order: Optional[Sequence[OrderArg]] = None,
+        lid_col: str = "gj_lid",
+        rank_col: str = "gj_rank",
+        suffix: str = "_r",
     ) -> "Query":
-        """GroupJoin (reference ``DryadLinqQueryable`` GroupJoin): per
-        left row, aggregates over the group of matching right rows;
-        left rows with no matches survive with ``defaults`` (count-like
-        aggregates default to 0 automatically)."""
+        """GroupJoin (reference ``DryadLinqQueryable.cs`` GroupJoin
+        overloads; dispatch ``DryadLinqQueryGen.cs:3439ff``): per left
+        row, the group of exactly-matching right rows.  Three shapes:
+
+        - neither ``aggs`` nor ``selector``: match count per left row
+          (``group_join_count``).
+        - ``aggs``: aggregates over the matched group via right-side
+          pre-aggregation; unmatched lefts survive with ``defaults``
+          (count-like aggregates default to 0).
+        - ``selector``: the FULL result-selector form.  ``selector``
+          receives the expanded (left x matching-right) pairs as a
+          Query carrying every left column, the right non-key columns
+          (clashes suffixed), plus ``lid_col`` (INT32 global left-row
+          id) and ``rank_col`` (INT32 group-local position of the
+          match).  It returns a Query that keeps ``lid_col``,
+          typically one row per group — e.g.
+          ``lambda p: p.where(lambda c: c["gj_rank"] < 3)
+          .group_by("gj_lid", {"top3_sum": ("sum", "v")})`` for
+          top-k-per-key, or rank-pivot selects for concat-style
+          results.  The selector output is left-outer-joined back onto
+          the left rows, so unmatched lefts survive with ``defaults``
+          (the GroupJoin + DefaultIfEmpty composition); selector
+          columns clashing with left names get ``"_s"``.
+
+          With ``order`` (an ``order_by``-style key list over RIGHT
+          columns), ranks follow that value order within each group —
+          deterministic under any partitioning.  Without it they
+          follow the right side's engine order.
+        """
         lk = _keys(left_keys)
         rk = _keys(right_keys) if right_keys is not None else lk
+        if selector is not None:
+            if aggs:
+                raise ValueError("group_join: pass aggs OR selector, not both")
+            for c in (lid_col, rank_col):
+                # a right column with the helper name would be silently
+                # clobbered by the rank output, so reject both sides
+                if c in self.schema.names or c in other.schema.names:
+                    raise ValueError(
+                        f"group_join helper column {c!r} clashes with an "
+                        "input column; rename via lid_col=/rank_col="
+                    )
+            left2 = self.with_rank(lid_col)
+            pairs = left2._ranked_join(
+                other, lk, rk, rank_out=rank_col, order=order,
+                expansion=expansion, suffix=suffix, strategy=strategy,
+            )
+            sel = selector(pairs)
+            if lid_col not in sel.schema.names:
+                raise ValueError(
+                    f"group_join selector result must keep the {lid_col!r} "
+                    "column (one row per left-row group)"
+                )
+            out = left2.left_join(
+                sel, [lid_col], right_defaults=defaults, expansion=2.0,
+                suffix="_s", strategy=strategy,
+            )
+            keep = [
+                c for c in out.schema.names if c not in (lid_col, rank_col)
+            ]
+            return out.project(keep)
         if not aggs:
             return self.group_join_count(
                 other, lk, rk, expansion=expansion, strategy=strategy
@@ -714,6 +774,42 @@ class Query:
             right_agg, lk, rk, right_defaults=dflt, expansion=expansion,
             strategy=strategy,
         )
+
+    def _ranked_join(
+        self,
+        other: "Query",
+        left_keys: List[str],
+        right_keys: List[str],
+        rank_out: str,
+        order: Optional[Sequence[OrderArg]] = None,
+        expansion: float = 4.0,
+        suffix: str = "_r",
+        strategy: str = "auto",
+    ) -> "Query":
+        """Inner equi-join that also emits each pair's group-local match
+        rank (full GroupJoin's enumerable group)."""
+        _check_strategy(strategy)
+        self._require_cols(left_keys, "in group_join left keys")
+        other._require_cols(right_keys, "in group_join right keys")
+        ks = _order_keys(order) if order is not None else None
+        if ks is not None:
+            other._require_cols([n for n, _ in ks], "in group_join order")
+        fields = [(f.name, f.ctype) for f in self.schema.fields]
+        lnames = {f.name for f in self.schema.fields}
+        for f in other.schema.fields:
+            if f.name in right_keys:
+                continue
+            name = f.name if f.name not in lnames else f"{f.name}{suffix}"
+            fields.append((name, f.ctype))
+        fields.append((rank_out, ColumnType.INT32))
+        node = Node(
+            "join", [self.node, other.node], Schema(fields),
+            self._join_partition_info(left_keys, strategy),
+            left_keys=left_keys, right_keys=right_keys, join_kind="ranked",
+            rank_out=rank_out, order=ks, expansion=expansion, suffix=suffix,
+            strategy=strategy,
+        )
+        return Query(self.ctx, node)
 
     def _physical_row(self, values: Dict[str, Any]) -> Dict[str, Any]:
         """Encode one logical row (missing columns -> zero/empty) into

@@ -514,9 +514,15 @@ class LocalJobSubmission:
         canceled (``DrVertex.cpp:444`` RequestDuplicate,
         ``DrStageManager.h:156`` CheckForDuplicates).
 
-        Only exchange-free plans qualify (each vertex sees one input
-        partition; the union of outputs is the job output).  Plans with
-        shuffles run as one gang-scheduled SPMD program via
+        Exchange-free plans qualify directly (each vertex sees one
+        input partition; the union of outputs is the job output).  A
+        plan whose TERMINAL node is a builtin-agg ``group_by`` or a
+        scalar aggregate also qualifies: it is split into per-vertex
+        partial reduction plus a driver-side final merge — the
+        reference's machine-level partial-aggregation vertices
+        (``DrDynamicAggregateManager.h:35-168``), so speculation and
+        re-execution cover real aggregation work.  Other shuffling
+        plans run as one gang-scheduled SPMD program via
         :meth:`submit`, where lockstep collectives make mid-program
         speculation meaningless.
         """
@@ -525,7 +531,16 @@ class LocalJobSubmission:
 
         self._reap_dead_workers()
         self._sync_membership(gang=False)
-        graph = lower([query.node], query.ctx.config, query.ctx.dictionary)
+        rewrite = self._rewrite_partial_group(query)
+        if rewrite is not None:
+            run_query, merge, gate_node = rewrite
+        else:
+            run_query, merge, gate_node = query, None, query.node
+        # The gate checks what vertices actually run per-partition: for
+        # a rewritten plan, the pre-group slice (the group tail is
+        # partition-local by construction — its exchange is identity on
+        # the one-device vertex mesh).
+        graph = lower([gate_node], query.ctx.config, query.ctx.dictionary)
         for st in graph.stages:
             bad = [
                 op.kind for op in st.ops
@@ -534,8 +549,11 @@ class LocalJobSubmission:
             if bad:
                 raise ValueError(
                     f"partitioned submission requires an exchange-free "
-                    f"plan; stage {st.name!r} contains {bad} — use submit()"
+                    f"plan (or a terminal builtin-agg group_by/aggregate "
+                    f"partial); stage {st.name!r} contains {bad} — use "
+                    f"submit()"
                 )
+        query = run_query
         nparts = nparts or self._auto_fanout(query)
         self._seq += 1
         seq = self._seq
@@ -688,10 +706,130 @@ class LocalJobSubmission:
                     if p.state not in terminal:
                         self.scheduler.cancel(p)
         self.events.emit("vertex_job_complete", seq=seq)
-        return self._assemble(
+        table = self._assemble(
             query, result_rel, list(range(nparts)),
             dictionary=query.ctx.dictionary,
         )
+        if merge is not None:
+            table = self._merge_partials(table, merge)
+            self.events.emit(
+                "vertex_partials_merged", seq=seq,
+                rows=len(next(iter(table.values()), [])),
+            )
+        return table
+
+    # mergeable builtin aggregates for the partial-vertex rewrite
+    # ("first" is engine-order-dependent across vertices; excluded)
+    _MERGEABLE_AGGS = frozenset(
+        {"sum", "count", "min", "max", "mean", "any", "all"}
+    )
+
+    @staticmethod
+    def _partial_plan(agg_list):
+        """Decompose builtin aggs into per-vertex partial specs plus
+        the driver merge plan (out_name, op, partial_col_names)."""
+        partial, plan = {}, []
+        for op, col, out in agg_list:
+            if op == "mean":
+                partial[f"{out}__ps"] = ("sum", col)
+                partial[f"{out}__pc"] = ("count", None)
+                plan.append((out, "mean", (f"{out}__ps", f"{out}__pc")))
+            else:
+                partial[f"{out}__p"] = (op, col)
+                plan.append((out, op, (f"{out}__p",)))
+        return partial, plan
+
+    def _rewrite_partial_group(self, query):
+        """Split a terminal builtin-agg group_by / scalar aggregate into
+        per-vertex partials + a driver-side final merge.  Returns
+        (partial_query, merge_spec, gate_node) or None when the plan
+        does not qualify.  merge_spec: (kind, keys, plan, out_schema)
+        where plan rows are (out_name, op, partial_col_names)."""
+        from dryad_tpu.api.query import Query
+
+        node = query.node
+        agg_list = node.params.get("aggs")
+        if (
+            not agg_list
+            or node.params.get("decomposable") is not None
+            or any(op not in self._MERGEABLE_AGGS for op, _c, _o in agg_list)
+        ):
+            return None
+        if node.kind == "group_by":
+            inner = Query(query.ctx, node.inputs[0])
+            partial, plan = self._partial_plan(agg_list)
+            pq = inner.group_by(
+                list(node.params["keys"]), partial,
+                dense=node.params.get("dense"),
+                # salt= is the user's sort-path/skew escape hatch;
+                # keep honoring it on the vertex
+                salt=node.params.get("salt"),
+            )
+            return pq, (
+                "group", list(node.params["keys"]), plan, query.schema
+            ), inner.node
+        if node.kind == "aggregate":
+            inner = Query(query.ctx, node.inputs[0])
+            partial, plan = self._partial_plan(agg_list)
+            pq = inner.aggregate_as_query(partial)
+            return pq, ("aggregate", [], plan, query.schema), inner.node
+        return None
+
+    def _merge_partials(self, table, merge):
+        """Final merge of assembled per-vertex partial results on the
+        driver (the aggregation tree's root; reference
+        ``DrDynamicAggregateManager`` final vertex)."""
+        kind, keys, plan, out_schema = merge
+        cols = {k: np.asarray(v) for k, v in table.items()}
+        n = len(next(iter(cols.values()), []))
+
+        def reduce_rows(idxs):
+            row = {}
+            for out, op, pcols in plan:
+                if op == "mean":
+                    s = cols[pcols[0]][idxs].sum()
+                    c = cols[pcols[1]][idxs].sum()
+                    row[out] = s / max(int(c), 1)
+                elif op in ("sum", "count"):
+                    row[out] = cols[pcols[0]][idxs].sum()
+                elif op == "min":
+                    row[out] = cols[pcols[0]][idxs].min()
+                elif op == "max":
+                    row[out] = cols[pcols[0]][idxs].max()
+                elif op == "any":
+                    row[out] = bool(np.any(cols[pcols[0]][idxs]))
+                elif op == "all":
+                    row[out] = bool(np.all(cols[pcols[0]][idxs]))
+            return row
+
+        out: Dict[str, list] = {}
+        if kind == "aggregate":
+            # scalar: one partial row per vertex; empty-partition rows
+            # carry neutral sentinels (0 sums, +/-inf extrema), which
+            # the reductions absorb.
+            row = reduce_rows(slice(None)) if n else {}
+            out = {o: [row.get(o, 0)] for o, _op, _p in plan}
+        else:
+            index: Dict[tuple, list] = {}
+            tups = list(zip(*[cols[k].tolist() for k in keys])) if n else []
+            for i, t in enumerate(tups):
+                index.setdefault(t, []).append(i)
+            out = {k: [] for k in keys}
+            for o, _op, _p in plan:
+                out[o] = []
+            for t, idxs in index.items():
+                for k, kv in zip(keys, t):
+                    out[k].append(kv)
+                row = reduce_rows(np.asarray(idxs))
+                for o, _op, _p in plan:
+                    out[o].append(row[o])
+        result: Dict[str, np.ndarray] = {}
+        for k in keys:
+            result[k] = np.asarray(out[k], dtype=cols[k].dtype)
+        for o, _op, _p in plan:
+            dt = out_schema.field(o).ctype.numpy_dtype
+            result[o] = np.asarray(out[o]).astype(dt)
+        return result
 
     def _auto_fanout(self, query) -> int:
         """Data-size-driven task count (``DrDynamicRangeDistributor.cpp:

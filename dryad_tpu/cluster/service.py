@@ -227,9 +227,13 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                     ver, val = got
                     self._send(200, val, {"X-Version": str(ver)})
             elif parts[0] == "file" and len(parts) >= 2:
-                # per-segment unquote (clients percent-encode reserved
-                # chars; the root realpath check below still contains
-                # any reintroduced separators)
+                # Per-segment unquote: every shipped client percent-
+                # encodes (enc=1 marks the encoding generation for
+                # future format changes).  WIRE-FORMAT LOCKSTEP: a
+                # client that does NOT encode must not send literal '%'
+                # in paths — the decode here would corrupt them.  The
+                # root realpath check below still contains any
+                # reintroduced separators.
                 rel = "/".join(urllib.parse.unquote(p) for p in parts[1:])
                 offset = int(q.get("offset", ["0"])[0])
                 length = int(
@@ -286,6 +290,7 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                     import zlib
 
                     body = zlib.decompress(body)
+                # same decode + lockstep rule as do_GET
                 self.service.write_file(
                     "/".join(urllib.parse.unquote(p) for p in parts[1:]),
                     body,
@@ -417,7 +422,7 @@ class ServiceClient:
         c = self._conn()
         try:
             quoted = urllib.parse.quote(rel, safe="/")
-            url = f"/file/{quoted}?offset={offset}&length={length}"
+            url = f"/file/{quoted}?offset={offset}&length={length}&enc=1"
             if compress:
                 url += "&compress=1"
             c.request("GET", url)
@@ -463,7 +468,7 @@ class ServiceClient:
         try:
             c.request(
                 "PUT",
-                f"/file/{urllib.parse.quote(rel, safe='/')}",
+                f"/file/{urllib.parse.quote(rel, safe='/')}?enc=1",
                 body=body, headers=headers,
             )
             r = c.getresponse()

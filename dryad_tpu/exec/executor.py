@@ -84,6 +84,9 @@ class GraphExecutor:
         self.subquery_runner = subquery_runner
         self.loop_lowerer = loop_lowerer
         self._profiling = False
+        # (stage name, device int32) dictionary-miss counters awaiting
+        # their deferred readback (_check_pending_miss)
+        self._pending_miss: List[Tuple[str, Any]] = []
         self.checkpoints = (
             CheckpointStore(self.config.checkpoint_dir)
             if self.config.checkpoint_dir
@@ -159,14 +162,43 @@ class GraphExecutor:
         self._profiling = bool(self.config.profile_dir)
         # stage id -> Merkle fingerprint (None = not checkpointable)
         stage_fps: Dict[int, Optional[str]] = {}
+        # Re-entrancy (do_while subqueries) and failure hygiene: drain
+        # only the counters THIS call added; on failure discard them so
+        # a stale counter can't fail a later unrelated job.
+        mark = len(self._pending_miss)
         try:
             with profile:
                 self._execute_stages(graph, bindings, results, binding_fps, stage_fps)
+        except BaseException:
+            del self._pending_miss[mark:]
+            raise
         finally:
             if not isinstance(profile, contextlib.nullcontext):
                 self._profiling = False
+        self._check_pending_miss(mark)
         self.events.emit("job_complete")
         return results
+
+    def _check_pending_miss(self, mark: int = 0) -> None:
+        """Drain deferred dictionary-miss counters added at or after
+        ``mark`` (one readback per string_code stage, after all
+        dispatches).  A nonzero count means rows carried STRING hash
+        words absent from the context dictionary — the dense kernel
+        dropped them, so fail loudly instead of returning a silently
+        wrong aggregate."""
+        pending = self._pending_miss[mark:]
+        del self._pending_miss[mark:]
+        for name, miss in pending:
+            m = int(miss)
+            if m:
+                self.events.emit("dict_miss", stage_name=name, rows=m)
+                raise StageFailedError(
+                    f"stage {name!r}: {m} rows carry STRING values not in "
+                    "the context dictionary (fabricated at run time?); "
+                    "the dense path would drop them. Register the values "
+                    "at ingest or use group_by(salt=) to force the sort "
+                    "path."
+                )
 
     def _execute_stages(self, graph, bindings, results, binding_fps, stage_fps):
         for stage in graph.stages:
@@ -254,7 +286,7 @@ class GraphExecutor:
                 with jax.profiler.StepTraceAnnotation(
                     stage.name, step_num=version
                 ):
-                    outs, (overflow,) = fn(inputs, ())
+                    outs, (overflow, dict_miss) = fn(inputs, ())
                     # Overflow-free stages skip the host sync: their
                     # flag is statically False, so the driver moves on
                     # and JAX async dispatch overlaps this stage's
@@ -304,6 +336,10 @@ class GraphExecutor:
                 # downstream stages (jobview surfaces the distinction)
                 **({} if can_overflow else {"async": True}),
             )
+            if any(op.kind == "string_code" for op in stage.ops):
+                # Deferred readback: checked after the job drains so the
+                # dense fast path keeps its async dispatch.
+                self._pending_miss.append((stage.name, dict_miss))
             for i, out_idx in enumerate(range(len(stage.out_slots))):
                 results[(stage.id, out_idx)] = outs[i]
             if (
@@ -549,32 +585,32 @@ class GraphExecutor:
                 (b0,) = sharded_inputs
 
                 def cond(state):
-                    i, b, ovf = state
-                    couts, (covf,) = cond_fn((b,), ())
+                    i, b, ovf, _miss = state
+                    couts, (covf, _cm) = cond_fn((b,), ())
                     go = couts[0].data[cond_col][0].astype(jnp.bool_)
                     return (i < max_iter) & go & ~(ovf | covf)
 
                 def body(state):
-                    i, b, ovf = state
-                    bouts, (bovf,) = body_fn((b,), ())
-                    return (i + jnp.int32(1), bouts[0], ovf | bovf)
+                    i, b, ovf, miss = state
+                    bouts, (bovf, bmiss) = body_fn((b,), ())
+                    return (i + jnp.int32(1), bouts[0], ovf | bovf, miss + bmiss)
 
                 # DoWhile runs the body BEFORE checking cond (reference
                 # semantics, DryadLinqQueryNode.cs:4555; driver fallback
                 # below mirrors it) — so seed the loop state with one body
                 # application rather than letting lax.while_loop evaluate
                 # cond on the un-iterated input.
-                bouts0, (bovf0,) = body_fn((b0,), ())
-                it, bout, ovf = jax.lax.while_loop(
-                    cond, body, (jnp.int32(1), bouts0[0], bovf0)
+                bouts0, (bovf0, bmiss0) = body_fn((b0,), ())
+                it, bout, ovf, miss = jax.lax.while_loop(
+                    cond, body, (jnp.int32(1), bouts0[0], bovf0, bmiss0)
                 )
                 # A cond-stage overflow terminates the loop (its `go` bit
                 # is garbage) but lives only inside cond's trace; recover
                 # it by re-evaluating cond on the final state so the host
                 # retries with a larger boost instead of accepting a
                 # result whose termination decision overflowed.
-                _, (covf,) = cond_fn((bout,), ())
-                return (bout,), (ovf | covf, it)
+                _, (covf, _cm) = cond_fn((bout,), ())
+                return (bout,), (ovf | covf, it, miss)
 
             key = (
                 "do_while_device", self._stage_key(body_stage),
@@ -588,8 +624,14 @@ class GraphExecutor:
             self.events.emit(
                 "do_while_device_start", stage=stage.id, boost=boost
             )
-            (out,), (overflow, iters) = fn((current,), ())
+            (out,), (overflow, iters, miss) = fn((current,), ())
             if not bool(overflow):
+                if any(
+                    op.kind == "string_code"
+                    for s in (body_stage, cond_stage)
+                    for op in s.ops
+                ):
+                    self._pending_miss.append((stage.name, miss))
                 self.events.emit(
                     "do_while_device_done", stage=stage.id, iters=int(iters)
                 )

@@ -43,6 +43,10 @@ class StageContext:
         self.slots: Dict[int, ColumnBatch] = {}
         self.entry_caps: Dict[int, int] = {}
         self.overflow = jnp.zeros((), jnp.bool_)
+        # Rows whose STRING hash words missed the context dictionary
+        # (runtime-fabricated values the dense path would silently
+        # drop); surfaced by the executor after the job drains.
+        self.dict_miss = jnp.zeros((), jnp.int32)
 
     def bind_inputs(self, batches: Tuple[ColumnBatch, ...]) -> None:
         for i, b in enumerate(batches):
@@ -353,6 +357,16 @@ def _k_string_code(ctx: StageContext, p) -> None:
     which the dense kernel's range mask drops."""
     b = ctx.slots[p["slot"]]
     codes = p["table"].lookup(b.data[p["h0"]], b.data[p["h1"]])
+    # Out-of-dictionary rows (miss -> num_codes) would be silently
+    # dropped by the dense kernel's range mask; count them so the
+    # executor can surface the loss instead (deferred readback, no
+    # sync on the dense fast path).
+    miss = jnp.sum(
+        (b.valid & (codes >= jnp.int32(p["table"].num_codes))).astype(
+            jnp.int32
+        )
+    )
+    ctx.dict_miss = ctx.dict_miss + miss
     ctx.slots[p["slot"]] = ColumnBatch(
         {**b.data, p["out"]: codes}, b.valid
     )
@@ -514,6 +528,24 @@ def _k_group_join_count(ctx: StageContext, p) -> None:
         left, right, p["left_keys"], p["right_keys"], cap
     )
     ctx.slots[p["left_slot"]] = left.with_column(p["out"], counts)
+    ctx.overflow = ctx.overflow | ovf
+
+
+def _k_join_ranked(ctx: StageContext, p) -> None:
+    """Inner join emitting a group-local match rank (full GroupJoin's
+    enumerable group, reference ``DryadLinqQueryable.cs`` GroupJoin
+    result-selector overloads)."""
+    base = _apply_join_strategy(ctx, p)
+    left = ctx.slots[p["left_slot"]]
+    right = ctx.slots[p["right_slot"]]
+    out_cap = _round8(base * p["expansion"] * ctx.boost)
+    operands_fn = p.get("operands_fn")
+    operands = operands_fn(right) if operands_fn is not None else ()
+    out, ovf = J.hash_join_ranked(
+        left, right, p["left_keys"], p["right_keys"], out_cap,
+        p.get("suffix", "_r"), p["rank_out"], operands,
+    )
+    ctx.slots[p["left_slot"]] = out
     ctx.overflow = ctx.overflow | ovf
 
 
@@ -873,6 +905,7 @@ _KERNELS = {
     "scalar_agg": _k_scalar_agg,
     "fork": _k_fork,
     "group_join_count": _k_group_join_count,
+    "join_ranked": _k_join_ranked,
     "zip": _k_zip,
     "sliding_window": _k_sliding_window,
 }
@@ -895,6 +928,7 @@ def build_stage_fn(stage, P: int, slack: float, boost: int,
         # mesh so the replicated output is truly uniform (a silently
         # device-local flag loses rows without tripping the retry).
         overflow = jax.lax.psum(ctx.overflow.astype(jnp.int32), axes) > 0
-        return outs, (overflow,)
+        miss = jax.lax.psum(ctx.dict_miss, axes)
+        return outs, (overflow, miss)
 
     return fn

@@ -324,22 +324,38 @@ class LocalDebugInterpreter:
             if kind == "anti":
                 mask = ~mask
             return _take_rows(lt, mask)
+        rorder = range(len(rtup))
+        if kind == "ranked" and node.params.get("order"):
+            # Rank order: sort right rows by the requested value order
+            # (stable), so match lists enumerate value-ordered.
+            import jax.numpy as jnp
+
+            operands_fn = K.ordering_operands(
+                right.schema, [tuple(k) for k in node.params["order"]]
+            )
+            n = _rows(rt)
+            b = ColumnBatch(
+                {k: jnp.asarray(v) for k, v in rt.items()}, np.ones(n, bool)
+            )
+            ops = [np.asarray(o) for o in operands_fn(b)]
+            rorder = np.lexsort(list(reversed(ops)))
         index: Dict[tuple, List[int]] = {}
-        for j, k in enumerate(rtup):
-            index.setdefault(k, []).append(j)
+        for j in rorder:
+            index.setdefault(rtup[j], []).append(j)
         if kind == "count":
             counts = np.array([len(index.get(k, ())) for k in ltup], np.int32)
             out = {c: np.asarray(v) for c, v in lt.items()}
             out[node.params["out"]] = counts
             return out
-        li, ri = [], []
+        li, ri, ranks = [], [], []
         outer = kind == "left"
         defaults = node.params.get("right_defaults") or {}
         for i, k in enumerate(ltup):
             matches = index.get(k, ())
-            for j in matches:
+            for r, j in enumerate(matches):
                 li.append(i)
                 ri.append(j)
+                ranks.append(r)
             if outer and not matches:
                 li.append(i)
                 ri.append(-1)  # sentinel: default-valued right row
@@ -360,6 +376,8 @@ class LocalDebugInterpreter:
                 np.asarray(defaults.get(c, 0), a.dtype), (1,) + a.shape[1:]
             )
             out[name] = np.concatenate([a, pad])[ri_arr]
+        if kind == "ranked":
+            out[node.params["rank_out"]] = np.asarray(ranks, np.int32)
         return out
 
     def _n_zip(self, node: Node) -> Table:

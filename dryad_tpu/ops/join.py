@@ -20,6 +20,7 @@ import jax.numpy as jnp
 
 from dryad_tpu.columnar.batch import ColumnBatch
 from dryad_tpu.ops.hash import hash_columns
+from dryad_tpu.ops.sort import sort_order_by_operands
 from dryad_tpu.ops.sortkeys import sort_order
 
 
@@ -60,10 +61,12 @@ def _probe_ranges(
 
 def _expand_pairs(
     start: jax.Array, counts: jax.Array, out_capacity: int
-) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """Enumerate candidate (left_row, right_row) pairs into fixed slots.
 
-    Returns (left_idx, right_idx, pair_valid, overflow).
+    Returns (left_idx, right_idx, pair_valid, overflow, offsets) where
+    ``offsets[i]`` is the first slot of left row i's candidate range
+    (slots for one left row are contiguous).
     """
     n = counts.shape[0]
     offsets = jnp.concatenate(
@@ -79,7 +82,7 @@ def _expand_pairs(
     within = slots - offsets[li].astype(jnp.int32)
     pair_valid = slots < total
     ri = start[li].astype(jnp.int32) + within
-    return li, ri, pair_valid, overflow
+    return li, ri, pair_valid, overflow, offsets
 
 
 def hash_join(
@@ -97,7 +100,7 @@ def hash_join(
     with left names get ``suffix``).  Returns (batch, overflow).
     """
     rs, lhash, start, counts = _probe_ranges(left, right, left_keys, right_keys)
-    li, ri, pair_valid, overflow = _expand_pairs(start, counts, out_capacity)
+    li, ri, pair_valid, overflow, _ = _expand_pairs(start, counts, out_capacity)
 
     data: Dict[str, jax.Array] = {}
     for name, col in left.data.items():
@@ -144,7 +147,7 @@ def hash_join_outer(
     the unmatched tail is statically reserved so it can never overflow.
     """
     rs, lhash, start, counts = _probe_ranges(left, right, left_keys, right_keys)
-    li, ri, pair_valid, overflow = _expand_pairs(start, counts, out_capacity)
+    li, ri, pair_valid, overflow, _ = _expand_pairs(start, counts, out_capacity)
     exact = _exact_pair_match(left, rs, left_keys, right_keys, li, ri, pair_valid)
 
     # Per-left-row exact-match count -> unmatched mask for the tail.
@@ -182,11 +185,69 @@ def group_join_counts(
     """Per-left-row count of exactly-matching right rows (GroupJoin's
     shape; aggregations over the group compose on the joined output)."""
     rs, _lhash, start, counts = _probe_ranges(left, right, left_keys, right_keys)
-    li, ri, pair_valid, overflow = _expand_pairs(start, counts, out_capacity)
+    li, ri, pair_valid, overflow, _ = _expand_pairs(start, counts, out_capacity)
     exact = _exact_pair_match(left, rs, left_keys, right_keys, li, ri, pair_valid)
     n = left.capacity
     cnt = jnp.zeros((n,), jnp.int32).at[li].add(exact.astype(jnp.int32), mode="drop")
     return cnt, overflow
+
+
+def hash_join_ranked(
+    left: ColumnBatch,
+    right: ColumnBatch,
+    left_keys: Sequence[str],
+    right_keys: Sequence[str],
+    out_capacity: int,
+    suffix: str = "_r",
+    rank_name: str = "gj_rank",
+    order_operands: Sequence[jax.Array] = (),
+) -> Tuple[ColumnBatch, jax.Array]:
+    """Inner equi-join that also emits each pair's group-local rank —
+    the position of the matching right row within its left row's match
+    group, as an INT32 column.  This is full GroupJoin's enumerable
+    group (reference ``DryadLinqQueryable.cs`` GroupJoin overloads with
+    a result selector): downstream segmented selection over
+    (left-row-id, rank) expresses top-k-per-key and concat-style
+    selectors.
+
+    With ``order_operands`` (uint32 sort operands over the UNSORTED
+    right batch, e.g. from ``plan.keys.ordering_operands``), ranks
+    follow that value order within each group — deterministic across
+    partitionings.  Without, ranks follow the right side's engine order.
+    """
+    if len(order_operands):
+        pre = sort_order_by_operands(order_operands, right.valid)
+        right = right.take(pre)
+    # _probe_ranges' argsort is stable, so the operand order survives
+    # within each equal-hash run.
+    rs, lhash, start, counts = _probe_ranges(left, right, left_keys, right_keys)
+    li, ri, pair_valid, overflow, offsets = _expand_pairs(
+        start, counts, out_capacity
+    )
+    exact = _exact_pair_match(left, rs, left_keys, right_keys, li, ri, pair_valid)
+
+    # Group-local rank among EXACT matches: a left row's candidate
+    # slots are contiguous ([offsets[i], offsets[i]+counts[i])), so the
+    # rank is the count of exact slots in [offsets[li], slot] minus 1.
+    # Hash-collision candidates inside the range fail `exact` and are
+    # skipped by the subtraction.
+    cs = jnp.cumsum(exact.astype(jnp.int32))
+    seg = offsets[li].astype(jnp.int32)
+    before = jnp.where(
+        seg > 0, cs[jnp.clip(seg - 1, 0, out_capacity - 1)], 0
+    )
+    rank = jnp.where(exact, cs - 1 - before, 0).astype(jnp.int32)
+
+    data: Dict[str, jax.Array] = {}
+    for name, col in left.data.items():
+        data[name] = col[li]
+    rk = set(right_keys)
+    for name, col in rs.data.items():
+        if name in rk:
+            continue
+        data[_suffixed(name, suffix) if name in data else name] = col[ri]
+    data[rank_name] = rank
+    return ColumnBatch(data, exact), overflow
 
 
 def exists_mask(

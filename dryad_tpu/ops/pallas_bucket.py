@@ -186,6 +186,14 @@ def bucket_sum_count(
             ]
 
     R = _row_block(a_pad, len(values))
+    if interpret is True and (pl is None or R is None):
+        # An explicit interpret=True means the caller wants the Pallas
+        # kernel exercised; silently taking the XLA fallback would stop
+        # tests from covering it with no signal.
+        raise ValueError(
+            "bucket_sum_count: interpret=True requested but the Pallas "
+            f"path is refused ({'pallas unavailable' if pl is None else f'VMEM budget: a_pad={a_pad}, n_vals={len(values)}'})"
+        )
     use_pallas = pl is not None and R is not None and (
         interpret is True or (interpret is None and _on_tpu())
     )

@@ -672,6 +672,28 @@ class _Builder:
                     ),
                 )
             )
+        elif jk == "ranked":
+            order = node.params.get("order")
+            operands_fn = (
+                K.ordering_operands(right.schema, list(order)) if order else None
+            )
+            stage.ops.append(
+                StageOp(
+                    "join_ranked",
+                    dict(
+                        left_slot=0,
+                        right_slot=1,
+                        left_keys=lkeys,
+                        right_keys=rkeys,
+                        rank_out=node.params["rank_out"],
+                        operands_fn=operands_fn,
+                        expansion=node.params.get("expansion", 1.0),
+                        suffix=node.params.get("suffix", "_r"),
+                        **strat_params,
+                    ),
+                )
+            )
+            stage.growth = max(1.0, node.params.get("expansion", 1.0))
         elif jk in ("inner", "left"):
             stage.ops.append(
                 StageOp(

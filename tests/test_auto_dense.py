@@ -246,3 +246,24 @@ def test_auto_dense_checkpoint_resume(rng, tmp_path):
     assert r1["c"].tolist() == r2["c"].tolist()
     kinds = [e["kind"] for e in q2.ctx.executor.events.events()]
     assert "stage_checkpoint_hit" in kinds
+
+
+def test_dict_miss_surfaced_not_dropped(rng):
+    """Rows whose STRING hash words miss the context dictionary (e.g.
+    fabricated by apply_host after ingest) fail loudly instead of being
+    silently dropped by the dense kernel's range mask."""
+    from dryad_tpu.exec.executor import StageFailedError
+
+    ctx = DryadContext(num_partitions_=8)
+    tbl = _vocab_table(rng, n=400, vocab=13)
+    q = ctx.from_arrays(tbl)
+
+    def poison(table, _pi):
+        t = {k: np.asarray(v).copy() for k, v in table.items()}
+        # fabricate hash words no dictionary entry ever produced
+        t["word#h0"] = t["word#h0"] ^ np.uint32(0xDEADBEEF)
+        return t
+
+    bad = q.apply_host(poison).group_by("word", {"c": ("count", None)})
+    with pytest.raises(StageFailedError, match="dictionary"):
+        bad.collect()

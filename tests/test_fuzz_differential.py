@@ -94,6 +94,20 @@ _STEPS = {
                     "c": ("count", None)}
         )
     ),
+    "gj_selector": (  # full GroupJoin: top-3-per-key self-join selector
+        lambda q: q.project(["k", "g", "v"]).group_join(
+            q.project(["k", "v"]), "k",
+            # self-join on a 9-value key: pair count ~n^2/9, far past
+            # the default 4x expansion budget
+            expansion=64.0,
+            order=[("v", False)],
+            selector=lambda p: p.where(lambda c: c["gj_rank"] < 3).group_by(
+                "gj_lid", {"t3": ("sum", "v_r"), "c3": ("count", None)}
+            ),
+            defaults={"t3": 0.0, "c3": 0},
+        ).select(lambda c: {"k": c["k"], "g": c["g"],
+                            "v": c["v"] + c["t3"] + c["c3"]})
+    ),
 }
 
 # steps needing columns (w, d, s) that schema-rebuilding steps drop
@@ -116,13 +130,13 @@ def _build_pipeline(rng, depth):
         name = names[int(rng.integers(0, len(names)))]
         if name in _WIDE_STEPS and not wide_ok:
             continue
-        if name == "group_by" or name in _TERMINAL:
+        if name in ("group_by", "gj_selector") or name in _TERMINAL:
             if n_groups >= _MAX_GROUPS:
                 continue
             n_groups += 1
         # select/group/project steps rebuild the schema without w/d
         if name in ("group_by", "select_double", "select_shift",
-                    "order_take"):
+                    "order_take", "gj_selector"):
             wide_ok = False
         steps.append(name)
         if name in _TERMINAL:

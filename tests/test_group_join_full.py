@@ -1,0 +1,259 @@
+"""Full GroupJoin (result-selector form) — reference
+``DryadLinqQueryable.cs`` GroupJoin overloads with a selector over the
+matched right *sequence* (dispatch ``DryadLinqQueryGen.cs:3439ff``).
+
+The selector receives the expanded pairs with a global left-row id and
+a group-local match rank, so top-k-per-key, rank-pivot (concat-style),
+and left-outer DefaultIfEmpty idioms all express directly.  Differential
+against the LocalDebug oracle.
+"""
+
+import numpy as np
+import pytest
+
+from dryad_tpu import ColumnType, DryadContext, Schema
+from oracle import check
+
+
+@pytest.fixture
+def ctx(mesh8):
+    return DryadContext(num_partitions_=8)
+
+
+@pytest.fixture
+def dbg():
+    return DryadContext(local_debug=True)
+
+
+def _sides(rng=None, nl=40, nr=200, keys=12):
+    rng = rng or np.random.default_rng(7)
+    left = {
+        "k": np.arange(nl, dtype=np.int32) % keys,
+        "lv": np.arange(nl, dtype=np.int32) * 10,
+    }
+    right = {
+        "k": rng.integers(0, keys, nr).astype(np.int32),
+        "rv": rng.standard_normal(nr).astype(np.float32),
+        "w": rng.integers(0, 1000, nr).astype(np.int32),
+    }
+    return left, right
+
+
+def test_selector_full_group_agg(ctx, dbg):
+    """Aggregate over the whole matched group via the selector path."""
+    left, right = _sides()
+
+    def q(c):
+        return (
+            c.from_arrays(left)
+            .group_join(
+                c.from_arrays(right), "k",
+                selector=lambda p: p.group_by(
+                    "gj_lid", {"n": ("count", None), "s": ("sum", "rv")}
+                ),
+                defaults={"n": 0, "s": 0.0},
+            )
+            .collect()
+        )
+
+    check(q(ctx), q(dbg))
+    got = q(ctx)
+    # Cross-check against a host groupby.
+    import collections
+
+    sums = collections.defaultdict(float)
+    cnts = collections.defaultdict(int)
+    for k, rv in zip(right["k"], right["rv"]):
+        sums[int(k)] += float(rv)
+        cnts[int(k)] += 1
+    for k, n, s in zip(got["k"], got["n"], got["s"]):
+        assert int(n) == cnts.get(int(k), 0)
+        np.testing.assert_allclose(s, sums.get(int(k), 0.0), rtol=1e-4)
+
+
+def test_selector_topk_per_key_ordered(ctx, dbg):
+    """Top-2 rv per left row, value-ordered ranks (order= makes the
+    rank deterministic under any partitioning)."""
+    left, right = _sides()
+
+    def q(c):
+        return (
+            c.from_arrays(left)
+            .group_join(
+                c.from_arrays(right), "k",
+                order=[("rv", True)],  # descending rv
+                selector=lambda p: p.where(lambda c_: c_["gj_rank"] < 2)
+                .group_by("gj_lid", {"top2": ("sum", "rv"), "nn": ("count", None)}),
+                defaults={"top2": 0.0, "nn": 0},
+            )
+            .collect()
+        )
+
+    check(q(ctx), q(dbg))
+    got = q(ctx)
+    by_key = {}
+    for k, rv in zip(right["k"], right["rv"]):
+        by_key.setdefault(int(k), []).append(float(rv))
+    for k, t2 in zip(got["k"], got["top2"]):
+        exp = sum(sorted(by_key.get(int(k), []), reverse=True)[:2])
+        np.testing.assert_allclose(t2, exp, rtol=1e-4)
+
+
+def test_selector_rank_pivot_concat_style(ctx, dbg):
+    """Concat-style: pivot the first 3 value-ordered matches into wide
+    columns w_0..w_2 (the columnar image of concatenating the group)."""
+    left, right = _sides(nl=24, nr=90, keys=8)
+
+    def sel(p):
+        import jax.numpy as jnp
+
+        def widen(cols):
+            out = {"gj_lid": cols["gj_lid"]}
+            for j in range(3):
+                hit = cols["gj_rank"] == j
+                out[f"w_{j}"] = jnp.where(hit, cols["w"], 0).astype(jnp.int32)
+            return out
+
+        sc = Schema(
+            [("gj_lid", ColumnType.INT32)]
+            + [(f"w_{j}", ColumnType.INT32) for j in range(3)]
+        )
+        return p.select(widen, schema=sc).group_by(
+            "gj_lid", {f"w_{j}": ("sum", f"w_{j}") for j in range(3)}
+        )
+
+    def q(c):
+        return (
+            c.from_arrays(left)
+            .group_join(
+                c.from_arrays(right), "k",
+                order=[("w", False)],  # ascending w: w_0 <= w_1 <= w_2
+                selector=sel,
+                defaults={f"w_{j}": 0 for j in range(3)},
+            )
+            .collect()
+        )
+
+    check(q(ctx), q(dbg))
+    got = q(ctx)
+    by_key = {}
+    for k, w in zip(right["k"], right["w"]):
+        by_key.setdefault(int(k), []).append(int(w))
+    for i in range(len(got["k"])):
+        exp = sorted(by_key.get(int(got["k"][i]), []))[:3]
+        exp += [0] * (3 - len(exp))
+        assert [int(got[f"w_{j}"][i]) for j in range(3)] == exp
+
+
+def test_selector_left_outer_defaults(ctx, dbg):
+    """Left rows with no matches survive with defaults (GroupJoin +
+    DefaultIfEmpty), and every left row appears exactly once."""
+    left = {
+        "k": np.array([0, 1, 2, 3, 4], np.int32),
+        "lv": np.array([5, 6, 7, 8, 9], np.int32),
+    }
+    right = {
+        "k": np.array([1, 1, 3], np.int32),
+        "rv": np.array([2.0, 4.0, 10.0], np.float32),
+    }
+
+    def q(c):
+        return (
+            c.from_arrays(left)
+            .group_join(
+                c.from_arrays(right), "k",
+                selector=lambda p: p.group_by("gj_lid", {"s": ("sum", "rv")}),
+                defaults={"s": -1.0},
+            )
+            .collect()
+        )
+
+    check(q(ctx), q(dbg))
+    got = q(ctx)
+    assert sorted(got["k"].tolist()) == [0, 1, 2, 3, 4]
+    by_k = dict(zip(got["k"].tolist(), got["s"].tolist()))
+    np.testing.assert_allclose(by_k[1], 6.0, rtol=1e-5)
+    np.testing.assert_allclose(by_k[3], 10.0, rtol=1e-5)
+    for k in (0, 2, 4):
+        assert by_k[k] == -1.0
+
+
+def test_selector_broadcast_strategy(ctx, dbg):
+    left, right = _sides(nl=30, nr=60, keys=6)
+
+    def q(c):
+        return (
+            c.from_arrays(left)
+            .group_join(
+                c.from_arrays(right), "k",
+                strategy="broadcast",
+                order=[("rv", False)],
+                selector=lambda p: p.where(lambda c_: c_["gj_rank"] == 0)
+                .group_by("gj_lid", {"mn": ("sum", "rv")}),
+                defaults={"mn": 0.0},
+            )
+            .collect()
+        )
+
+    check(q(ctx), q(dbg))
+    got = q(ctx)
+    by_key = {}
+    for k, rv in zip(right["k"], right["rv"]):
+        by_key.setdefault(int(k), []).append(float(rv))
+    for k, mn in zip(got["k"], got["mn"]):
+        exp = min(by_key.get(int(k), [0.0]))
+        if int(k) in by_key:
+            np.testing.assert_allclose(mn, exp, rtol=1e-4)
+
+
+def test_selector_keeps_left_columns(ctx, dbg):
+    """Left payload columns ride through untouched; selector output
+    clashing with a left name gets the _s suffix."""
+    left = {
+        "k": np.array([0, 1, 1], np.int32),
+        "s": np.array([100, 200, 300], np.int32),  # clashes with selector's "s"
+    }
+    right = {"k": np.array([1, 1], np.int32), "rv": np.array([1.5, 2.5], np.float32)}
+
+    def q(c):
+        return (
+            c.from_arrays(left)
+            .group_join(
+                c.from_arrays(right), "k",
+                selector=lambda p: p.group_by("gj_lid", {"s": ("sum", "rv")}),
+                defaults={"s": 0.0},
+            )
+            .collect()
+        )
+
+    check(q(ctx), q(dbg))
+    got = q(ctx)
+    assert "s_s" in got and "s" in got
+    assert sorted(got["s"].tolist()) == [100, 200, 300]
+    by_lv = dict(zip(got["s"].tolist(), got["s_s"].tolist()))
+    np.testing.assert_allclose(by_lv[200], 4.0, rtol=1e-5)
+    np.testing.assert_allclose(by_lv[300], 4.0, rtol=1e-5)
+    assert by_lv[100] == 0.0
+
+
+def test_ranked_join_rank_set_engine_order(ctx, dbg):
+    """Without order=, ranks are engine-order but each group's rank set
+    is exactly {0..count-1}."""
+    left, right = _sides(nl=16, nr=64, keys=4)
+
+    def q(c):
+        l2 = c.from_arrays(left).with_rank("gj_lid")
+        return l2._ranked_join(
+            c.from_arrays(right), ["k"], ["k"], rank_out="gj_rank"
+        ).collect()
+
+    got = q(ctx)
+    by_lid = {}
+    for lid, r in zip(got["gj_lid"], got["gj_rank"]):
+        by_lid.setdefault(int(lid), []).append(int(r))
+    counts = {}
+    for k in right["k"]:
+        counts[int(k)] = counts.get(int(k), 0) + 1
+    lid_to_key = dict(zip(got["gj_lid"].tolist(), got["k"].tolist()))
+    for lid, ranks in by_lid.items():
+        assert sorted(ranks) == list(range(counts[lid_to_key[lid]]))

@@ -123,8 +123,10 @@ def test_huge_bucket_count_uses_fallback(rng):
     n = 2000
     k = rng.integers(0, big, n).astype(np.int32)
     v = np.ones(n, np.float32)
-    sums, cnt = bucket_sum_count(
-        k, [v], np.ones(n, bool), big, interpret=True
-    )
+    # An EXPLICIT interpret=True must not silently take the fallback
+    # when the Pallas path is refused on VMEM grounds (advisor r3).
+    with pytest.raises(ValueError, match="VMEM"):
+        bucket_sum_count(k, [v], np.ones(n, bool), big, interpret=True)
+    sums, cnt = bucket_sum_count(k, [v], np.ones(n, bool), big)
     assert float(cnt.sum()) == n
     np.testing.assert_allclose(np.asarray(sums[0]), np.asarray(cnt))

@@ -126,11 +126,117 @@ def test_worker_death_survivors_finish_vertex_job():
 
 
 def test_exchange_plan_rejected(submission):
-    """Plans with shuffles are gang-SPMD jobs; partitioned submission
-    must refuse them rather than compute wrong per-partition groups."""
+    """Plans with shuffles (beyond the terminal-group partial rewrite)
+    are gang-SPMD jobs; partitioned submission must refuse them rather
+    than compute wrong per-partition results."""
     ctx = DryadContext(num_partitions_=1)
-    q = ctx.from_arrays({"k": np.arange(8, dtype=np.int32)}).group_by(
-        "k", {"c": ("count", None)}
+    q = ctx.from_arrays({"k": np.arange(8, dtype=np.int32)}).order_by(
+        [("k", False)]
     )
     with pytest.raises(ValueError, match="exchange-free"):
         submission.submit_partitioned(q)
+    # group_by with an engine-order-dependent agg ("first") cannot be
+    # merged across vertices either
+    q2 = ctx.from_arrays(
+        {"k": np.arange(8, dtype=np.int32),
+         "v": np.ones(8, np.float32)}
+    ).group_by("k", {"f": ("first", "v")})
+    with pytest.raises(ValueError, match="exchange-free"):
+        submission.submit_partitioned(q2)
+
+
+def _group_query(n: int = 4000):
+    """A terminal builtin-agg group_by: runs as per-vertex PARTIAL
+    reduction + driver-side final merge (DrDynamicAggregateManager
+    machine-level partials)."""
+    rng = np.random.default_rng(11)
+    tbl = {
+        "k": rng.integers(0, 20, n).astype(np.int32),
+        "v": rng.standard_normal(n).astype(np.float32),
+    }
+    ctx = DryadContext(num_partitions_=1)
+    q = ctx.from_arrays(tbl).group_by(
+        "k", {"c": ("count", None), "s": ("sum", "v"),
+              "mn": ("min", "v"), "m": ("mean", "v")}
+    )
+    return q, tbl
+
+
+def _expected_groups(tbl):
+    exp = {}
+    for k in np.unique(tbl["k"]):
+        vs = tbl["v"][tbl["k"] == k]
+        exp[int(k)] = (len(vs), float(vs.sum()), float(vs.min()),
+                       float(vs.mean()))
+    return exp
+
+
+def test_partitioned_group_by_partials(submission):
+    q, tbl = _group_query()
+    out = submission.submit_partitioned(q, nparts=6)
+    exp = _expected_groups(tbl)
+    assert sorted(out["k"].tolist()) == sorted(exp)
+    for k, c, s, mn, m in zip(out["k"], out["c"], out["s"], out["mn"], out["m"]):
+        ec, es, emn, em = exp[int(k)]
+        assert int(c) == ec
+        np.testing.assert_allclose(s, es, rtol=1e-4)
+        np.testing.assert_allclose(mn, emn, rtol=1e-5)
+        np.testing.assert_allclose(m, em, rtol=1e-4)
+    kinds = [e["kind"] for e in submission.events.events()]
+    assert "vertex_partials_merged" in kinds
+
+
+def test_partitioned_group_by_straggler_duplicated(submission):
+    """A group_by partial vertex that straggles is speculatively
+    duplicated and the merged result is still correct."""
+    q, tbl = _group_query()
+    submission.submit_partitioned(q, nparts=4)  # warm caches
+
+    submission.inject_delay(worker=0, seconds=DELAY, count=1)
+    t0 = time.monotonic()
+    out = submission.submit_partitioned(q, nparts=4)
+    dt = time.monotonic() - t0
+
+    exp = _expected_groups(tbl)
+    assert sorted(out["k"].tolist()) == sorted(exp)
+    for k, c, s in zip(out["k"], out["c"], out["s"]):
+        ec, es, _, _ = exp[int(k)]
+        assert int(c) == ec
+        np.testing.assert_allclose(s, es, rtol=1e-4)
+    assert dt < DELAY - 1.0, f"job took {dt:.1f}s, straggler not bypassed"
+    kinds = [e["kind"] for e in submission.events.events()]
+    assert "vertex_duplicate" in kinds and "vertex_duplicate_win" in kinds
+
+
+def test_partitioned_scalar_aggregate_partials(submission):
+    rng = np.random.default_rng(13)
+    tbl = {"v": rng.standard_normal(3000).astype(np.float32)}
+    ctx = DryadContext(num_partitions_=1)
+    q = ctx.from_arrays(tbl).aggregate_as_query(
+        {"s": ("sum", "v"), "n": ("count", None),
+         "lo": ("min", "v"), "m": ("mean", "v")}
+    )
+    out = submission.submit_partitioned(q, nparts=5)
+    assert len(out["s"]) == 1
+    np.testing.assert_allclose(out["s"][0], tbl["v"].sum(), rtol=1e-4)
+    assert int(out["n"][0]) == 3000
+    np.testing.assert_allclose(out["lo"][0], tbl["v"].min(), rtol=1e-5)
+    np.testing.assert_allclose(out["m"][0], tbl["v"].mean(), rtol=1e-4)
+
+
+def test_partitioned_rejects_mid_plan_group_by(submission):
+    """Only a TERMINAL group_by qualifies for the partial rewrite: a
+    group_by feeding further ops would be merged too late."""
+    rng = np.random.default_rng(17)
+    tbl = {
+        "k": rng.integers(0, 20, 500).astype(np.int32),
+        "v": rng.standard_normal(500).astype(np.float32),
+    }
+    ctx = DryadContext(num_partitions_=1)
+    q = (
+        ctx.from_arrays(tbl)
+        .group_by("k", {"s": ("sum", "v")})
+        .where(_even)
+    )
+    with pytest.raises(ValueError, match="use submit"):
+        submission.submit_partitioned(q, nparts=4)

@@ -1,0 +1,128 @@
+"""Real WebHDFS REST protocol: client + provider against the in-tree
+protocol stub server (``tools/webhdfs_stub.py``), which plays both the
+namenode and datanode roles with faithful 307 redirects.
+
+Reference parity: ``GraphManager/filesystem/DrHdfsClient.cpp:32-69``
+(WebHDFS REST ops), ``DryadVertex/.../channelbufferhdfs.cpp``
+(chunked/read-ahead stream reads).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from dryad_tpu import DryadContext
+from dryad_tpu.columnar.webhdfs import WebHdfsClient, WebHdfsError
+from dryad_tpu.tools.webhdfs_stub import WebHdfsStubServer
+
+
+@pytest.fixture
+def stub(tmp_path):
+    with WebHdfsStubServer(str(tmp_path / "hdfs-root")) as srv:
+        yield srv
+
+
+@pytest.fixture
+def client(stub):
+    return WebHdfsClient(stub.host, stub.port, chunk=64 * 1024, threads=3)
+
+
+def test_create_status_open_roundtrip(stub, client):
+    data = os.urandom(1000)
+    client.mkdirs("/a/b")
+    client.create("/a/b/f.bin", data)
+    st = client.status("/a/b/f.bin")
+    assert st["length"] == 1000 and st["type"] == "FILE"
+    assert client.open_range("/a/b/f.bin") == data
+    assert client.open_range("/a/b/f.bin", offset=100, length=50) == data[100:150]
+    # the faithful two-hop dance actually happened
+    assert stub.redirects >= 2  # one CREATE redirect + one OPEN redirect
+
+
+def test_chunked_parallel_read(stub, client):
+    """A file larger than the chunk size reads via the windowed
+    parallel ranged-OPEN pipeline through the native Fifo."""
+    data = os.urandom(client.chunk * 5 + 12345)
+    client.create("/big.bin", data)
+    got = client.read_file("/big.bin")
+    assert got == data
+    # at least ceil(size/chunk) ranged reads hit the datanode role
+    assert stub.bytes_read >= len(data)
+
+
+def test_liststatus_and_delete(stub, client):
+    client.mkdirs("/d")
+    client.create("/d/x", b"1")
+    client.create("/d/y", b"22")
+    names = [s["pathSuffix"] for s in client.list_dir("/d")]
+    assert names == ["x", "y"]
+    assert client.delete("/d/x")
+    assert [s["pathSuffix"] for s in client.list_dir("/d")] == ["y"]
+    assert not client.delete("/d/x")  # already gone -> false, no raise
+
+
+def test_delete_non_empty_requires_recursive(stub, client):
+    client.create("/dd/z", b"z")
+    with pytest.raises(WebHdfsError, match="PathIsNotEmpty"):
+        client.delete("/dd", recursive=False)
+    assert client.delete("/dd", recursive=True)
+
+
+def test_missing_file_raises_filenotfound(stub, client):
+    with pytest.raises(FileNotFoundError):
+        client.status("/nope")
+    with pytest.raises(FileNotFoundError):
+        client.open_range("/nope")
+
+
+def test_create_no_overwrite(stub, client):
+    client.create("/f1", b"a")
+    with pytest.raises(WebHdfsError, match="FileAlreadyExists"):
+        client.create("/f1", b"b", overwrite=False)
+    client.create("/f1", b"b", overwrite=True)
+    assert client.open_range("/f1") == b"b"
+
+
+def test_user_name_param_sent(stub, tmp_path, monkeypatch):
+    monkeypatch.setenv("DRYAD_TPU_HDFS_USER", "alice")
+    c = WebHdfsClient(stub.host, stub.port)
+    assert "user.name=alice" in c._url("/x", "OPEN")
+
+
+# -- provider: engine store round-trip over the real protocol -------------
+
+def test_store_roundtrip_via_webhdfs(stub, mesh8, rng):
+    """to_store/from_store on an hdfs:// URI speak the real WebHDFS
+    protocol end-to-end (no framework gateway env set)."""
+    os.environ.pop("DRYAD_TPU_DFS_GATEWAY", None)
+    ctx = DryadContext(num_partitions_=8)
+    tbl = {
+        "k": rng.integers(0, 50, 600).astype(np.int32),
+        "v": rng.standard_normal(600).astype(np.float32),
+    }
+    uri = f"hdfs://{stub.host}:{stub.port}/warehouse/t1"
+    ctx.from_arrays(tbl).group_by(
+        "k", {"c": ("count", None), "s": ("sum", "v")}
+    ).to_store(uri)
+
+    out = DryadContext(num_partitions_=8).from_store(uri).collect()
+    ref = np.bincount(tbl["k"], minlength=50)
+    got = dict(zip(out["k"].tolist(), out["c"].tolist()))
+    assert got == {int(k): int(c) for k, c in enumerate(ref) if c}
+    assert stub.redirects > 0  # data ops really two-hopped
+
+
+def test_store_roundtrip_string_dictionary(stub, mesh8, rng):
+    os.environ.pop("DRYAD_TPU_DFS_GATEWAY", None)
+    ctx = DryadContext(num_partitions_=8)
+    words = np.array(
+        [f"w{int(i)}" for i in rng.integers(0, 9, 200)], object
+    )
+    uri = f"hdfs://{stub.host}:{stub.port}/warehouse/strs"
+    ctx.from_arrays({"w": words}).distinct().to_store(uri)
+    out = DryadContext(num_partitions_=8).from_store(uri).collect()
+    assert sorted(str(w) for w in out["w"]) == sorted(
+        set(str(w) for w in words)
+    )
