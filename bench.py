@@ -305,44 +305,110 @@ def terasort_metric(n: int):
     )
 
 
+# Analytic single-chip ceilings (BASELINE.md "round-4 pass-count
+# analysis", v5e): the factorized one-hot kernel's per-PASS ceiling is
+# ~7.5e9 rows/s (contraction rate; NOT the old 4.8e10, which assumed
+# all 128 output sublanes useful).  Count-only shapes pay 1 pass;
+# count + one float sum pays 1+2 split-bf16 passes.  Each on-chip
+# metric reports value/ceiling as ``roofline_fraction``.
+ROOFLINE = {
+    "group_reduce_rows_per_sec": 2.7e8,      # sort path, HBM-bound
+    "terasort_rows_per_sec": 2.7e8,          # full-range sort
+    "dense_pallas_rows_per_sec": 2.5e9,      # 1 cnt + 2 split-sum passes
+    "dense_xla_rows_per_sec": 2.5e9,
+    "wordcount_rows_per_sec": 7.5e9,         # count-only dense route
+    "wordcount_dense_rows_per_sec": 7.5e9,
+}
+
+
 # -- backend ---------------------------------------------------------------
 
-def init_backend(max_tries: int = 2, probe_timeout: float = 90.0) -> str:
-    """Probe the accelerator backend in a SUBPROCESS with a hard timeout
-    (remote-TPU init can hang indefinitely; round-1 artifact), pinning
-    this process to CPU on failure so a number is always produced."""
+def _probe_once(probe_timeout: float = 90.0):
+    """One subprocess backend probe; returns (platform|None, detail)."""
     import subprocess
 
     probe = "import jax; d = jax.devices()[0]; print('PLATFORM=' + d.platform)"
-    for attempt in range(max_tries):
-        try:
-            out = subprocess.run(
-                [sys.executable, "-c", probe],
-                capture_output=True, text=True, timeout=probe_timeout,
-            )
-            for line in out.stdout.splitlines():
-                if line.startswith("PLATFORM="):
-                    platform = line.split("=", 1)[1]
-                    log(f"backend probe ok: {platform}")
-                    import jax  # noqa: F401
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", probe],
+            capture_output=True, text=True, timeout=probe_timeout,
+        )
+        for line in out.stdout.splitlines():
+            if line.startswith("PLATFORM="):
+                return line.split("=", 1)[1], "ok"
+        detail = (
+            out.stderr.strip().splitlines()[-1][:200]
+            if out.stderr.strip() else "no output"
+        )
+        return None, f"rc={out.returncode}: {detail}"
+    except subprocess.TimeoutExpired:
+        return None, f"hung >{probe_timeout:.0f}s"
 
-                    return platform
-            detail = (
-                out.stderr.strip().splitlines()[-1][:200]
-                if out.stderr.strip() else "no output"
-            )
-            log(f"backend probe {attempt + 1}/{max_tries} rc={out.returncode}: {detail}")
-        except subprocess.TimeoutExpired:
-            log(f"backend probe {attempt + 1}/{max_tries} hung >{probe_timeout}s")
-        if attempt + 1 < max_tries:
-            time.sleep(5.0)
-    log("falling back to CPU")
+
+def init_backend() -> str:
+    """Probe the accelerator backend in a SUBPROCESS with a hard timeout
+    (remote-TPU init can hang indefinitely; round-1 artifact).  The
+    tunnel FLAPS for hours, so a single failed probe must not condemn
+    the whole run to CPU: retry over a window (default half the budget,
+    env DRYAD_BENCH_PROBE_WINDOW) before falling back — and stamp
+    ``tunnel_down: true`` plus the retry log into the summary when it
+    never comes up, so the artifact records WHY the platform is cpu."""
+    window = float(
+        os.environ.get("DRYAD_BENCH_PROBE_WINDOW", str(BUDGET * 0.5))
+    )
+    t0 = time.monotonic()
+    tries = 0
+    probe_log = []
+    while True:
+        tries += 1
+        platform, detail = _probe_once()
+        if platform is not None:
+            log(f"backend probe ok after {tries} tries: {platform}")
+            SUMMARY["probe_tries"] = tries
+            import jax  # noqa: F401
+
+            return platform
+        elapsed = time.monotonic() - t0
+        probe_log.append(f"t+{elapsed:.0f}s: {detail}")
+        log(f"backend probe {tries} failed ({detail}); "
+            f"{window - elapsed:.0f}s of probe window left")
+        if elapsed + 60.0 > window or remaining() < BUDGET * 0.35:
+            break
+        time.sleep(20.0)
+    log("tunnel down for the whole probe window; falling back to CPU")
+    SUMMARY["tunnel_down"] = True
+    SUMMARY["probe_tries"] = tries
+    SUMMARY["probe_log"] = probe_log[-5:]
     from dryad_tpu.parallel.mesh import force_cpu_backend
 
     force_cpu_backend(1)
     import jax
 
     return jax.devices()[0].platform
+
+
+def run_tests_tpu() -> dict:
+    """Run the chip-gated test suite in the SAME session and record the
+    counts in the artifact (VERDICT r3: tests_tpu had never run)."""
+    import re
+    import subprocess
+
+    budget = max(60.0, min(remaining() - 20.0, 600.0))
+    try:
+        out = subprocess.run(
+            [sys.executable, "-m", "pytest", "tests_tpu/", "-q",
+             "--no-header", "-p", "no:cacheprovider"],
+            capture_output=True, text=True, timeout=budget,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        tail = (out.stdout.strip().splitlines() or [""])[-1]
+        counts = {
+            m[1]: int(m[0])
+            for m in re.findall(r"(\d+) (passed|failed|error|skipped)", tail)
+        }
+        return {"rc": out.returncode, "tail": tail[:200], **counts}
+    except subprocess.TimeoutExpired:
+        return {"rc": -1, "tail": f"timeout after {budget:.0f}s"}
 
 
 # -- main ------------------------------------------------------------------
@@ -421,19 +487,37 @@ def main() -> None:
             rec = fn()
             if baseline:
                 rec["vs_baseline"] = round(rec["value"] / baseline, 3)
+            if accel and name in ROOFLINE:
+                rec["roofline_fraction"] = round(
+                    rec["value"] / ROOFLINE[name], 5
+                )
             if is_core:
                 SUMMARY["value"] = rec["value"]
                 SUMMARY["vs_baseline"] = rec.get("vs_baseline", 0.0)
                 SUMMARY["contended"] = rec["contended"]
                 SUMMARY["reps_s"] = rec["reps_s"]
+                if "roofline_fraction" in rec:
+                    SUMMARY["roofline_fraction"] = rec["roofline_fraction"]
             else:
                 SUMMARY[name] = rec["value"]
+                if "roofline_fraction" in rec:
+                    SUMMARY[f"{name}_roofline"] = rec["roofline_fraction"]
             emit(rec)
             log(f"{name}: {rec['value']:.3e} rows/s "
                 f"(spread {rec['spread']}x{', CONTENDED' if rec['contended'] else ''})")
         except Exception as e:  # noqa: BLE001
             traceback.print_exc(file=sys.stderr)
             emit({"metric": name, "error": f"{type(e).__name__}: {e}"})
+
+    if platform in ("tpu", "axon") and remaining() > 90:
+        # chip-gated test suite, recorded in the SAME artifact
+        log("running tests_tpu/ on the chip...")
+        tt = run_tests_tpu()
+        SUMMARY["tests_tpu"] = tt
+        emit({"metric": "tests_tpu", **tt})
+        log(f"tests_tpu: {tt}")
+    elif platform in ("tpu", "axon"):
+        SUMMARY["tests_tpu"] = {"skipped": "budget"}
 
     print(json.dumps(SUMMARY), flush=True)
     sys.exit(0)

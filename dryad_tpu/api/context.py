@@ -195,9 +195,23 @@ class DryadContext:
                     # throw it away.
                     for s in np.unique(np.asarray(arrays[name], object)):
                         self.dictionary.add(str(s))
+        # Ingest column statistics: INT32 ranges feed the int auto-dense
+        # group_by rewrite (the observed-data-size adaptation of
+        # DrDynamicRangeDistributor.cpp:54-110 applied to key domains).
+        # Skipped when the sole consumer is off.
+        col_stats = {}
+        if getattr(self.config, "auto_dense_ints", True):
+            for name in schema.names:
+                if (
+                    schema.field(name).ctype is ColumnType.INT32
+                    and name in arrays
+                ):
+                    a = np.asarray(arrays[name])
+                    if a.size:
+                        col_stats[name] = (int(a.min()), int(a.max()))
         node = Node(
             "input", [], schema, PartitionInfo.roundrobin(),
-            source="host",
+            source="host", col_stats=col_stats,
         )
         self._bindings[node.id] = ("host", arrays, partition_capacity)
         return Query(self, node)

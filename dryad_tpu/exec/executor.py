@@ -43,6 +43,17 @@ from dryad_tpu.utils.logging import get_logger
 log = get_logger("dryad_tpu.exec")
 
 
+def _stage_has_miss_guard(stage) -> bool:
+    """Stages whose compiled program accumulates a dense-domain miss
+    counter needing the deferred readback: STRING dictionary coding, or
+    the guarded int auto-dense bucket reduce."""
+    return any(
+        op.kind == "string_code"
+        or (op.kind == "group_reduce_dense" and op.params.get("guard"))
+        for op in stage.ops
+    )
+
+
 class StageFailedError(RuntimeError):
     pass
 
@@ -193,26 +204,126 @@ class GraphExecutor:
             if m:
                 self.events.emit("dict_miss", stage_name=name, rows=m)
                 raise StageFailedError(
-                    f"stage {name!r}: {m} rows carry STRING values not in "
-                    "the context dictionary (fabricated at run time?); "
-                    "the dense path would drop them. Register the values "
-                    "at ingest or use group_by(salt=) to force the sort "
+                    f"stage {name!r}: {m} rows fall outside the dense "
+                    "path's key domain (STRING values missing from the "
+                    "context dictionary, or INT32 keys past their "
+                    "ingest-time range — fabricated at run time?); the "
+                    "dense kernel would drop them. Register/ingest the "
+                    "values, or use group_by(salt=) to force the sort "
                     "path."
                 )
 
     def _execute_stages(self, graph, bindings, results, binding_fps, stage_fps):
+        depth = max(1, self.config.overflow_sync_depth)
+        # Speculative dispatch window (DrMessagePump.h:116-180 pump
+        # concurrency): overflow-capable stages dispatch without their
+        # per-stage host sync; flags drain in one batched readback when
+        # the window fills, before any host-consuming stage, and at job
+        # end.  Downstream stages consume the optimistic results — an
+        # overflow (rare) re-runs the affected suffix synchronously.
+        window: List[Dict] = []
         for stage in graph.stages:
             if stage.ops and stage.ops[0].kind == "do_while":
+                self._drain_window(window, graph, bindings, results,
+                                   binding_fps or {}, stage_fps)
                 stage_fps[stage.id] = None  # loop state is data-dependent
                 self._run_do_while(stage, graph, bindings, results)
                 continue
             if stage.ops and stage.ops[0].kind == "apply_host":
+                self._drain_window(window, graph, bindings, results,
+                                   binding_fps or {}, stage_fps)
                 stage_fps[stage.id] = None  # host fn is opaque
                 self._run_apply_host(stage, bindings, results)
                 continue
             self._run_stage(
-                stage, graph, bindings, results, binding_fps or {}, stage_fps
+                stage, graph, bindings, results, binding_fps or {}, stage_fps,
+                window=window if depth > 1 else None,
             )
+            if len(window) >= depth:
+                self._drain_window(window, graph, bindings, results,
+                                   binding_fps or {}, stage_fps)
+        self._drain_window(window, graph, bindings, results,
+                           binding_fps or {}, stage_fps)
+
+    def _drain_window(self, window, graph, bindings, results,
+                      binding_fps, stage_fps) -> None:
+        """Resolve all speculatively dispatched stages: ONE batched
+        overflow readback for the all-clear case; on an overflow,
+        finalize the clean prefix and re-run the overflowing stage and
+        everything dispatched after it synchronously (their inputs or
+        contents were garbage) at an escalated boost."""
+        if not window:
+            return
+        import jax.numpy as jnp
+
+        flags = [w["flag"] for w in window if w["flag"] is not None]
+        self.events.emit(
+            "overflow_drain", inflight=len(window),
+            stages=[w["stage"].name for w in window],
+        )
+        combined = (
+            False if not flags
+            else flags[0] if len(flags) == 1
+            else jnp.any(jnp.stack(flags))
+        )
+        if not bool(combined):
+            for w in window:
+                self._finalize_entry(w, results)
+            window.clear()
+            return
+        bad = next(
+            i for i, w in enumerate(window)
+            if w["flag"] is not None and bool(w["flag"])
+        )
+        for w in window[:bad]:
+            self._finalize_entry(w, results)
+        redo = window[bad:]
+        window.clear()
+        first = redo[0]
+        self.events.emit(
+            "stage_overflow", stage=first["stage"].id,
+            name=first["stage"].name, version=first["version"],
+            boost=first["boost"],
+        )
+        # Windowed dispatches always ran at boost 1 (the speculative
+        # branch returns on the first attempt); the synchronous redo's
+        # own retry loop handles further escalation and the boost
+        # ceiling.
+        for j, w in enumerate(redo):
+            self._run_stage(
+                w["stage"], graph, bindings, results, binding_fps, stage_fps,
+                boost0=2 if j == 0 else 1, window=None,
+            )
+
+    def _finalize_entry(self, w, results) -> None:
+        """A speculative dispatch whose overflow flag came back clean:
+        emit its completion, queue its dict-miss counter, and save its
+        checkpoint (none of which may happen before the flag clears)."""
+        stage = w["stage"]
+        # dispatch-to-drain wall time covers the WHOLE window's
+        # dispatches + the batched readback, so it must not feed the
+        # straggler duration model (sync runs still do); it is reported
+        # on the event for observability only.
+        dt = time.time() - w["t0"]
+        self.events.emit(
+            "stage_complete", stage=stage.id, name=stage.name,
+            version=w["version"], seconds=dt, deferred=True,
+        )
+        if _stage_has_miss_guard(stage):
+            self._pending_miss.append((stage.name, w["miss"]))
+        if self.checkpoints is not None and w["fp"] is not None:
+            try:
+                path = self.checkpoints.save(
+                    stage, w["fp"], tuple(w["outs"][: len(stage.out_slots)])
+                )
+                self.events.emit(
+                    "stage_checkpoint_saved", stage=stage.id,
+                    name=stage.name, path=path,
+                )
+            except OSError as e:
+                log.warning(
+                    "checkpoint save failed for %s: %s", stage.name, e
+                )
 
     def _resolve_inputs(
         self,
@@ -236,6 +347,8 @@ class GraphExecutor:
         results: Dict[Tuple[int, int], ColumnBatch],
         binding_fps: Dict[int, Optional[str]] = {},
         stage_fps: Dict[int, Optional[str]] = {},
+        boost0: int = 1,
+        window: Optional[List[Dict]] = None,
     ) -> None:
         inputs = self._resolve_inputs(stage, bindings, results)
         shape_key = self._shape_key(inputs)
@@ -266,10 +379,21 @@ class GraphExecutor:
                     return
         st = self.stats.setdefault(stage.name, StageStatistics(self.config.outlier_sigmas))
 
+        fan = [
+            op.params.get("nparts") for op in stage.ops
+            if op.params.get("nparts")
+        ]
+        if fan:
+            # stage-level fan-out adaptation record (the rewired-graph
+            # event of DrDynamicRangeDistributor.cpp:54-110)
+            self.events.emit(
+                "stage_fanout", stage=stage.id, name=stage.name,
+                nparts=min(min(fan), self.P), of=self.P,
+            )
         can_overflow = any(
             op.kind not in NON_OVERFLOW_OPS for op in stage.ops
         )
-        boost = 1
+        boost = boost0
         failures = 0
         version = 0
         while True:
@@ -287,6 +411,28 @@ class GraphExecutor:
                     stage.name, step_num=version
                 ):
                     outs, (overflow, dict_miss) = fn(inputs, ())
+                    if window is not None and (can_overflow or window):
+                        # Speculative dispatch: publish the optimistic
+                        # results so downstream stages can dispatch too,
+                        # and defer the overflow sync to the window
+                        # drain (one batched readback for the window).
+                        # A non-overflow stage joins an OPEN window too:
+                        # it may have consumed speculative inputs, so a
+                        # redo must recompute it (flag None = never the
+                        # overflow pivot).
+                        for i in range(len(stage.out_slots)):
+                            results[(stage.id, i)] = outs[i]
+                        window.append(dict(
+                            stage=stage, version=version, boost=boost,
+                            fp=fp, flag=overflow if can_overflow else None,
+                            miss=dict_miss, outs=outs, t0=t0,
+                        ))
+                        self.events.emit(
+                            "stage_dispatched", stage=stage.id,
+                            name=stage.name, version=version, boost=boost,
+                            inflight=len(window),
+                        )
+                        return
                     # Overflow-free stages skip the host sync: their
                     # flag is statically False, so the driver moves on
                     # and JAX async dispatch overlaps this stage's
@@ -336,7 +482,7 @@ class GraphExecutor:
                 # downstream stages (jobview surfaces the distinction)
                 **({} if can_overflow else {"async": True}),
             )
-            if any(op.kind == "string_code" for op in stage.ops):
+            if _stage_has_miss_guard(stage):
                 # Deferred readback: checked after the job drains so the
                 # dense fast path keeps its async dispatch.
                 self._pending_miss.append((stage.name, dict_miss))

@@ -119,13 +119,24 @@ def _k_apply(ctx: StageContext, p) -> None:
 
 # -- exchanges -------------------------------------------------------------
 
-def _do_exchange_hash(ctx: StageContext, slot: int, keys, tree=None) -> None:
+def _fanout(ctx: StageContext, nparts) -> int:
+    """Effective destination count for a fan-reduced exchange (stage-
+    level fan-out adaptation, ``DrDynamicRangeDistributor.cpp:54-110``):
+    rows concentrate onto the first ``nparts`` partitions; the rest run
+    the stage masked-empty."""
+    return min(int(nparts), ctx.P) if nparts else ctx.P
+
+
+def _do_exchange_hash(
+    ctx: StageContext, slot: int, keys, tree=None, nparts=None
+) -> None:
     b = ctx.slots[slot]
     if tree is not None and len(ctx.axes) == 2:
         _tree_exchange_hash(ctx, slot, keys, tree)
         return
-    dest = partition_ids([b.data[k] for k in keys], ctx.P)
-    B = SH.bucket_capacity(b.capacity, ctx.P, ctx.slack * ctx.boost)
+    P_eff = _fanout(ctx, nparts)
+    dest = partition_ids([b.data[k] for k in keys], P_eff)
+    B = SH.bucket_capacity(b.capacity, P_eff, ctx.slack * ctx.boost)
     out, ovf = SH.exchange(b, dest, ctx.P, B, ctx.axes)
     ctx.slots[slot] = out
     ctx.overflow = ctx.overflow | ovf
@@ -178,9 +189,17 @@ def _tree_exchange_hash(ctx: StageContext, slot: int, keys, tree) -> None:
     ctx.slots[slot] = out2
 
 
-def _do_resize(ctx: StageContext, slot: int, factor: float) -> None:
+def _do_resize(
+    ctx: StageContext, slot: int, factor: float, nparts=None
+) -> None:
     b = ctx.slots[slot]
-    target = _round8(ctx.base_cap(slot) * factor * ctx.boost * ctx.slack)
+    # A fan-reduced exchange concentrates ~P/P_eff partitions' rows
+    # onto each live partition; scale the post-shuffle capacity so the
+    # concentration itself never trips the overflow retry.
+    conc = ctx.P / _fanout(ctx, nparts)
+    target = _round8(
+        ctx.base_cap(slot) * factor * conc * ctx.boost * ctx.slack
+    )
     out, ovf = SH.resize(b, target)
     ctx.slots[slot] = out
     ctx.overflow = ctx.overflow | ovf
@@ -199,7 +218,9 @@ NON_OVERFLOW_OPS = frozenset({
 
 
 def _k_exchange_hash(ctx: StageContext, p) -> None:
-    _do_exchange_hash(ctx, p["slot"], p["keys"], p.get("tree"))
+    _do_exchange_hash(
+        ctx, p["slot"], p["keys"], p.get("tree"), p.get("nparts")
+    )
 
 
 def _k_exchange_range(ctx: StageContext, p) -> None:
@@ -216,6 +237,7 @@ def _k_exchange_range(ctx: StageContext, p) -> None:
     # recomputation of DrDynamicRangeDistributor.cpp:54-110).
     rate = float(p.get("rate", 0.001)) * ctx.boost
     m = int(min(512 * ctx.boost, max(16 * ctx.boost, b.capacity * rate)))
+    P_eff = _fanout(ctx, p.get("nparts"))
     if p.get("spread"):
         # Skew-proof variant for pure ordering (order_by): splitters
         # elected over ALL sort operands plus a uniform synthetic
@@ -228,15 +250,15 @@ def _k_exchange_range(ctx: StageContext, p) -> None:
         words = [o.astype(jnp.uint32) for o in operands]
         words.append(SORT.spread_word(b.capacity))
         splitters = SORT.sample_splitters_multi(
-            words, b.valid, ctx.P, m, ctx.axes
+            words, b.valid, P_eff, m, ctx.axes
         )
         dest = SORT.range_dest_multi(words, splitters)
     else:
         splitters = SORT.sample_splitters(
-            operands[0], b.valid, ctx.P, m, ctx.axes
+            operands[0], b.valid, P_eff, m, ctx.axes
         )
         dest = SORT.range_dest(operands[0], splitters)
-    B = SH.bucket_capacity(b.capacity, ctx.P, ctx.slack * ctx.boost)
+    B = SH.bucket_capacity(b.capacity, P_eff, ctx.slack * ctx.boost)
     out, ovf = SH.exchange(b, dest, ctx.P, B, ctx.axes)
     ctx.slots[p["slot"]] = out
     ctx.overflow = ctx.overflow | ovf
@@ -246,7 +268,7 @@ def _k_resize(ctx: StageContext, p) -> None:
     # Post-shuffle capacity: entry capacity x pipeline growth x retry
     # boost x slack (hash placement has variance, so the uniform
     # expectation alone overflows regularly).
-    _do_resize(ctx, p["slot"], p["factor"])
+    _do_resize(ctx, p["slot"], p["factor"], p.get("nparts"))
 
 
 # -- grouping / sorting ----------------------------------------------------
@@ -295,6 +317,15 @@ def _k_group_reduce_dense(ctx: StageContext, p) -> None:
     Kp = per * ctx.P
     key = b.data[p["key"]]
     in_range = b.valid & (key >= 0) & (key < K)
+    if p.get("guard"):
+        # Int auto-dense rewrite: the [0, K) bound came from INGEST
+        # statistics, so out-of-range keys mean post-ingest fabrication
+        # — count them for the executor's deferred loud failure instead
+        # of silently dropping (explicit dense=K keeps its documented
+        # drop semantics).
+        ctx.dict_miss = ctx.dict_miss + jnp.sum(
+            (b.valid & ~in_range).astype(jnp.int32)
+        )
 
     # Distinct value columns needed by sum/mean aggs.
     val_cols: List[str] = []

@@ -36,6 +36,7 @@ bytes/row a materialized one-hot pays.
 
 from __future__ import annotations
 
+import os
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -67,24 +68,53 @@ def _hi_width(num_buckets: int) -> int:
     return _round_up(max(1, -(-num_buckets // _LO)), 8)
 
 
-def _row_block(a_pad: int, n_vals: int = 1) -> Optional[int]:
+def _row_block(a_pad: int, n_vals: int = 1, planes_per_val: int = 2) -> Optional[int]:
     """Rows per grid step, multiple of 128 (rows ride the lane dim),
     sized to the VMEM budget: per-row cost is the hi one-hot plus the
-    lo one-hot plus one rhs plane per value column; the (A, 128)
-    accumulators are resident off the top.  None when the accumulators
-    alone blow the budget (huge num_buckets) — callers must use the
-    XLA fallback, which has no VMEM ceiling."""
+    lo one-hot plus ``planes_per_val`` rhs planes per value column
+    (split-bf16 accumulation uses two); the (A, 128) accumulators are
+    resident off the top.  None when the accumulators alone blow the
+    budget (huge num_buckets) — callers must use the XLA fallback,
+    which has no VMEM ceiling."""
     acc_bytes = a_pad * _LO * 4 * (1 + n_vals)
     left = _VMEM_BUDGET - acc_bytes
     if left <= 0:
         return None
-    r = left // (4 * (a_pad + (1 + n_vals) * _LO + 4))
+    r = left // (
+        4 * (a_pad + (1 + planes_per_val * n_vals) * _LO + 4)
+    )
     if r < 128:
         return None
     return min(8192, (r // 128) * 128)
 
 
-def _make_kernel(n_vals: int, a_pad: int):
+def _split_terms(v, n: int):
+    """Decompose f32 ``v`` into ``n`` bf16 terms summing to ~v; term j
+    carries mantissa bits [8j, 8j+8)."""
+    import jax.numpy as jnp
+
+    terms = []
+    rem = v
+    for _ in range(n - 1):
+        t = rem.astype(jnp.bfloat16)
+        terms.append(t)
+        rem = rem - t.astype(jnp.float32)
+    terms.append(rem.astype(jnp.bfloat16))
+    return terms
+
+
+def _val_splits(values) -> Tuple[int, ...]:
+    """bf16 terms per value column: 3 for integers (exact to 2^24,
+    the documented dense-path contract), 2 for floats (~2^-16)."""
+    import jax.numpy as jnp
+
+    return tuple(
+        3 if jnp.issubdtype(jnp.asarray(v).dtype, jnp.integer) else 2
+        for v in values
+    )
+
+
+def _make_kernel(n_vals: int, a_pad: int, splits: Tuple[int, ...] = ()):
     """Kernel over refs (k, mask, v_0..v_{n-1}, cnt, sum_0..sum_{n-1}).
 
     Row refs are (1, R) lane vectors; accumulators are (A, 128) tables
@@ -92,7 +122,18 @@ def _make_kernel(n_vals: int, a_pad: int):
     in contraction orientation — (A, R) and (128, R), rows on lanes —
     so the dots are plain NT matmuls with no data-dependent transposes
     (a dim-0 contraction here costs a Mosaic relayout of the whole
-    one-hot; measured 2x slower end-to-end)."""
+    one-hot; measured 2x slower end-to-end).
+
+    EVERY dot runs single-pass bf16xbf16->f32 — the MXU's native rate.
+    Counts are exact there (0/1 products).  Value sums use SPLIT-bf16
+    accumulation: v decomposes into ``splits[i]`` bf16 terms (each
+    carrying the next 8 mantissa bits), every term's one-hot products
+    are exactly representable, and the f32 accumulator adds them — so
+    2 terms give ~2^-16 relative representation error (float columns)
+    and 3 terms keep integers exact to 2^24 (the documented dense-path
+    contract), at 2-3 native-rate passes instead of the HIGHEST
+    (f32-rate, ~6x slower) pass the round-3 kernel paid (BASELINE.md
+    round-4 pass-count analysis)."""
 
     def kernel(*refs):
         k_ref, m_ref = refs[0], refs[1]
@@ -108,9 +149,9 @@ def _make_kernel(n_vals: int, a_pad: int):
         lo_iota = jax.lax.broadcasted_iota(jnp.int32, (_LO, R), 0)
         # mask folded into the lo factor zeroes invalid rows out of both
         # the counts and every sum in one place.
-        oh_lo = (((kb & (_LO - 1)) == lo_iota) & mb).astype(jnp.float32)
+        oh_lo = (((kb & (_LO - 1)) == lo_iota) & mb).astype(jnp.bfloat16)
         hi_iota = jax.lax.broadcasted_iota(jnp.int32, (a_pad, R), 0)
-        oh_hi = ((kb >> _LO_SHIFT) == hi_iota).astype(jnp.float32)
+        oh_hi = ((kb >> _LO_SHIFT) == hi_iota).astype(jnp.bfloat16)
 
         @pl.when(i == 0)
         def _init():
@@ -119,22 +160,20 @@ def _make_kernel(n_vals: int, a_pad: int):
                 s[...] = jnp.zeros((a_pad, _LO), jnp.float32)
 
         contract_lanes = (((1,), (1,)), ((), ()))
-        # (A, R) . (128, R)^T -> (A, 128) rides the MXU.  Counts run at
-        # default (bf16) MXU precision — 0/1 products are exact there.
-        # Value sums use HIGHEST: the default would round each v to
-        # bf16 (~4e-3 relative error); HIGHEST keeps f32-equivalent
-        # products at ~3x the matmul passes, still MXU-bound.
         cnt_ref[...] += jax.lax.dot_general(
             oh_hi, oh_lo, contract_lanes,
             preferred_element_type=jnp.float32,
         )
-        for v_ref, s_ref in zip(v_refs, sum_refs):
-            rhs = oh_lo * v_ref[...].astype(jnp.float32)  # (1,R) bcast
-            s_ref[...] += jax.lax.dot_general(
-                oh_hi, rhs, contract_lanes,
-                preferred_element_type=jnp.float32,
-                precision=jax.lax.Precision.HIGHEST,
-            )
+        for j, (v_ref, s_ref) in enumerate(zip(v_refs, sum_refs)):
+            v = v_ref[...].astype(jnp.float32)  # (1, R)
+            acc = None
+            for t in _split_terms(v, splits[j] if splits else 2):
+                d = jax.lax.dot_general(
+                    oh_hi, oh_lo * t, contract_lanes,
+                    preferred_element_type=jnp.float32,
+                )
+                acc = d if acc is None else acc + d
+            s_ref[...] += acc
 
     return kernel
 
@@ -150,6 +189,41 @@ def _on_tpu() -> bool:
         return False
 
 
+def _default_strategy() -> str:
+    """Bucket-reduce strategy: one-hot MXU matmul vs plain scatter-add
+    (``segment_sum`` on unsorted keys — no sort).  The CPU probe
+    (``probe_perf.py``, BASELINE.md) measured scatter ~100x faster than
+    the sort path and well above the factorized matmul on CPU; on TPU
+    scatters have historically serialized, so the matmul stays default
+    until the on-chip probe demonstrates otherwise.  Override with
+    ``DRYAD_TPU_BUCKET_STRATEGY=matmul|scatter``."""
+    env = os.environ.get("DRYAD_TPU_BUCKET_STRATEGY")
+    if env in ("matmul", "scatter"):
+        return env
+    return "matmul" if _on_tpu() else "scatter"
+
+
+def _scatter_bucket(
+    keys: jax.Array,
+    values: Sequence[jax.Array],
+    valid: jax.Array,
+    k_full: int,
+) -> Tuple[List[jax.Array], jax.Array]:
+    """Scatter-add bucket reduce: exact f32 adds, HBM-bound (roofline
+    ~2.3e10 rows/s IF the backend vectorizes scatters)."""
+    seg = jnp.where(valid, keys, k_full)  # invalid -> dropped sentinel
+    cnt = jax.ops.segment_sum(
+        valid.astype(jnp.float32), seg, k_full + 1
+    )[:k_full]
+    sums = [
+        jax.ops.segment_sum(
+            jnp.where(valid, v.astype(jnp.float32), 0.0), seg, k_full + 1
+        )[:k_full]
+        for v in values
+    ]
+    return sums, cnt
+
+
 def bucket_sum_count(
     keys: jax.Array,
     values: Sequence[jax.Array],
@@ -157,6 +231,7 @@ def bucket_sum_count(
     num_buckets: int,
     block: int = DEFAULT_BLOCK,
     interpret: Optional[bool] = None,
+    strategy: Optional[str] = None,
 ) -> Tuple[List[jax.Array], jax.Array]:
     """Per-bucket sums of each value column + row counts.
 
@@ -166,6 +241,9 @@ def bucket_sum_count(
     ``interpret``: force Pallas interpret mode (CPU testing); default
     picks the Pallas kernel on TPU and the XLA fallback elsewhere.
     ``block`` caps the rows-per-step of the XLA fallback's scan.
+    ``strategy``: "matmul" (factorized one-hot, MXU) or "scatter"
+    (plain segment_sum) — default measured-per-backend
+    (:func:`_default_strategy`).
     """
     n = keys.shape[0]
     a_pad = _hi_width(num_buckets)
@@ -173,6 +251,9 @@ def bucket_sum_count(
     keys = jnp.clip(
         jnp.where(valid, keys, 0).astype(jnp.int32), 0, k_full - 1
     )
+    if (strategy or _default_strategy()) == "scatter" and interpret is not True:
+        flat_s, flat_c = _scatter_bucket(keys, values, valid, k_full)
+        return [s[:num_buckets] for s in flat_s], flat_c[:num_buckets]
 
     def pad_to(npad):
         nonlocal keys, valid, values
@@ -185,7 +266,8 @@ def bucket_sum_count(
                 for v in values
             ]
 
-    R = _row_block(a_pad, len(values))
+    splits = _val_splits(values)
+    R = _row_block(a_pad, len(values), max(splits, default=2))
     if interpret is True and (pl is None or R is None):
         # An explicit interpret=True means the caller wants the Pallas
         # kernel exercised; silently taking the XLA fallback would stop
@@ -204,7 +286,7 @@ def bucket_sum_count(
         row_spec = pl.BlockSpec((1, R), lambda i: (0, i))
         out_spec = pl.BlockSpec((a_pad, _LO), lambda i: (0, 0))
         outs = pl.pallas_call(
-            _make_kernel(len(values), a_pad),
+            _make_kernel(len(values), a_pad, splits),
             grid=(npad // R,),
             in_specs=[row_spec] * (2 + len(values)),
             out_specs=[out_spec] * (1 + len(values)),
@@ -233,23 +315,29 @@ def bucket_sum_count(
 
         def body(acc, xs):
             kb, mb, *vbs = xs
+            # identical split-bf16 math to the Pallas kernel (products
+            # exactly representable; f32 accumulate)
             oh_lo = (
                 ((kb[:, None] & (_LO - 1)) == lo_iota) & mb[:, None]
-            ).astype(jnp.float32)
+            ).astype(jnp.bfloat16)
             oh_hi = (
                 (kb[:, None] >> _LO_SHIFT) == hi_iota
-            ).astype(jnp.float32)
+            ).astype(jnp.bfloat16)
             cnt_a, sums_a = acc
-            cnt_a = cnt_a + oh_hi.T @ oh_lo
-            sums_a = [
-                s + jnp.matmul(
-                    oh_hi.T,
-                    oh_lo * vb[:, None].astype(jnp.float32),
-                    precision=jax.lax.Precision.HIGHEST,
-                )
-                for s, vb in zip(sums_a, vbs)
-            ]
-            return (cnt_a, sums_a), None
+            cnt_a = cnt_a + jax.lax.dot_general(
+                oh_hi, oh_lo, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            new_sums = []
+            for j, (s, vb) in enumerate(zip(sums_a, vbs)):
+                v = vb[:, None].astype(jnp.float32)
+                for t in _split_terms(v, splits[j] if splits else 2):
+                    s = s + jax.lax.dot_general(
+                        oh_hi, oh_lo * t, (((0,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+                new_sums.append(s)
+            return (cnt_a, new_sums), None
 
         init = (
             jnp.zeros((a_pad, _LO), jnp.float32),

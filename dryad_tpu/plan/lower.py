@@ -73,6 +73,67 @@ class _Builder:
         # node id -> ("open", stage, slot) | ("closed", stage_id, out_idx)
         self.cursor: Dict[int, Tuple] = {}
         self.plan_inputs: Dict[int, Node] = {}
+        # node id -> static upper bound on GLOBAL row count (None =
+        # unbounded); feeds stage-level fan-out adaptation
+        self.est: Dict[int, Optional[int]] = {}
+        # node ids whose hash claim was produced by a fan-REDUCED
+        # exchange (mod P_stage < P): still key-colocated, so group_by
+        # elision stays safe, but a join must NOT treat it as
+        # co-partitioned with a full-width side
+        self.reduced: set = set()
+
+    # -- static row estimates (DrDynamicRangeDistributor.cpp:54-110:
+    # consumer fan-out from observed data size; here from the plan's
+    # statically-bounded row counts) --------------------------------------
+    def _estimate_node(self, node: Node) -> Optional[int]:
+        ins = [self.est.get(i.id) for i in node.inputs]
+        k = node.kind
+        if k == "aggregate":
+            return 1
+        if k in ("take", "tail"):
+            return int(node.params["n"])
+        if k == "topk":
+            return int(node.params["n"])
+        if k == "group_by":
+            if node.params.get("dense"):
+                return int(node.params["dense"])
+            if node.params.get("auto_dense") and self.dictionary is not None:
+                return len(self.dictionary)
+            return ins[0]  # groups <= input rows
+        if k == "distinct":
+            return ins[0]
+        if k == "concat":
+            return sum(ins) if all(e is not None for e in ins) else None
+        if k == "zip":
+            known = [e for e in ins if e is not None]
+            return min(known) if known else None
+        if k in (
+            "select", "where", "project", "with_rank", "take_while",
+            "skip_while", "skip", "reverse", "default_if_empty",
+            "order_by", "hash_partition", "range_partition",
+            "assume_partition", "tee", "fork_branch", "cache",
+        ):
+            return ins[0] if ins else None
+        if k == "join" and node.params.get("join_kind") in (
+            "count", "semi", "anti"
+        ):
+            # per-left-row output shapes: at most the left's rows
+            # (left-outer and inner joins expand — unbounded)
+            return ins[0]
+        return None
+
+    def _tail_nparts(self, src: Node) -> Optional[int]:
+        """ceil(bounded rows / tail_rows_per_partition) when the source
+        is statically tiny — the masked-partition fan-out for the
+        consumer exchange; None = full width."""
+        limit = getattr(self.config, "tail_fanout_rows", 4096)
+        if not limit:
+            return None
+        est = self.est.get(src.id)
+        if est is None or est > limit:
+            return None
+        per = max(1, getattr(self.config, "tail_rows_per_partition", 512))
+        return max(1, -(-est // per))
 
     # -- stage bookkeeping -------------------------------------------------
     def _new_stage(self, name: str, input_refs: List[Tuple[Any, int]]) -> Stage:
@@ -118,6 +179,12 @@ class _Builder:
 
     # -- node lowering -----------------------------------------------------
     def lower_node(self, node: Node, fanout: Dict[int, int]) -> None:
+        self.est[node.id] = self._estimate_node(node)
+        # reduced-ness is sticky down single-input chains: any claim
+        # derived from fan-reduced data keeps its mod-P_stage layout
+        # until something re-exchanges full-width
+        if node.inputs and node.inputs[0].id in self.reduced:
+            self.reduced.add(node.id)
         n_cons = fanout.get(node.id, 1)
         k = node.kind
 
@@ -407,9 +474,24 @@ class _Builder:
         return out
 
     def _needs_hash_exchange(self, node: Node, keys: Sequence[str]) -> bool:
+        """Equal-key COLOCATION elision for keyed ops (group_by /
+        distinct / hash_partition): a matching hash claim colocates, and
+        so does a STRICT (non-spread) range claim whose partition keys
+        are a subset of the group keys — the partition function then
+        depends only on the group key, so equal groups cannot straddle
+        (the dense bucket path's key-ordered output rides this)."""
         src = node.inputs[0]
         p = src.partition
-        return not (p.scheme == "hash" and tuple(p.keys) == tuple(keys))
+        if p.scheme == "hash" and tuple(p.keys) == tuple(keys):
+            return False
+        if (
+            p.scheme == "range"
+            and not p.spread
+            and p.keys
+            and set(p.keys) <= set(keys)
+        ):
+            return False
+        return True
 
     def _lower_keyed(self, node: Node, fanout: Dict[int, int]) -> None:
         stage, slot = self._continue_or_start(node, fanout.get(node.inputs[0].id, 1))
@@ -419,10 +501,22 @@ class _Builder:
         carry_cols = K.group_carry_cols(in_schema, keys)
         need_exchange = self._needs_hash_exchange(node, keys)
 
+        # Stage-level fan-out adaptation: a statically-tiny input
+        # concentrates onto fewer partitions (masked tail).
+        nparts = self._tail_nparts(node.inputs[0])
+
         if node.kind == "hash_partition":
             if need_exchange:
-                stage.ops.append(StageOp("exchange_hash", dict(slot=slot, keys=eq_cols)))
-                stage.ops.append(StageOp("resize", dict(slot=slot, factor=stage.growth)))
+                if nparts:
+                    self.reduced.add(node.id)
+                stage.ops.append(StageOp(
+                    "exchange_hash",
+                    dict(slot=slot, keys=eq_cols, nparts=nparts),
+                ))
+                stage.ops.append(StageOp(
+                    "resize",
+                    dict(slot=slot, factor=stage.growth, nparts=nparts),
+                ))
             self.cursor[node.id] = ("open", stage, slot)
             return
 
@@ -437,13 +531,18 @@ class _Builder:
 
         if node.kind == "distinct":
             if need_exchange:
+                if nparts:
+                    self.reduced.add(node.id)
                 stage.ops.append(StageOp("distinct", dict(slot=slot, keys=eq_cols)))
                 stage.ops.append(StageOp(
                     "exchange_hash",
-                    dict(slot=slot, keys=eq_cols,
+                    dict(slot=slot, keys=eq_cols, nparts=nparts,
                          tree=dict(keys=eq_cols, distinct=True)),
                 ))
-                stage.ops.append(StageOp("resize", dict(slot=slot, factor=stage.growth)))
+                stage.ops.append(StageOp(
+                    "resize",
+                    dict(slot=slot, factor=stage.growth, nparts=nparts),
+                ))
             stage.ops.append(StageOp("distinct", dict(slot=slot, keys=eq_cols)))
             self.cursor[node.id] = ("open", stage, slot)
             return
@@ -461,6 +560,7 @@ class _Builder:
                         key=carry_cols[0],
                         aggs=aggs,
                         num_buckets=int(node.params["dense"]),
+                        guard=bool(node.params.get("guard_range")),
                     ),
                 )
             )
@@ -503,14 +603,19 @@ class _Builder:
                 )
             )
             if need_exchange:
+                if nparts:
+                    self.reduced.add(node.id)
                 stage.ops.append(StageOp(
                     "exchange_hash",
-                    dict(slot=slot, keys=eq_cols,
+                    dict(slot=slot, keys=eq_cols, nparts=nparts,
                          tree=dict(keys=carry_cols,
                                    state_cols=decomposable.state_cols,
                                    merge=decomposable.merge)),
                 ))
-                stage.ops.append(StageOp("resize", dict(slot=slot, factor=stage.growth)))
+                stage.ops.append(StageOp(
+                    "resize",
+                    dict(slot=slot, factor=stage.growth, nparts=nparts),
+                ))
                 stage.ops.append(
                     StageOp(
                         "group_combine",
@@ -560,12 +665,17 @@ class _Builder:
                     StageOp("group_reduce", dict(slot=slot, keys=carry_cols, aggs=partial))
                 )
             if need_exchange:
+                if nparts:
+                    self.reduced.add(node.id)
                 stage.ops.append(StageOp(
                     "exchange_hash",
-                    dict(slot=slot, keys=eq_cols,
+                    dict(slot=slot, keys=eq_cols, nparts=nparts,
                          tree=dict(keys=carry_cols, aggs=final)),
                 ))
-                stage.ops.append(StageOp("resize", dict(slot=slot, factor=stage.growth)))
+                stage.ops.append(StageOp(
+                    "resize",
+                    dict(slot=slot, factor=stage.growth, nparts=nparts),
+                ))
                 stage.ops.append(
                     StageOp("group_reduce", dict(slot=slot, keys=carry_cols, aggs=final))
                 )
@@ -608,6 +718,9 @@ class _Builder:
             # equal keys across partitions (skew-proof, kernels.py
             # _k_exchange_range); range_partition promises equal-key
             # COLOCATION and keeps strict splitters.
+            nparts = self._tail_nparts(node.inputs[0])
+            if nparts:
+                self.reduced.add(node.id)
             stage.ops.append(
                 StageOp(
                     "exchange_range",
@@ -615,10 +728,13 @@ class _Builder:
                         slot=slot, operands_fn=operands_fn,
                         spread=node.kind == "order_by",
                         rate=self.config.sample_rate,
+                        nparts=nparts,
                     ),
                 )
             )
-            stage.ops.append(StageOp("resize", dict(slot=slot, factor=stage.growth)))
+            stage.ops.append(StageOp(
+                "resize", dict(slot=slot, factor=stage.growth, nparts=nparts)
+            ))
         if node.kind == "order_by":
             stage.ops.append(
                 StageOp("local_sort", dict(slot=slot, operands_fn=operands_fn))
@@ -732,6 +848,12 @@ class _Builder:
         self.cursor[node.id] = ("open", stage, 0)
 
     def _needs_hash_exchange_for(self, src: Node, keys: Sequence[str]) -> bool:
+        # A fan-REDUCED hash layout (mod P_stage < P) is key-colocated
+        # but NOT co-partitioned with a full-width side — a join must
+        # re-exchange it (group_by elision over it stays safe and is
+        # handled by _needs_hash_exchange).
+        if src.id in self.reduced:
+            return True
         p = src.partition
         return not (p.scheme == "hash" and tuple(p.keys) == tuple(keys))
 

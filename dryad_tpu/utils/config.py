@@ -106,6 +106,10 @@ class DryadConfig:
     # holds at most auto_dense_limit distinct strings — no shuffle at
     # all, vs the reference's full hash repartition for the same query.
     auto_dense_strings: bool = True
+    # Int twin: a plain group_by over one INT32 key whose INGEST-time
+    # range is [0, K), K <= auto_dense_limit, rides the same MXU bucket
+    # path (with a range-miss guard for post-ingest fabrication).
+    auto_dense_ints: bool = True
     auto_dense_limit: int = _env_int("DRYAD_TPU_AUTO_DENSE_LIMIT", 1 << 17)
     # Device-resident input cache budget in bytes (0 disables): ingested
     # host/store tables stay sharded in HBM across submits, LRU-evicted
@@ -122,6 +126,26 @@ class DryadConfig:
     # recomputation of DrDynamicRangeDistributor.cpp:54-110:
     # copies = sampledSize / dataPerVertex).
     rows_per_vertex: int = _env_int("DRYAD_TPU_ROWS_PER_VERTEX", 1 << 18)
+    # How many overflow-capable stages may be DISPATCHED speculatively
+    # before the driver syncs their overflow flags in one batched
+    # readback (the GM pump's concurrent vertex management,
+    # DrMessagePump.h:116-180).  Through a ~70ms/dispatch tunnel a
+    # 5-shuffle pipeline pays one control round-trip instead of five;
+    # an overflow re-runs the affected suffix at a larger boost.
+    # 1 = legacy per-stage sync.
+    overflow_sync_depth: int = _env_int("DRYAD_TPU_OVERFLOW_SYNC_DEPTH", 4)
+    # Stage-level fan-out adaptation (DrDynamicRangeDistributor.cpp:
+    # 54-110: consumer copies = observed size / data-per-vertex): when a
+    # stage's input row count is STATICALLY bounded at or below
+    # tail_fanout_rows (post-aggregation tails, take(n) heads, dense-K
+    # domains), its exchange concentrates rows onto
+    # ceil(rows / tail_rows_per_partition) partitions instead of all P —
+    # the remaining partitions run empty (masked) and per-partition
+    # padding shrinks.  0 disables.
+    tail_fanout_rows: int = _env_int("DRYAD_TPU_TAIL_FANOUT_ROWS", 4096)
+    tail_rows_per_partition: int = _env_int(
+        "DRYAD_TPU_TAIL_ROWS_PER_PARTITION", 512
+    )
 
     def __post_init__(self) -> None:
         self.validate()
@@ -152,3 +176,9 @@ class DryadConfig:
             raise ValueError("rows_per_vertex must be >= 1")
         if self.device_cache_bytes < 0:
             raise ValueError("device_cache_bytes must be >= 0")
+        if self.overflow_sync_depth < 1:
+            raise ValueError("overflow_sync_depth must be >= 1")
+        if self.tail_fanout_rows < 0:
+            raise ValueError("tail_fanout_rows must be >= 0")
+        if self.tail_rows_per_partition < 1:
+            raise ValueError("tail_rows_per_partition must be >= 1")

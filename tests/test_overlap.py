@@ -54,7 +54,9 @@ def test_shuffle_stages_still_sync(rng):
     ctx = DryadContext(num_partitions_=8)
     ev = EventLog(None)
     ctx.executor.events = ev
-    tbl = {"k": rng.integers(0, 100, 2048).astype(np.int32)}
+    # keys start at -1: the int auto-dense rewrite (0-based domains)
+    # stays off, so the group_by really shuffles
+    tbl = {"k": (rng.integers(0, 100, 2048) - 1).astype(np.int32)}
     out = ctx.from_arrays(tbl).group_by("k", {"c": ("count", None)}).collect()
     assert int(out["c"].sum()) == 2048
     done = [e for e in ev.events() if e["kind"] == "stage_complete"]

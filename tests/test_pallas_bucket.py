@@ -62,9 +62,14 @@ def test_dense_group_by_end_to_end(rng, ctx_kw):
     order = np.argsort(out["k"])
     np.testing.assert_array_equal(np.sort(out["k"]), present)
     np.testing.assert_array_equal(out["c"][order], ref_c[present])
-    np.testing.assert_allclose(out["s"][order], ref_s[present], rtol=1e-4)
+    # float sums use split-bf16 accumulation (~2^-16 per element;
+    # cancellation in near-zero groups amplifies the relative error)
     np.testing.assert_allclose(
-        out["m"][order], ref_s[present] / ref_c[present], rtol=1e-4
+        out["s"][order], ref_s[present], rtol=1e-3, atol=1e-3
+    )
+    np.testing.assert_allclose(
+        out["m"][order], ref_s[present] / ref_c[present], rtol=1e-3,
+        atol=1e-3
     )
 
 
@@ -130,3 +135,31 @@ def test_huge_bucket_count_uses_fallback(rng):
     sums, cnt = bucket_sum_count(k, [v], np.ones(n, bool), big)
     assert float(cnt.sum()) == n
     np.testing.assert_allclose(np.asarray(sums[0]), np.asarray(cnt))
+
+
+def test_int_sums_exact_to_2p24(rng):
+    """Integer value columns use 3 split-bf16 terms: every value below
+    2^24 is represented exactly, keeping the documented dense-path
+    integer contract after the round-4 native-rate rewrite."""
+    n, K = 1024, 16
+    k = rng.integers(0, K, n).astype(np.int32)
+    # large, awkward integers just under 2^24
+    v = (rng.integers(0, (1 << 24) - 1, n)).astype(np.int32)
+    sums, cnt = bucket_sum_count(
+        k, [v], np.ones(n, bool), K, interpret=True
+    )
+    ref = np.bincount(k, weights=v.astype(np.float64), minlength=K)
+    # per-element representation is exact; only f32 accumulation of
+    # ~64 terms per bucket rounds (sums near 2^29 -> ulp ~64)
+    np.testing.assert_allclose(np.asarray(sums[0]), ref, rtol=1e-6)
+
+
+def test_float_split_accuracy_vs_f64(rng):
+    """2-term float split: per-element error ~2^-16, far tighter than
+    single-pass bf16 (~4e-3)."""
+    n, K = 4096, 8
+    k = rng.integers(0, K, n).astype(np.int32)
+    v = np.abs(rng.standard_normal(n)).astype(np.float32)  # no cancel
+    sums, _ = bucket_sum_count(k, [v], np.ones(n, bool), K, interpret=True)
+    ref = np.bincount(k, weights=v.astype(np.float64), minlength=K)
+    np.testing.assert_allclose(np.asarray(sums[0]), ref, rtol=3e-5)
