@@ -437,7 +437,10 @@ class DryadContext:
         return fp
 
     def _execute_device(self, query: Query) -> ColumnBatch:
-        graph = lower([query.node], self.config, self.dictionary)
+        graph = lower(
+            [query.node], self.config, self.dictionary,
+            P=num_partitions(self.mesh) if self.mesh is not None else None,
+        )
         bindings = {
             nid: self._bind_device(n) for nid, n in graph.inputs.items()
         }

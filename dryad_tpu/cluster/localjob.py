@@ -248,6 +248,8 @@ class LocalJobSubmission:
         self._registered: set = set()
         self._dead: set = set()
         self._coord = f"{self.advertise}:{_free_port()}"
+        self._base_job_id = self.job_id
+        self._gen = 0  # gang generation (bumped by rebuild_gang)
         for i in range(self.n - max(defer_workers, 0)):
             self.start_worker(i)
 
@@ -431,9 +433,93 @@ class LocalJobSubmission:
 
         return fn
 
-    def submit(self, query) -> Dict[str, np.ndarray]:
+    def rebuild_gang(self, num_workers: Optional[int] = None) -> int:
+        """Mid-job gang elasticity (the reference's mutable computer
+        set, ``ClusterInterface/Interfaces.cs:336-343``,
+        ``LocalScheduler.cs:88``): reshape the gang to ``num_workers``
+        (default: the current survivors) and restart it under a fresh
+        coordinator + announce namespace.  The multi-controller JAX
+        runtime pins its membership at init, so a gang that lost a
+        member RESTARTS rather than limping — survivors (possibly
+        wedged in collectives with the dead peer) are stopped, every
+        slot respawns, and the caller re-runs its submission."""
+        dead = set(self._dead) | {
+            i for i, h in self._handles.items()
+            if self.launcher.poll(h) is not None
+        }
+        target = num_workers if num_workers is not None else max(
+            1, self.n - len(dead)
+        )
+        self.events.emit(
+            "gang_rebuild", dead=sorted(dead), workers=target,
+            generation=self._gen + 1,
+        )
+        for h in self._handles.values():
+            try:
+                if self.launcher.poll(h) is None:
+                    self.launcher.stop(h)
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+        for i in list(self._registered):
+            self.scheduler.remove_computer(f"worker{i}")
+        self._handles.clear()
+        self._logs.clear()
+        self._registered.clear()
+        self._dead.clear()
+        self._status_ver.clear()
+        self.n = target
+        self._gen += 1
+        # Fresh namespace: stale announce/status props from the old
+        # generation must not satisfy the new gang's membership wait.
+        from dryad_tpu.parallel.multihost import ControlPlane
+
+        self.job_id = f"{self._base_job_id}-g{self._gen}"
+        self._cp = ControlPlane(self.job_id, -1, mailbox=self.service.mailbox)
+        self._coord = f"{self.advertise}:{_free_port()}"
+        for i in range(self.n):
+            self.start_worker(i)
+        return target
+
+    def submit(
+        self, query, auto_recover: bool = True
+    ) -> Dict[str, np.ndarray]:
         """Pack the query, run it across the worker gang, assemble the
-        result table (reference SubmitAndWait)."""
+        result table (reference SubmitAndWait).
+
+        ``auto_recover``: a gang member dying MID-JOB no longer fails
+        the submission — the gang auto-shrinks to the survivors
+        (:meth:`rebuild_gang`) and the job re-runs, up to two
+        reshapes (the elastic computer-set semantics of the
+        reference's scheduler)."""
+        attempts = 0
+        while True:
+            try:
+                return self._submit_gang(query)
+            except (RuntimeError, TimeoutError):
+                dead = {
+                    i for i, h in self._handles.items()
+                    if self.launcher.poll(h) is not None
+                }
+                if (
+                    not auto_recover
+                    or not dead
+                    or attempts >= 2
+                    or self.n - len(dead) < 1
+                ):
+                    raise
+                attempts += 1
+                self.events.emit(
+                    "gang_member_lost_mid_job", dead=sorted(dead),
+                    attempt=attempts,
+                )
+                log.warning(
+                    "gang member(s) %s died mid-job; shrinking to %d "
+                    "workers and re-running", sorted(dead),
+                    self.n - len(dead),
+                )
+                self.rebuild_gang()
+
+    def _submit_gang(self, query) -> Dict[str, np.ndarray]:
         self._check_workers_alive()
         self._sync_membership()
         self._seq += 1
@@ -451,22 +537,37 @@ class LocalJobSubmission:
         t_run0 = time.monotonic()
         self.events.emit("gang_run_start", seq=seq, workers=self.n)
         procs = []
-        for i in range(self.n):
-            p = ClusterProcess(
-                self._command_round_trip(i, cmd),
-                name=f"run{seq}-w{i}",
-                affinities=[Affinity(f"worker{i}", hard=True)],
-            )
-            self.scheduler.schedule(p)
-            procs.append(p)
-        for i, p in enumerate(procs):
-            if not p.wait(self.timeout + 30.0):
-                self.scheduler.cancel(p)
-                raise TimeoutError(f"worker {i} command round-trip hung")
-        failed = [p for p in procs if p.state is not ProcessState.COMPLETED]
-        if failed:
-            errs = "; ".join(f"{p.name}: {p.error}" for p in failed)
-            raise RuntimeError(f"local job failed: {errs}")
+        terminal = (
+            ProcessState.COMPLETED, ProcessState.FAILED,
+            ProcessState.CANCELED,
+        )
+        try:
+            for i in range(self.n):
+                p = ClusterProcess(
+                    self._command_round_trip(i, cmd),
+                    name=f"run{seq}-w{i}",
+                    affinities=[Affinity(f"worker{i}", hard=True)],
+                )
+                self.scheduler.schedule(p)
+                procs.append(p)
+            for i, p in enumerate(procs):
+                if not p.wait(self.timeout + 30.0):
+                    raise TimeoutError(
+                        f"worker {i} command round-trip hung"
+                    )
+            failed = [
+                p for p in procs if p.state is not ProcessState.COMPLETED
+            ]
+            if failed:
+                errs = "; ".join(f"{p.name}: {p.error}" for p in failed)
+                raise RuntimeError(f"local job failed: {errs}")
+        except BaseException:
+            # a failed/auto-recovering gang run must not leak queued
+            # commands into the (possibly rebuilt) gang's mailboxes
+            for p in procs:
+                if p.state not in terminal:
+                    self.scheduler.cancel(p)
+            raise
         # Gang runs are lockstep (a mid-program straggler cannot be
         # duplicated), so the duration model here SURFACES outliers for
         # the jobview diagnosis rather than acting (the stage-level half

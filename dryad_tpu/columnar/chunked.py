@@ -1,0 +1,75 @@
+"""Windowed chunk-parallel ranged reads through the native Fifo.
+
+The async channel-buffer read-ahead pipeline of the reference's DFS
+stream readers (``channelbufferhdfs.cpp``; Azure page reads in
+``DrAzureBlobClient.h``) factored once for every ranged-byte client:
+a thread pool fetches ``chunk``-sized ranges ahead, completed chunks
+flow to the consumer IN ORDER through the native ``Fifo``
+(``runtime/native/dryadnative.cpp``), and memory stays bounded at
+``depth`` chunks while the pipe stays full.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, List
+
+
+def chunked_read(
+    size: int,
+    fetch_range: Callable[[int, int], bytes],
+    chunk: int,
+    threads: int = 4,
+    depth: int = 4,
+) -> bytes:
+    """Read ``size`` bytes as parallel ranged fetches, reassembled in
+    order.  ``fetch_range(offset, length) -> bytes``."""
+    if size <= chunk:
+        return fetch_range(0, size) if size else b""
+    from dryad_tpu.runtime.bindings import Fifo
+
+    nchunks = -(-size // chunk)
+    fifo = Fifo(depth=depth)
+    err: List[BaseException] = []
+
+    def feed() -> None:
+        try:
+            with ThreadPoolExecutor(max_workers=threads) as ex:
+                futs = [
+                    ex.submit(
+                        fetch_range,
+                        i * chunk,
+                        min(chunk, size - i * chunk),
+                    )
+                    for i in range(nchunks)
+                ]
+                # in-order push; the pool keeps later chunks fetching
+                for f in futs:
+                    if not fifo.push(f.result()):
+                        for g in futs:
+                            g.cancel()
+                        return
+        except BaseException as e:  # noqa: BLE001 - surfaced below
+            err.append(e)
+        finally:
+            fifo.close()
+
+    t = threading.Thread(target=feed, daemon=True)
+    t.start()
+    out = bytearray()
+    try:
+        while True:
+            block = fifo.pop()
+            if block is None:
+                break
+            out += block
+    finally:
+        fifo.close()
+        t.join()
+        fifo.destroy()
+    if err:
+        raise err[0]
+    if len(out) != size:
+        raise IOError(f"chunked read: got {len(out)} of {size} bytes")
+    return bytes(out)

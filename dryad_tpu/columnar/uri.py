@@ -340,6 +340,65 @@ class WebHdfsProvider(DataProvider):
         )
 
 
+class AzureBlobProvider(DataProvider):
+    """``wasb://container@host[:port]/path`` (and ``abfs://``) speaking
+    REAL Azure Blob REST (``columnar/azblob.py``: ranged Get Blob,
+    BlockBlob Put, XML List Blobs — the surface of
+    ``DrAzureBlobClient.h:25,42``).  SAS auth via
+    ``DRYAD_TPU_AZURE_SAS``.
+
+    URIs WITHOUT the ``container@`` authority, or any URI when
+    ``DRYAD_TPU_DFS_GATEWAY`` is set, keep the legacy framework
+    file-gateway route (``DfsGatewayProvider``) — the secured-cluster /
+    Shared-Key escape hatch."""
+
+    THREADS = 4
+
+    def __init__(self, scheme: str, gateway: "DfsGatewayProvider"):
+        self.scheme = scheme
+        self.gateway = gateway
+
+    def _route(self, rest: str):
+        from dryad_tpu.columnar.azblob import (
+            AzureBlobClient, parse_wasb_netloc,
+        )
+
+        if os.environ.get("DRYAD_TPU_DFS_GATEWAY"):
+            return None
+        try:
+            container, host, port, base = parse_wasb_netloc(rest)
+        except ValueError:
+            return None  # no container@ authority: legacy gateway form
+        return AzureBlobClient(host, port), container, base
+
+    def read(self, rest: str) -> ReadResult:
+        routed = self._route(rest)
+        if routed is None:
+            return self.gateway.read(rest)
+        client, container, base = routed
+        return _read_store_via(
+            lambda name: client.get_blob(
+                container, f"{base}/{name}" if base else name
+            ),
+            self.THREADS,
+        )
+
+    def write(self, rest, partitions, schema, dictionary, compression):
+        routed = self._route(rest)
+        if routed is None:
+            return self.gateway.write(
+                rest, partitions, schema, dictionary, compression
+            )
+        client, container, base = routed
+        client.create_container(container)
+        _write_store_via(
+            lambda name, data: client.put_blob(
+                container, f"{base}/{name}" if base else name, data
+            ),
+            partitions, schema, dictionary, compression, self.THREADS,
+        )
+
+
 _HTTP = HttpStoreProvider()
 register_provider("partfile", PartfileProvider())
 register_provider("file", TextFileProvider())
@@ -347,4 +406,6 @@ register_provider("mem", MemProvider())
 register_provider("http", _HTTP)
 register_provider("hdfs", WebHdfsProvider())
 for _scheme in ("wasb", "abfs"):
-    register_provider(_scheme, DfsGatewayProvider(_scheme, _HTTP))
+    register_provider(
+        _scheme, AzureBlobProvider(_scheme, DfsGatewayProvider(_scheme, _HTTP))
+    )

@@ -28,9 +28,7 @@ from __future__ import annotations
 import http.client
 import json
 import os
-import threading
 import urllib.parse
-from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
 DEFAULT_CHUNK = 4 * 1024 * 1024
@@ -172,59 +170,16 @@ class WebHdfsClient:
         """Whole file, chunked-parallel: a ``depth``-deep window of
         ranged reads on a thread pool, re-ordered through the native
         Fifo so the consumer sees bytes in order with bounded
-        memory (the channelbufferhdfs read-ahead pipeline)."""
+        memory (the channelbufferhdfs read-ahead pipeline,
+        ``columnar/chunked.py``)."""
+        from dryad_tpu.columnar.chunked import chunked_read
+
         size = int(self.status(path)["length"])
-        if size <= self.chunk:
-            return self.open_range(path, 0, size or None) if size else b""
-        from dryad_tpu.runtime.bindings import Fifo
-
-        nchunks = -(-size // self.chunk)
-        fifo = Fifo(depth=self.depth)
-        err: List[BaseException] = []
-
-        def feed() -> None:
-            try:
-                with ThreadPoolExecutor(max_workers=self.threads) as ex:
-                    futs = [
-                        ex.submit(
-                            self.open_range,
-                            path,
-                            i * self.chunk,
-                            min(self.chunk, size - i * self.chunk),
-                        )
-                        for i in range(nchunks)
-                    ]
-                    # in-order push; the pool keeps later chunks fetching
-                    for f in futs:
-                        if not fifo.push(f.result()):
-                            for g in futs:
-                                g.cancel()
-                            return
-            except BaseException as e:  # noqa: BLE001 - surfaced below
-                err.append(e)
-            finally:
-                fifo.close()
-
-        t = threading.Thread(target=feed, daemon=True)
-        t.start()
-        out = bytearray()
-        try:
-            while True:
-                block = fifo.pop()
-                if block is None:
-                    break
-                out += block
-        finally:
-            fifo.close()
-            t.join()
-            fifo.destroy()
-        if err:
-            raise err[0]
-        if len(out) != size:
-            raise IOError(
-                f"webhdfs read {path}: got {len(out)} of {size} bytes"
-            )
-        return bytes(out)
+        return chunked_read(
+            size,
+            lambda off, ln: self.open_range(path, off, ln),
+            self.chunk, self.threads, self.depth,
+        )
 
     def create(self, path: str, data: bytes, overwrite: bool = True) -> None:
         """Two-step CREATE: PUT to the namenode with no body -> 307

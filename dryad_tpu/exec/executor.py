@@ -98,6 +98,9 @@ class GraphExecutor:
         # (stage name, device int32) dictionary-miss counters awaiting
         # their deferred readback (_check_pending_miss)
         self._pending_miss: List[Tuple[str, Any]] = []
+        # (stage, fp, outs) checkpoint saves of miss-GUARDED stages,
+        # persisted only after their counters drain clean
+        self._pending_ckpt: List[Tuple[Any, Any, Any]] = []
         self.checkpoints = (
             CheckpointStore(self.config.checkpoint_dir)
             if self.config.checkpoint_dir
@@ -177,16 +180,27 @@ class GraphExecutor:
         # only the counters THIS call added; on failure discard them so
         # a stale counter can't fail a later unrelated job.
         mark = len(self._pending_miss)
+        mark_ckpt = len(self._pending_ckpt)
         try:
             with profile:
                 self._execute_stages(graph, bindings, results, binding_fps, stage_fps)
         except BaseException:
             del self._pending_miss[mark:]
+            del self._pending_ckpt[mark_ckpt:]
             raise
         finally:
             if not isinstance(profile, contextlib.nullcontext):
                 self._profiling = False
-        self._check_pending_miss(mark)
+        try:
+            self._check_pending_miss(mark)
+        except BaseException:
+            # guarded stages' results are poisoned — never persist them
+            del self._pending_ckpt[mark_ckpt:]
+            raise
+        # miss counters clean: guarded stages' checkpoints may persist
+        for stage, fp, outs in self._pending_ckpt[mark_ckpt:]:
+            self._write_checkpoint(stage, fp, outs)
+        del self._pending_ckpt[mark_ckpt:]
         self.events.emit("job_complete")
         return results
 
@@ -311,19 +325,38 @@ class GraphExecutor:
         )
         if _stage_has_miss_guard(stage):
             self._pending_miss.append((stage.name, w["miss"]))
-        if self.checkpoints is not None and w["fp"] is not None:
-            try:
-                path = self.checkpoints.save(
-                    stage, w["fp"], tuple(w["outs"][: len(stage.out_slots)])
-                )
-                self.events.emit(
-                    "stage_checkpoint_saved", stage=stage.id,
-                    name=stage.name, path=path,
-                )
-            except OSError as e:
-                log.warning(
-                    "checkpoint save failed for %s: %s", stage.name, e
-                )
+        self._save_checkpoint(stage, w["fp"], w["outs"])
+
+    def _save_checkpoint(self, stage, fp, outs) -> None:
+        """Shared checkpoint save (sync + deferred paths).  Stages with
+        a dense-domain miss guard DEFER their save to the job-end miss
+        drain: saving now could persist a dropped-rows result that a
+        later identical submission would load, silently bypassing the
+        loud-failure guarantee (code-review r4)."""
+        if self.checkpoints is None or fp is None:
+            return
+        if _stage_has_miss_guard(stage):
+            self._pending_ckpt.append((stage, fp, outs))
+            return
+        self._write_checkpoint(stage, fp, outs)
+
+    def _write_checkpoint(self, stage, fp, outs) -> None:
+        if self.config.checkpoint_retain_seconds is not None:
+            n = self.checkpoints.gc(self.config.checkpoint_retain_seconds)
+            if n:
+                self.events.emit("checkpoint_gc", removed=n)
+        try:
+            path = self.checkpoints.save(
+                stage, fp, tuple(outs[: len(stage.out_slots)])
+            )
+            self.events.emit(
+                "stage_checkpoint_saved", stage=stage.id,
+                name=stage.name, path=path,
+            )
+        except OSError as e:
+            log.warning(
+                "checkpoint save failed for %s: %s", stage.name, e
+            )
 
     def _resolve_inputs(
         self,
@@ -383,12 +416,14 @@ class GraphExecutor:
             op.params.get("nparts") for op in stage.ops
             if op.params.get("nparts")
         ]
-        if fan:
+        # kernels disable fan reduction on hybrid meshes and clamp to
+        # P; the event must describe what actually runs
+        if fan and len(mesh_axes(self.mesh)) == 1 and min(fan) < self.P:
             # stage-level fan-out adaptation record (the rewired-graph
             # event of DrDynamicRangeDistributor.cpp:54-110)
             self.events.emit(
                 "stage_fanout", stage=stage.id, name=stage.name,
-                nparts=min(min(fan), self.P), of=self.P,
+                nparts=min(fan), of=self.P,
             )
         can_overflow = any(
             op.kind not in NON_OVERFLOW_OPS for op in stage.ops
@@ -488,28 +523,7 @@ class GraphExecutor:
                 self._pending_miss.append((stage.name, dict_miss))
             for i, out_idx in enumerate(range(len(stage.out_slots))):
                 results[(stage.id, out_idx)] = outs[i]
-            if (
-                self.checkpoints is not None
-                and self.config.checkpoint_retain_seconds is not None
-            ):
-                n = self.checkpoints.gc(self.config.checkpoint_retain_seconds)
-                if n:
-                    self.events.emit("checkpoint_gc", removed=n)
-            if self.checkpoints is not None and fp is not None:
-                try:
-                    path = self.checkpoints.save(
-                        stage, fp, tuple(outs[: len(stage.out_slots)])
-                    )
-                    self.events.emit(
-                        "stage_checkpoint_saved", stage=stage.id,
-                        name=stage.name, path=path,
-                    )
-                except OSError as e:
-                    # the computation succeeded; a full/unwritable
-                    # checkpoint volume must not fail the job
-                    log.warning(
-                        "checkpoint save failed for %s: %s", stage.name, e
-                    )
+            self._save_checkpoint(stage, fp, outs)
             return
 
     def _run_do_while(

@@ -123,8 +123,13 @@ def _fanout(ctx: StageContext, nparts) -> int:
     """Effective destination count for a fan-reduced exchange (stage-
     level fan-out adaptation, ``DrDynamicRangeDistributor.cpp:54-110``):
     rows concentrate onto the first ``nparts`` partitions; the rest run
-    the stage masked-empty."""
-    return min(int(nparts), ctx.P) if nparts else ctx.P
+    the stage masked-empty.  On hybrid (2-axis) meshes the tree
+    exchange ignores nparts, so reduction is disabled there outright —
+    a half-applied reduction would inflate the paired resize by P/P_eff
+    while the data actually spread full-width."""
+    if not nparts or len(ctx.axes) != 1:
+        return ctx.P
+    return min(int(nparts), ctx.P)
 
 
 def _do_exchange_hash(

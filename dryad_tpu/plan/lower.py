@@ -65,9 +65,11 @@ class StageGraph:
 
 
 class _Builder:
-    def __init__(self, config, dictionary=None) -> None:
+    def __init__(self, config, dictionary=None, P: Optional[int] = None) -> None:
         self.config = config
         self.dictionary = dictionary
+        # mesh width when the caller knows it (fan-out decisions)
+        self.P = P
         self.stages: List[Stage] = []
         self.open: Dict[int, Stage] = {}  # stage id -> stage (not yet closed)
         # node id -> ("open", stage, slot) | ("closed", stage_id, out_idx)
@@ -125,7 +127,10 @@ class _Builder:
     def _tail_nparts(self, src: Node) -> Optional[int]:
         """ceil(bounded rows / tail_rows_per_partition) when the source
         is statically tiny — the masked-partition fan-out for the
-        consumer exchange; None = full width."""
+        consumer exchange; None = full width.  A result at or above the
+        mesh width ``self.P`` (when known) is no reduction at all, and
+        returning it would needlessly mark the node reduced (forcing
+        joins to re-exchange a correctly co-partitioned side)."""
         limit = getattr(self.config, "tail_fanout_rows", 4096)
         if not limit:
             return None
@@ -133,7 +138,10 @@ class _Builder:
         if est is None or est > limit:
             return None
         per = max(1, getattr(self.config, "tail_rows_per_partition", 512))
-        return max(1, -(-est // per))
+        nparts = max(1, -(-est // per))
+        if self.P is not None and nparts >= self.P:
+            return None
+        return nparts
 
     # -- stage bookkeeping -------------------------------------------------
     def _new_stage(self, name: str, input_refs: List[Tuple[Any, int]]) -> Stage:
@@ -1007,12 +1015,16 @@ def _rewrite_topk(roots: Sequence[Node], limit: int) -> List[Node]:
     return [rb(r) for r in roots]
 
 
-def lower(roots: Sequence[Node], config, dictionary=None) -> StageGraph:
+def lower(
+    roots: Sequence[Node], config, dictionary=None, P: Optional[int] = None
+) -> StageGraph:
     """Lower a logical DAG to a stage graph (Phase 2+3).
 
     ``dictionary``: the context StringDictionary, enabling the
-    auto-dense STRING group_by rewrite (codes against its entries)."""
-    b = _Builder(config, dictionary)
+    auto-dense STRING group_by rewrite (codes against its entries).
+    ``P``: mesh partition count when known — lets the fan-out
+    adaptation skip no-op reductions at or above the mesh width."""
+    b = _Builder(config, dictionary, P)
     rewritten = _rewrite_topk(roots, getattr(config, "topk_limit", 1024))
     fanout = consumers(rewritten)
     for node in walk(rewritten):

@@ -433,3 +433,37 @@ def test_int_auto_dense_project_and_default_if_empty(rng):
     )
     out = q2.collect()  # sort path: the fabricated key 99 must survive
     assert out["k"].tolist() == [99] and out["c"].tolist() == [1]
+
+
+def test_range_miss_never_persists_a_poisoned_checkpoint(rng, tmp_path):
+    """A guarded dense stage whose miss counter fires must not have
+    saved a checkpoint: re-running the identical (still-poisoned) query
+    raises AGAIN instead of silently loading dropped-row aggregates
+    (code-review r4)."""
+    from dryad_tpu.exec.executor import StageFailedError
+
+    ctx = DryadContext(
+        num_partitions_=8,
+        config=DryadConfig(checkpoint_dir=str(tmp_path / "ck")),
+    )
+    arrays = {"k": rng.integers(0, 20, 400).astype(np.int32)}
+    q = ctx.from_arrays(arrays).group_by("k", {"c": ("count", None)})
+    arrays["k"][:] = arrays["k"] + 100  # fabricate past the ingest range
+    with pytest.raises(StageFailedError, match="ingest-time range"):
+        q.collect()
+    with pytest.raises(StageFailedError, match="ingest-time range"):
+        q.collect()  # would silently succeed if the checkpoint leaked
+    # a CLEAN guarded stage still checkpoints (after the drain)
+    ctx2 = DryadContext(
+        num_partitions_=8,
+        config=DryadConfig(checkpoint_dir=str(tmp_path / "ck2")),
+    )
+    out = ctx2.from_arrays(
+        {"k": rng.integers(0, 20, 400).astype(np.int32)}
+    ).group_by("k", {"c": ("count", None)}).collect()
+    assert int(np.sum(out["c"])) == 400
+    saved = [
+        e for e in ctx2.events.events()
+        if e["kind"] == "stage_checkpoint_saved"
+    ]
+    assert saved, "clean guarded stage should checkpoint after the drain"

@@ -1,0 +1,104 @@
+"""Real Azure Blob REST protocol: client + wasb:// provider against
+the in-tree stub server (``tools/azblob_stub.py``).
+
+Reference parity: ``GraphManager/filesystem/DrAzureBlobClient.h:25,42``
+(Blob REST read/write), with the ``channelbuffer`` read-ahead applied
+via the shared chunked pipeline.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from dryad_tpu import DryadContext
+from dryad_tpu.columnar.azblob import (
+    AzureBlobClient, AzureBlobError, parse_wasb_netloc,
+)
+from dryad_tpu.tools.azblob_stub import AzureBlobStubServer
+
+
+@pytest.fixture
+def stub(tmp_path):
+    with AzureBlobStubServer(str(tmp_path / "az-root")) as srv:
+        yield srv
+
+
+@pytest.fixture
+def client(stub):
+    return AzureBlobClient(
+        stub.host, stub.port, https=False, chunk=64 * 1024, threads=3
+    )
+
+
+def test_put_head_get_roundtrip(stub, client):
+    data = os.urandom(1234)
+    client.create_container("c1")
+    client.put_blob("c1", "dir/f.bin", data)
+    assert client.blob_size("c1", "dir/f.bin") == 1234
+    assert client.get_range("c1", "dir/f.bin", 100, 50) == data[100:150]
+    assert client.get_blob("c1", "dir/f.bin") == data
+
+
+def test_chunked_parallel_get(stub, client):
+    data = os.urandom(client.chunk * 4 + 999)
+    client.create_container("c2")
+    client.put_blob("c2", "big.bin", data)
+    assert client.get_blob("c2", "big.bin") == data
+    assert stub.bytes_read >= len(data)
+
+
+def test_list_and_delete(stub, client):
+    client.create_container("c3")
+    client.put_blob("c3", "a/x", b"1")
+    client.put_blob("c3", "a/y", b"2")
+    client.put_blob("c3", "b/z", b"3")
+    assert client.list_blobs("c3") == ["a/x", "a/y", "b/z"]
+    assert client.list_blobs("c3", prefix="a/") == ["a/x", "a/y"]
+    assert client.delete_blob("c3", "a/x")
+    assert not client.delete_blob("c3", "a/x")
+    assert client.list_blobs("c3", prefix="a/") == ["a/y"]
+
+
+def test_errors_are_azure_xml(stub, client):
+    with pytest.raises(FileNotFoundError):
+        client.blob_size("nope", "missing")
+    with pytest.raises(AzureBlobError, match="ContainerNotFound"):
+        client.put_blob("nope", "f", b"x")
+
+
+def test_parse_wasb_netloc():
+    c, h, p, path = parse_wasb_netloc("data@acct.blob.example:8888/wh/t1")
+    assert (c, h, p, path) == ("data", "acct.blob.example", 8888, "wh/t1")
+    with pytest.raises(ValueError):
+        parse_wasb_netloc("127.0.0.1:80/container/blob")  # legacy form
+
+
+def test_store_roundtrip_via_wasb(stub, mesh8, rng):
+    """to_store/from_store on a wasb:// container@host URI speak real
+    Blob REST end-to-end (no gateway env)."""
+    os.environ.pop("DRYAD_TPU_DFS_GATEWAY", None)
+    ctx = DryadContext(num_partitions_=8)
+    tbl = {
+        "k": rng.integers(0, 40, 500).astype(np.int32),
+        "v": rng.standard_normal(500).astype(np.float32),
+    }
+    uri = f"wasb://warehouse@{stub.host}:{stub.port}/tables/t1"
+    ctx.from_arrays(tbl).group_by(
+        "k", {"c": ("count", None)}
+    ).to_store(uri)
+    out = DryadContext(num_partitions_=8).from_store(uri).collect()
+    ref = np.bincount(tbl["k"], minlength=40)
+    got = dict(zip(out["k"].tolist(), out["c"].tolist()))
+    assert got == {int(k): int(c) for k, c in enumerate(ref) if c}
+    assert stub.bytes_written > 0 and stub.bytes_read > 0
+
+
+def test_abfs_scheme_same_surface(stub, mesh8, rng):
+    os.environ.pop("DRYAD_TPU_DFS_GATEWAY", None)
+    ctx = DryadContext(num_partitions_=8)
+    tbl = {"v": np.arange(64, dtype=np.int32)}
+    uri = f"abfs://fs1@{stub.host}:{stub.port}/t2"
+    ctx.from_arrays(tbl).to_store(uri)
+    out = DryadContext(num_partitions_=8).from_store(uri).collect()
+    assert sorted(out["v"].tolist()) == list(range(64))
