@@ -472,14 +472,24 @@ def _gather_all(b: ColumnBatch, axes: Tuple[str, ...]) -> ColumnBatch:
 def _join_strategy(ctx: StageContext, p, right: ColumnBatch) -> bool:
     """True -> broadcast the right side; False -> co-hash-partition.
 
-    The capacity-based analog of the reference's dynamic broadcast
-    decision (``DynamicManager.cs:51``): capacities are static at trace
-    time, so the choice is baked per compiled shape and cached."""
+    The analog of the reference's dynamic broadcast decision
+    (``DynamicManager.cs:51``, which reads actual data size): when the
+    plan carries a static ROW-count bound for the right side
+    (take(n) heads, aggregates, dense domains — lower.py's estimator),
+    that bound decides; otherwise fall back to the capacity heuristic.
+    Both are trace-time static, so the choice is baked per compiled
+    shape and cached."""
     strategy = p.get("strategy", "shuffle")
     if strategy == "broadcast":
         return True
     if strategy == "auto":
-        return right.capacity * ctx.P <= p.get("broadcast_limit", 1 << 16)
+        limit = p.get("broadcast_limit", 1 << 16)
+        est = p.get("est_right")
+        if est is not None:
+            # global row bound: a mostly-empty right batch with large
+            # CAPACITY still broadcasts when its rows are bounded small
+            return est <= limit
+        return right.capacity * ctx.P <= limit
     return False
 
 
@@ -506,7 +516,20 @@ def _apply_join_strategy(ctx: StageContext, p) -> int:
     )
     if "strategy" in p:
         if _join_strategy(ctx, p, ctx.slots[p["right_slot"]]):
-            ctx.slots[p["right_slot"]] = _gather_all(ctx.slots[p["right_slot"]], ctx.axes)
+            right = ctx.slots[p["right_slot"]]
+            est = p.get("est_right")
+            if est is not None:
+                # An est-bound broadcast must not gather the FULL
+                # capacity (P x cap could dwarf broadcast_limit):
+                # shrink each partition to the global row bound first —
+                # per-partition valid <= global valid <= est, so this
+                # cannot overflow.
+                tight = _round8(min(right.capacity, max(8, int(est))))
+                if tight < right.capacity:
+                    right, ovf = SH.resize(right, tight)
+                    ctx.overflow = ctx.overflow | ovf
+                    ctx.slots[p["right_slot"]] = right
+            ctx.slots[p["right_slot"]] = _gather_all(right, ctx.axes)
         else:
             _co_partition_for_join(ctx, p)
             base = max(

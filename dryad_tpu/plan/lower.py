@@ -779,6 +779,11 @@ class _Builder:
                 need_left_exchange=need_l,
                 need_right_exchange=need_r,
                 broadcast_limit=self.config.broadcast_limit,
+                # statically-bounded right-side ROW count (None =
+                # unbounded): lets the auto broadcast decision use
+                # observed-data-size bounds instead of raw capacity
+                # (DynamicManager.cs:51 decides from actual size)
+                est_right=self.est.get(right.id),
             )
         jk = node.params.get("join_kind", "inner")
         if jk == "count":

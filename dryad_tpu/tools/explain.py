@@ -76,6 +76,16 @@ def explain_stages(graph: StageGraph) -> str:
     return "\n".join(lines)
 
 
+def _ref_key(ref, idx) -> str:
+    """Stage-graph node key for an input ref: plan inputs are in<idx>,
+    producer stages s<id> (shared by the DOT and SVG renderers)."""
+    return f"in{idx}" if ref == "plan_input" else f"s{ref}"
+
+
+def _stage_exchanges(stage) -> int:
+    return sum(1 for op in stage.ops if op.kind in _EXCHANGE_OPS)
+
+
 def explain_dot(query) -> str:
     """Graphviz DOT of the fused stage graph (the JobBrowser DAG-drawing
     analog, ``JobBrowser/Tools/drawingSurface.cs`` — emitted as DOT so
@@ -89,7 +99,7 @@ def explain_dot(query) -> str:
     ]
     inputs = set()
     for s in graph.stages:
-        n_ex = sum(1 for op in s.ops if op.kind in _EXCHANGE_OPS)
+        n_ex = _stage_exchanges(s)
         label = s.name + (f"\\n{n_ex} exchange(s)" if n_ex else "")
         style = ', style=filled, fillcolor="#d6eaf8"' if n_ex else ""
         lines.append(f'  s{s.id} [label="{label}"{style}];')
@@ -113,3 +123,95 @@ def explain(query) -> str:
 
     graph = lower([query.node], query.ctx.config, query.ctx.dictionary)
     return explain_logical([query.node]) + "\n\n" + explain_stages(graph)
+
+
+def _layered_layout(graph: StageGraph):
+    """Topological layers for the SVG renderer: node -> (layer, column).
+    Inputs sit on layer 0; each stage one past its deepest producer."""
+    layer: Dict[str, int] = {}
+    for s in graph.stages:
+        deps = []
+        for ref, idx in s.input_refs:
+            key = _ref_key(ref, idx)
+            if key.startswith("in"):
+                layer.setdefault(key, 0)
+            deps.append(layer.get(key, 0))
+        layer[f"s{s.id}"] = (max(deps) + 1) if deps else 1
+    cols: Dict[str, int] = {}
+    counts: Dict[int, int] = {}
+    for key, ly in layer.items():
+        cols[key] = counts.get(ly, 0)
+        counts[ly] = counts.get(ly, 0) + 1
+    return layer, cols, counts
+
+
+def explain_svg(query) -> str:
+    """Self-contained SVG drawing of the fused stage DAG — the
+    JobBrowser drawing surface (``JobBrowser/Tools/drawingSurface.cs``)
+    without an external renderer: layered layout, exchange stages
+    highlighted, edges as arrows.  Embed in reports or save as .svg."""
+    from dryad_tpu.plan.lower import lower
+
+    graph = lower([query.node], query.ctx.config, query.ctx.dictionary)
+    layer, cols, counts = _layered_layout(graph)
+    BW, BH, GX, GY, PAD = 190, 44, 36, 70, 20
+    width = max(counts.values() or [1]) * (BW + GX) + PAD * 2
+    height = (max(layer.values() or [0]) + 1) * (BH + GY) + PAD * 2
+
+    def pos(key):
+        ly, c = layer[key], cols[key]
+        n_in_layer = counts[ly]
+        row_w = n_in_layer * BW + (n_in_layer - 1) * GX
+        x0 = (width - row_w) / 2 + c * (BW + GX)
+        return x0, PAD + ly * (BH + GY)
+
+    def esc(t: str) -> str:
+        return (
+            t.replace("&", "&amp;").replace("<", "&lt;").replace(">", "&gt;")
+        )
+
+    out = [
+        f'<svg xmlns="http://www.w3.org/2000/svg" width="{width:.0f}" '
+        f'height="{height:.0f}" font-family="monospace" font-size="11">',
+        '<defs><marker id="arr" markerWidth="8" markerHeight="8" '
+        'refX="7" refY="3" orient="auto"><path d="M0,0 L8,3 L0,6 z" '
+        'fill="#555"/></marker></defs>',
+    ]
+    # edges first (under the boxes)
+    for s in graph.stages:
+        x2, y2 = pos(f"s{s.id}")
+        for ref, idx in s.input_refs:
+            x1, y1 = pos(_ref_key(ref, idx))
+            out.append(
+                f'<line x1="{x1 + BW/2:.0f}" y1="{y1 + BH:.0f}" '
+                f'x2="{x2 + BW/2:.0f}" y2="{y2:.0f}" stroke="#555" '
+                'marker-end="url(#arr)"/>'
+            )
+    for key in layer:
+        x, y = pos(key)
+        if key.startswith("in"):
+            out.append(
+                f'<ellipse cx="{x + BW/2:.0f}" cy="{y + BH/2:.0f}" '
+                f'rx="{BW/2.4:.0f}" ry="{BH/2:.0f}" fill="#eee" '
+                'stroke="#777"/>'
+                f'<text x="{x + BW/2:.0f}" y="{y + BH/2 + 4:.0f}" '
+                f'text-anchor="middle">input#{esc(key[2:])}</text>'
+            )
+            continue
+        sid = int(key[1:])
+        s = next(st for st in graph.stages if st.id == sid)
+        n_ex = _stage_exchanges(s)
+        fill = "#d6eaf8" if n_ex else "#ffffff"
+        name = s.name if len(s.name) <= 26 else s.name[:25] + "…"
+        out.append(
+            f'<rect x="{x:.0f}" y="{y:.0f}" width="{BW}" height="{BH}" '
+            f'rx="6" fill="{fill}" stroke="#333"/>'
+            f'<text x="{x + BW/2:.0f}" y="{y + 18:.0f}" '
+            f'text-anchor="middle">{esc(name)}</text>'
+            f'<text x="{x + BW/2:.0f}" y="{y + 34:.0f}" '
+            f'text-anchor="middle" fill="#666">stage {sid}'
+            + (f" · {n_ex} exchange(s)" if n_ex else "")
+            + "</text>"
+        )
+    out.append("</svg>")
+    return "\n".join(out)

@@ -80,9 +80,11 @@ def main():
             c = jax.ops.segment_sum(valid.astype(jnp.int32), k, 4096)
             return jnp.sum(s) + jnp.sum(c)
 
-        def dense_body(interp):
+        def dense_body(interp, strat="matmul"):
             def f(k, v, valid):
-                s, c = bucket_sum_count(k, [v], valid, 4096, interpret=interp)
+                s, c = bucket_sum_count(
+                    k, [v], valid, 4096, interpret=interp, strategy=strat
+                )
                 return jnp.sum(s[0]) + jnp.sum(c)
 
             return f
@@ -116,6 +118,7 @@ def main():
             cases.append(
                 ("E dense_pallas", single(dense_body(None)), dense_body(None))
             )
+        amortized = {}
         for name, fn, body16 in cases:
             t0 = time.perf_counter()
             fn()
@@ -130,10 +133,29 @@ def main():
             lf = looped(body16)
             float(lf(k, v, valid))  # compile
             lb, _ = best_of(lambda: float(lf(k, v, valid)), reps=3)
+            rows_s = 16 * n / lb
+            amortized[name.split()[0]] = rows_s
             log(
                 f"n={n} {name}: amortized16 {lb/16*1e3:.2f}ms/iter"
-                f" -> {16*n/lb:.3e} rows/s"
+                f" -> {rows_s:.3e} rows/s"
             )
+        # The bucket-strategy decision (ops/pallas_bucket._default_strategy
+        # and the scatter-vs-sort question of ops/segmented.py): compare
+        # the MXU matmul path against the scatter-add on THIS backend.
+        mxu = amortized.get("E", amortized.get("D", 0.0))
+        scat = amortized.get("C", 0.0)
+        if mxu and scat:
+            rec = "scatter" if scat > mxu else "matmul"
+            import json
+
+            print(json.dumps({
+                "probe": "bucket_strategy", "n": n,
+                "platform": d.platform,
+                "matmul_rows_s": round(mxu, 1),
+                "scatter_rows_s": round(scat, 1),
+                "recommend": rec,
+                "env": f"DRYAD_TPU_BUCKET_STRATEGY={rec}",
+            }), flush=True)
     log("done")
 
 

@@ -94,6 +94,22 @@ _STEPS = {
                     "c": ("count", None)}
         )
     ),
+    "left_join": (  # left-outer self-join against a deterministic head
+        lambda q: q.project(["k", "g", "v"]).left_join(
+            q.project(["k", "v"]).order_by(
+                [("v", True), ("k", False)]
+            ).take(10),
+            # defaults are keyed by the RIGHT side's own column names
+            # (suffixing happens later)
+            "k", right_defaults={"v": -1.0}, expansion=32.0,
+        ).select(lambda c: {"k": c["k"], "g": c["g"],
+                            "v": c["v"] + c["v_r"]})
+    ),
+    "semi_join": (  # semi-join filter on even keys
+        lambda q: q.semi_join(
+            q.where(_where_kmod).project(["k"]), "k"
+        )
+    ),
     "gj_selector": (  # full GroupJoin: top-3-per-key self-join selector
         lambda q: q.project(["k", "g", "v"]).group_join(
             q.project(["k", "v"]), "k",
@@ -136,7 +152,7 @@ def _build_pipeline(rng, depth):
             n_groups += 1
         # select/group/project steps rebuild the schema without w/d
         if name in ("group_by", "select_double", "select_shift",
-                    "order_take", "gj_selector"):
+                    "order_take", "gj_selector", "left_join"):
             wide_ok = False
         steps.append(name)
         if name in _TERMINAL:

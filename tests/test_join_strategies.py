@@ -188,3 +188,47 @@ def test_group_join_aggregates(ctx, rng):
     np.testing.assert_allclose(
         out["s"], [sums[i] for i in range(4)], rtol=1e-4, atol=1e-5
     )
+
+
+def test_auto_broadcast_decides_from_row_bound(ctx, dbg, rng):
+    """strategy='auto' uses the plan's static ROW bound when one exists
+    (DynamicManager.cs:51 reads actual size): a right side whose
+    CAPACITY is large but whose rows are bounded tiny broadcasts
+    instead of shuffling — and stays correct."""
+    import jax.numpy as jnp
+
+    from dryad_tpu.columnar.batch import ColumnBatch
+    from dryad_tpu.exec.kernels import StageContext, _join_strategy
+
+    kctx = StageContext(P=8, slack=2.0, boost=1)
+    cap = 1 << 16
+    right = ColumnBatch(
+        {"k": jnp.zeros((cap,), jnp.int32)}, jnp.zeros((cap,), jnp.bool_)
+    )
+    base = {"strategy": "auto", "broadcast_limit": 1 << 16}
+    # capacity heuristic alone: 65536 * 8 > limit -> shuffle
+    assert _join_strategy(kctx, dict(base), right) is False
+    # a bounded-rows right (e.g. under a take(100)) -> broadcast
+    assert _join_strategy(kctx, dict(base, est_right=100), right) is True
+    assert _join_strategy(kctx, dict(base, est_right=1 << 20), right) is False
+
+    # end-to-end differential: take(50)-bounded right under auto
+    left = {
+        "k": rng.integers(0, 30, 2000).astype(np.int32),
+        "v": rng.standard_normal(2000).astype(np.float32),
+    }
+    right_t = {
+        "k": np.arange(30, dtype=np.int32),
+        "w": np.arange(30, dtype=np.int32) * 10,
+    }
+
+    def q(c):
+        r = c.from_arrays(right_t).order_by([("k", False)]).take(20)
+        return (
+            c.from_arrays(left)
+            .join(r, "k", strategy="auto")
+            .group_by("k", {"n": ("count", None)})
+            .collect()
+        )
+
+    check(q(ctx), q(dbg))

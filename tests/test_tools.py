@@ -336,3 +336,23 @@ def test_jobview_reports_do_while_state_boost(rng, tmp_path):
     assert any(
         "outgrew its capacity" in d for j in boosted for d in diagnose(j)
     )
+
+
+def test_explain_svg(rng):
+    """Self-contained SVG DAG drawing (the JobBrowser drawing surface
+    analog) — layered layout, exchange stages highlighted."""
+    import numpy as np
+    from dryad_tpu import DryadContext
+    from dryad_tpu.tools.explain import explain_svg
+
+    ctx = DryadContext(num_partitions_=8)
+    a = ctx.from_arrays(
+        {"k": (rng.integers(0, 9, 200) - 1).astype(np.int32),
+         "v": np.ones(200, np.float32)}
+    ).group_by("k", {"s": ("sum", "v")})
+    b = ctx.from_arrays({"k": (np.arange(9, dtype=np.int32) - 1)})
+    svg = explain_svg(a.join(b, "k", strategy="shuffle"))
+    assert svg.startswith("<svg") and svg.endswith("</svg>")
+    assert "exchange" in svg and "<rect" in svg and "marker-end" in svg
+    # every stage box and input ellipse is connected
+    assert svg.count("<line") >= svg.count("<rect")

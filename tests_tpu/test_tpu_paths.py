@@ -94,3 +94,105 @@ def test_auto_dense_wordcount_on_chip(jaxmod):
     uniq, counts = np.unique(words.astype(str), return_counts=True)
     got = dict(zip([str(w) for w in out["w"]], out["c"].tolist()))
     assert got == dict(zip(uniq.tolist(), counts.tolist()))
+
+
+def test_split_bf16_sums_on_chip(jaxmod):
+    """Round-4 kernel: split-bf16 value accumulation at the MXU's
+    native rate — integer values exact to 2^24 (3 terms), float values
+    ~2^-16 (2 terms) — on the real chip."""
+    import jax.numpy as jnp
+
+    from dryad_tpu.ops.pallas_bucket import bucket_sum_count
+
+    rng = np.random.default_rng(4)
+    n, K = 1 << 16, 1024
+    k = rng.integers(0, K, n).astype(np.int32)
+    iv = rng.integers(0, (1 << 24) - 1, n).astype(np.int32)
+    fv = np.abs(rng.standard_normal(n)).astype(np.float32)
+    sums, cnt = bucket_sum_count(
+        jnp.asarray(k), [jnp.asarray(iv), jnp.asarray(fv)],
+        jnp.ones((n,), jnp.bool_), K, strategy="matmul",
+    )
+    ref_i = np.bincount(k, weights=iv.astype(np.float64), minlength=K)
+    ref_f = np.bincount(k, weights=fv.astype(np.float64), minlength=K)
+    np.testing.assert_allclose(np.asarray(sums[0]), ref_i, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(sums[1]), ref_f, rtol=3e-5)
+    np.testing.assert_array_equal(
+        np.asarray(cnt), np.bincount(k, minlength=K)
+    )
+
+
+def test_scatter_strategy_on_chip(jaxmod):
+    """The scatter-add bucket strategy (probe decision seam) computes
+    correctly on the chip."""
+    import jax.numpy as jnp
+
+    from dryad_tpu.ops.pallas_bucket import bucket_sum_count
+
+    rng = np.random.default_rng(5)
+    n, K = 1 << 15, 700
+    k = rng.integers(0, K, n).astype(np.int32)
+    v = rng.standard_normal(n).astype(np.float32)
+    valid = rng.random(n) > 0.1
+    sums, cnt = bucket_sum_count(
+        jnp.asarray(k), [jnp.asarray(v)], jnp.asarray(valid), K,
+        strategy="scatter",
+    )
+    np.testing.assert_array_equal(
+        np.asarray(cnt), np.bincount(k[valid], minlength=K)
+    )
+    np.testing.assert_allclose(
+        np.asarray(sums[0]),
+        np.bincount(k[valid], weights=v[valid], minlength=K),
+        atol=1e-3,
+    )
+
+
+def test_int_auto_dense_on_chip(jaxmod):
+    """A plain group_by over an ingest-bounded INT32 key rides the
+    Pallas bucket path on the chip (shuffle-free plan, correct
+    counts)."""
+    from dryad_tpu import DryadContext
+    from dryad_tpu.plan.lower import lower
+
+    rng = np.random.default_rng(6)
+    ctx = DryadContext()
+    tbl = {
+        "k": rng.integers(0, 200, 20000).astype(np.int32),
+        "v": rng.standard_normal(20000).astype(np.float32),
+    }
+    q = ctx.from_arrays(tbl).group_by(
+        "k", {"c": ("count", None), "s": ("sum", "v")}
+    )
+    kinds = [
+        op.kind
+        for st in lower([q.node], ctx.config, ctx.dictionary).stages
+        for op in st.ops
+    ]
+    assert "group_reduce_dense" in kinds and "exchange_hash" not in kinds
+    out = q.collect()
+    ref = np.bincount(tbl["k"], minlength=200)
+    got = dict(zip(out["k"].tolist(), out["c"].tolist()))
+    assert got == {int(i): int(c) for i, c in enumerate(ref) if c}
+
+
+def test_deferred_overflow_window_on_chip(jaxmod):
+    """The speculative dispatch window (one batched overflow readback
+    per k shuffle stages — built for exactly this tunnel's ~70ms
+    dispatch latency) executes correctly on the chip."""
+    from dryad_tpu import DryadContext
+    from dryad_tpu.exec.events import EventLog
+
+    rng = np.random.default_rng(7)
+    ctx = DryadContext()
+    ev = EventLog(None)
+    ctx.executor.events = ev
+    kk = (rng.integers(0, 50, 6000) - 1).astype(np.int32)  # sort path
+    a = ctx.from_arrays(
+        {"k": kk, "v": np.ones(6000, np.float32)}
+    ).group_by("k", {"s": ("sum", "v")})
+    b = ctx.from_arrays({"k": kk}).group_by("k", {"n": ("count", None)})
+    j = a.join(b, "k", strategy="shuffle").collect()
+    assert len(j["k"]) == len(np.unique(kk))
+    kinds = [e["kind"] for e in ev.events()]
+    assert "overflow_drain" in kinds
