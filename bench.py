@@ -278,6 +278,50 @@ def wordcount_dense_metric(n: int, vocab_size: int = 1 << 14):
     )
 
 
+def hdfs_ingest_metric(n: int = 1 << 21):
+    """Ingest through the REAL WebHDFS protocol (ranged OPEN with the
+    namenode->datanode redirect, chunk-parallel reads): write a
+    partitioned store to an in-tree stub namenode over loopback, then
+    measure ``from_store("hdfs://...")`` -> collect end to end — the
+    BASELINE 1TB-ingest north-star shape at bench scale
+    (``DrHdfsClient.cpp:32-69`` / ``channelbufferhdfs.cpp`` parity)."""
+    import tempfile
+
+    from dryad_tpu import DryadContext
+    from dryad_tpu.tools.webhdfs_stub import WebHdfsStubServer
+
+    os.environ.pop("DRYAD_TPU_DFS_GATEWAY", None)
+    rng = np.random.default_rng(3)
+    tbl = {
+        "k": rng.integers(0, 1 << 20, n).astype(np.int32),
+        "v": rng.standard_normal(n).astype(np.float32),
+    }
+    nbytes = sum(a.nbytes for a in tbl.values())
+    root = tempfile.mkdtemp(prefix="bench-hdfs-")
+    with WebHdfsStubServer(root) as srv:
+        uri = f"hdfs://{srv.host}:{srv.port}/bench/t1"
+        ctx = DryadContext()
+        t0 = time.perf_counter()
+        ctx.from_arrays(tbl).to_store(uri)
+        write_s = time.perf_counter() - t0
+        log(f"hdfs egress {nbytes/1e6:.0f}MB in {write_s:.1f}s")
+
+        def run():
+            c = DryadContext()
+            out = c.from_store(uri).count()
+            assert out == n
+
+        best, times = timed_reps(run, reps=3)
+        rec = rep_record(
+            "hdfs_ingest_rows_per_sec", n, times,
+            {"mb": round(nbytes / 1e6, 1),
+             "mb_per_s": round(nbytes / 1e6 / best, 1),
+             "egress_s": round(write_s, 2),
+             "protocol": "webhdfs", "redirects": srv.redirects},
+        )
+        return rec
+
+
 def terasort_metric(n: int):
     """TeraSort end-to-end THROUGH DryadContext: random keys + payload ->
     sampled-splitter range partition -> local sort -> collect.
@@ -466,6 +510,9 @@ def main() -> None:
              "dense_xla_rows_per_sec", 1 << 22 if accel else 1 << 19,
              use_pallas=False, iters=32 if accel else 4),
          45 if accel else 15, False),
+        ("hdfs_ingest_rows_per_sec",
+         lambda: hdfs_ingest_metric(1 << 21 if accel else 1 << 19),
+         60 if accel else 25, False),
     ]
     if platform in ("tpu", "axon"):
         # The Pallas kernel only truly runs on TPU; elsewhere the number

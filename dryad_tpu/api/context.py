@@ -58,6 +58,12 @@ _NP_TYPE_MAP = {
 }
 
 
+def _word_vocab(h0: np.ndarray, h1: np.ndarray) -> np.ndarray:
+    """Unique 64-bit word hashes from split (#h0, #h1) columns."""
+    h = (h1.astype(np.uint64) << np.uint64(32)) | h0.astype(np.uint64)
+    return np.unique(h)
+
+
 def _infer_schema(arrays: Dict[str, np.ndarray]) -> Schema:
     fields = []
     for name, a in arrays.items():
@@ -183,6 +189,7 @@ class DryadContext:
         # codes against the dictionary at lowering, which runs before
         # ingest would otherwise populate it.  Skipped when the feature
         # is off — ingest registers the same strings at bind time.
+        str_vocab = {}
         if getattr(self.config, "auto_dense_strings", True):
             for name in schema.names:
                 if (
@@ -192,9 +199,17 @@ class DryadContext:
                     # Unique the object array directly: .astype(str)
                     # would materialize a fixed-width unicode copy of
                     # the whole column (width = longest string) just to
-                    # throw it away.
-                    for s in np.unique(np.asarray(arrays[name], object)):
+                    # throw it away.  The per-COLUMN hash set feeds the
+                    # per-ingest auto-dense gate: one big-vocabulary
+                    # ingest elsewhere must not disable the fast path
+                    # for every later query (round-3 weak item 7).
+                    hs = [
                         self.dictionary.add(str(s))
+                        for s in np.unique(np.asarray(arrays[name], object))
+                    ]
+                    str_vocab[name] = np.sort(
+                        np.asarray(hs, dtype=np.uint64)
+                    )
         # Ingest column statistics: INT32 ranges feed the int auto-dense
         # group_by rewrite (the observed-data-size adaptation of
         # DrDynamicRangeDistributor.cpp:54-110 applied to key domains).
@@ -211,7 +226,7 @@ class DryadContext:
                         col_stats[name] = (int(a.min()), int(a.max()))
         node = Node(
             "input", [], schema, PartitionInfo.roundrobin(),
-            source="host", col_stats=col_stats,
+            source="host", col_stats=col_stats, str_vocab=str_vocab,
         )
         self._bindings[node.id] = ("host", arrays, partition_capacity)
         return Query(self, node)
@@ -261,6 +276,7 @@ class DryadContext:
             node = Node(
                 "input", [], schema, PartitionInfo.roundrobin(),
                 source="host_physical",
+                str_vocab={column: _word_vocab(h0, h1)},
             )
             self._bindings[node.id] = (
                 "host_physical",
@@ -279,7 +295,9 @@ class DryadContext:
         h0, h1, r0, r1 = self._tokenize_buf(buf)
         schema = Schema([(column, ColumnType.STRING)])
         node = Node(
-            "input", [], schema, PartitionInfo.roundrobin(), source="host_physical",
+            "input", [], schema, PartitionInfo.roundrobin(),
+            source="host_physical",
+            str_vocab={column: _word_vocab(h0, h1)},
         )
         self._bindings[node.id] = (
             "host_physical",
@@ -296,8 +314,17 @@ class DryadContext:
 
         schema, parts, dictionary = read_store_uri(path)
         self.dictionary = self.dictionary.merge(dictionary)
+        # the store dictionary bounds every STRING column's vocabulary
+        # (a superset per column, still a sound auto-dense gate)
+        store_hashes = np.sort(
+            np.fromiter(dictionary._map.keys(), dtype=np.uint64)
+        )
         node = Node(
             "input", [], schema, PartitionInfo.roundrobin(), source="store",
+            str_vocab={
+                f.name: store_hashes
+                for f in schema.fields if f.ctype is ColumnType.STRING
+            },
         )
         self._bindings[node.id] = ("store", parts, schema)
         return Query(self, node)

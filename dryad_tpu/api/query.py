@@ -81,6 +81,36 @@ class _Project:
         return {c: cols[c] for c in self.phys}
 
 
+_VOCAB_PRESERVING = frozenset({
+    "where", "take", "skip", "tail", "reverse", "order_by",
+    "hash_partition", "range_partition", "assume_partition", "tee",
+    "with_rank", "take_while", "skip_while", "distinct",
+})
+
+
+def static_str_vocab(node, col):
+    """Static hash-vocabulary bound for a STRING column, walked back to
+    ingest through value-preserving nodes (the string twin of the
+    INT32 range walk): the union of the reaching ingests' per-column
+    hash sets, or None when something could fabricate values
+    (select/apply/join/default_if_empty).  Shared by the API gate and
+    the lowering's subset-table build."""
+    import numpy as np
+
+    if node.kind == "input":
+        return (node.params.get("str_vocab") or {}).get(col)
+    if node.kind == "concat":
+        vs = [static_str_vocab(i, col) for i in node.inputs]
+        if any(v is None for v in vs):
+            return None
+        return np.unique(np.concatenate(vs)) if vs else None
+    if node.kind == "select" and isinstance(node.params.get("fn"), _Project):
+        return static_str_vocab(node.inputs[0], col)
+    if node.kind in _VOCAB_PRESERVING and node.inputs:
+        return static_str_vocab(node.inputs[0], col)
+    return None
+
+
 class Query:
     """Lazy distributed table: a logical plan node plus its context."""
 
@@ -344,17 +374,27 @@ class Query:
 
     def _auto_dense_eligible(self, keys, agg_list, salt) -> bool:
         """Build-time gate for the auto-dense STRING group_by lowering
-        (``plan/lower.py`` re-checks the dictionary size at lowering;
-        a vocabulary grown past the limit falls back to the sort path,
-        which the claim-free partition metadata keeps correct)."""
+        (``plan/lower.py`` re-checks at lowering; a vocabulary grown
+        past the limit falls back to the sort path, which the
+        claim-free partition metadata keeps correct).
+
+        The vocabulary bound is PER-INGEST when provenance allows
+        (``static_str_vocab``): a context that once ingested a huge
+        unrelated vocabulary no longer disables the fast path for every
+        later query — only the key column's own domain matters (and the
+        coding tables shrink to it)."""
         cfg = self.ctx.config
         if salt or not getattr(cfg, "auto_dense_strings", True):
             return False
         d = getattr(self.ctx, "dictionary", None)
         limit = getattr(cfg, "auto_dense_limit", 1 << 17)
-        if d is None or not 0 < len(d) <= limit:
+        if d is None or len(d) == 0:
             return False
         if len(keys) != 1:
+            return False
+        vocab = static_str_vocab(self.node, keys[0])
+        bound = len(vocab) if vocab is not None else len(d)
+        if not 0 < bound <= limit:
             return False
         if self.schema.field(keys[0]).ctype is not ColumnType.STRING:
             return False

@@ -819,10 +819,12 @@ class LocalJobSubmission:
             )
         return table
 
-    # mergeable builtin aggregates for the partial-vertex rewrite
-    # ("first" is engine-order-dependent across vertices; excluded)
+    # mergeable builtin aggregates for the partial-vertex rewrite.
+    # "first" merges correctly because _assemble concatenates partition
+    # results in part-id order (= engine order), so the first partial
+    # occurrence of a key IS the engine-order first.
     _MERGEABLE_AGGS = frozenset(
-        {"sum", "count", "min", "max", "mean", "any", "all"}
+        {"sum", "count", "min", "max", "mean", "any", "all", "first"}
     )
 
     @staticmethod
@@ -856,6 +858,19 @@ class LocalJobSubmission:
             or any(op not in self._MERGEABLE_AGGS for op, _c, _o in agg_list)
         ):
             return None
+        if any(op == "first" for op, _c, _o in agg_list):
+            # "first" merges by part-id-concat order, which equals
+            # engine order only for HOST bindings (np.array_split is
+            # contiguous); slice_binding deals STORE partitions
+            # round-robin, where that order diverges from
+            # submit()/collect() — refuse rather than return an
+            # nparts-dependent answer (code-review r4).
+            from dryad_tpu.plan.nodes import walk as _walk
+
+            for nd in _walk([node]):
+                b = query.ctx._bindings.get(nd.id)
+                if b and b[0] == "store":
+                    return None
         if node.kind == "group_by":
             inner = Query(query.ctx, node.inputs[0])
             partial, plan = self._partial_plan(agg_list)
@@ -870,6 +885,11 @@ class LocalJobSubmission:
                 "group", list(node.params["keys"]), plan, query.schema
             ), inner.node
         if node.kind == "aggregate":
+            # scalar "first" has no neutral value for an empty
+            # partition's partial row (and scalar_agg doesn't implement
+            # it) — the engine-order merge applies to group_by only
+            if any(op == "first" for op, _c, _o in agg_list):
+                return None
             inner = Query(query.ctx, node.inputs[0])
             partial, plan = self._partial_plan(agg_list)
             pq = inner.aggregate_as_query(partial)
@@ -901,6 +921,10 @@ class LocalJobSubmission:
                     row[out] = bool(np.any(cols[pcols[0]][idxs]))
                 elif op == "all":
                     row[out] = bool(np.all(cols[pcols[0]][idxs]))
+                elif op == "first":
+                    # partial rows concatenate in part-id order, so the
+                    # first occurrence is the engine-order first
+                    row[out] = cols[pcols[0]][np.asarray(idxs)[0]]
             return row
 
         out: Dict[str, list] = {}

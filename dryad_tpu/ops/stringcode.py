@@ -176,13 +176,22 @@ def build_tables(dictionary) -> Tuple[CodeTable, DecodeTable]:
     cached = getattr(dictionary, "_stringcode_cache", None)
     if cached is not None and cached[0] == len(dictionary):
         return cached[1]
-    from dryad_tpu.columnar.schema import split64, string_prefix_rank
-
     hashes = []
     strings = []
     for h, s in dictionary.items():
         hashes.append(h)
         strings.append(s)
+    tables = _tables_from(hashes, strings)
+    dictionary._stringcode_cache = (len(hashes), tables)
+    return tables
+
+
+def _tables_from(hashes, strings) -> Tuple[CodeTable, DecodeTable]:
+    """Assemble the (code, decode) pair from parallel hash/string lists
+    — the ONE place that knows the physical word layout (shared by the
+    whole-dictionary and per-ingest-subset builders)."""
+    from dryad_tpu.columnar.schema import split64, string_prefix_rank
+
     K = len(hashes)
     arr = np.asarray(hashes, np.uint64)
     lo, hi = split64(arr)
@@ -193,6 +202,32 @@ def build_tables(dictionary) -> Tuple[CodeTable, DecodeTable]:
     words = (
         np.stack([lo, hi, r0, r1], axis=1) if K else np.zeros((0, 4), np.uint32)
     )
-    tables = CodeTable(pairs), DecodeTable(words)
-    dictionary._stringcode_cache = (K, tables)
+    return CodeTable(pairs), DecodeTable(words)
+
+
+def build_tables_subset(
+    dictionary, hashes: np.ndarray
+) -> Tuple[CodeTable, DecodeTable]:
+    """Build the (code, decode) pair over a SUBSET of the dictionary —
+    the key column's own per-ingest vocabulary (``api.query.
+    static_str_vocab``) — in sorted-hash order (deterministic across
+    driver and workers; the job package ships the tables inside the
+    lowered plan).  Hashes absent from the dictionary are skipped:
+    they cannot decode, and the runtime miss guard covers fabricated
+    values.  A (len, digest)-keyed memo on the dictionary makes warm
+    re-lowers O(1)."""
+    hs = np.unique(np.asarray(hashes, np.uint64))
+    key = (len(dictionary), hs.tobytes())
+    cached = getattr(dictionary, "_stringcode_subset_cache", None)
+    if cached is not None and cached[0] == key:
+        return cached[1]
+    strings = []
+    kept = []
+    for h in hs.tolist():
+        s = dictionary._map.get(h)
+        if s is not None:
+            kept.append(h)
+            strings.append(s)
+    tables = _tables_from(kept, strings)
+    dictionary._stringcode_subset_cache = (key, tables)
     return tables

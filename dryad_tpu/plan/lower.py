@@ -83,6 +83,18 @@ class _Builder:
         # elision stays safe, but a join must NOT treat it as
         # co-partitioned with a full-width side
         self.reduced: set = set()
+        # (node id, col) -> static vocab walk result; the gate and the
+        # emission run back-to-back, and do_while re-lowers per
+        # iteration — don't redo the O(V) union walk each time
+        self._vocab_cache: Dict[Tuple[int, str], Any] = {}
+
+    def _str_vocab(self, node: Node, col: str):
+        key = (node.id, col)
+        if key not in self._vocab_cache:
+            from dryad_tpu.api.query import static_str_vocab
+
+            self._vocab_cache[key] = static_str_vocab(node, col)
+        return self._vocab_cache[key]
 
     # -- static row estimates (DrDynamicRangeDistributor.cpp:54-110:
     # consumer fan-out from observed data size; here from the plan's
@@ -394,10 +406,17 @@ class _Builder:
     def _emit_auto_dense(self, node: Node, stage, slot, key: str, aggs) -> None:
         """Shared emission for auto-dense STRING rewrites (group_by and
         vocabulary distinct): string_code -> dense bucket reduce with
-        decode -> project to the node's schema."""
-        from dryad_tpu.ops.stringcode import build_tables
+        decode -> project to the node's schema.  When the key column's
+        per-ingest vocabulary is statically known, the coding tables
+        shrink to THAT subset — a context that ingested an unrelated
+        huge vocabulary elsewhere no longer inflates K for this query."""
+        from dryad_tpu.ops.stringcode import build_tables, build_tables_subset
 
-        code_t, dec_t = build_tables(self.dictionary)
+        vocab = self._str_vocab(node.inputs[0], key)
+        if vocab is not None and len(vocab) < len(self.dictionary):
+            code_t, dec_t = build_tables_subset(self.dictionary, vocab)
+        else:
+            code_t, dec_t = build_tables(self.dictionary)
         stage.ops.append(StageOp(
             "string_code",
             dict(slot=slot, h0=f"{key}#h0", h1=f"{key}#h1",
@@ -426,10 +445,12 @@ class _Builder:
         # precisely because the node claims nothing.
         if not node.params.get("auto_dense"):
             return False
-        if self.dictionary is None:
+        if self.dictionary is None or len(self.dictionary) == 0:
             return False
         limit = getattr(self.config, "auto_dense_limit", 1 << 17)
-        return 0 < len(self.dictionary) <= limit
+        vocab = self._str_vocab(node.inputs[0], keys[0])
+        bound = len(vocab) if vocab is not None else len(self.dictionary)
+        return 0 < bound <= limit
 
     def _phys_aggs(self, schema: Schema, aggs) -> List:
         from dryad_tpu.ops.segmented import AggSpec

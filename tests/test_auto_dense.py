@@ -467,3 +467,66 @@ def test_range_miss_never_persists_a_poisoned_checkpoint(rng, tmp_path):
         if e["kind"] == "stage_checkpoint_saved"
     ]
     assert saved, "clean guarded stage should checkpoint after the drain"
+
+
+def test_per_ingest_vocab_gate_survives_big_ingest(rng):
+    """A context that ingested a HUGE unrelated vocabulary no longer
+    loses the dense path for later small-vocab queries: the gate and
+    the coding tables key on the KEY COLUMN's own per-ingest
+    vocabulary (round-3 weak item 7)."""
+    small_limit = DryadConfig(auto_dense_limit=64)
+    ctx = DryadContext(num_partitions_=8, config=small_limit)
+
+    # blow past the limit with an unrelated ingest
+    big_words = np.array([f"huge{i:05d}" for i in range(500)], object)
+    ctx.from_arrays({"w": big_words})
+    assert len(ctx.dictionary) > 64
+
+    # a small-vocab table still rides the dense path...
+    small = np.array(
+        [f"s{i}" for i in rng.integers(0, 20, 800)], object
+    )
+    q = ctx.from_arrays({"w": small}).group_by("w", {"c": ("count", None)})
+    kinds = _ops(lower([q.node], ctx.config, ctx.dictionary))
+    assert "string_code" in kinds and "exchange_hash" not in kinds
+    # ...with coding tables shrunk to ITS vocabulary, not the context's
+    st = [
+        op for s in lower([q.node], ctx.config, ctx.dictionary).stages
+        for op in s.ops if op.kind == "string_code"
+    ][0]
+    assert st.params["table"].num_codes == len(np.unique(small))
+
+    out = q.collect()
+    uniq, counts = np.unique(small.astype(str), return_counts=True)
+    got = dict(zip([str(w) for w in out["w"]], out["c"].tolist()))
+    assert got == dict(zip(uniq.tolist(), counts.tolist()))
+
+    # the big-vocab table itself falls back to the sort path, correctly
+    qb = ctx.from_arrays({"w": big_words}).group_by(
+        "w", {"c": ("count", None)}
+    )
+    assert "string_code" not in _ops(lower([qb.node], ctx.config, ctx.dictionary))
+    ob = qb.collect()
+    assert len(ob["w"]) == 500 and set(ob["c"].tolist()) == {1}
+
+
+def test_subset_tables_with_where_chain(rng):
+    """The vocab bound propagates through value-preserving operators
+    (where/project), and select breaks it."""
+    ctx = DryadContext(num_partitions_=8)
+    words = np.array([f"t{i}" for i in rng.integers(0, 15, 600)], object)
+    v = rng.standard_normal(600).astype(np.float32)
+    base = ctx.from_arrays({"w": words, "v": v})
+    q = base.where(lambda c: c["v"] > 0).project(["w"]).group_by(
+        "w", {"c": ("count", None)}
+    )
+    st = [
+        op for s in lower([q.node], ctx.config, ctx.dictionary).stages
+        for op in s.ops if op.kind == "string_code"
+    ]
+    assert st and st[0].params["table"].num_codes == len(np.unique(words))
+    out = q.collect()
+    mask = v > 0
+    uniq, counts = np.unique(words[mask].astype(str), return_counts=True)
+    got = dict(zip([str(w) for w in out["w"]], out["c"].tolist()))
+    assert got == dict(zip(uniq.tolist(), counts.tolist()))

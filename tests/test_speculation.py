@@ -135,12 +135,21 @@ def test_exchange_plan_rejected(submission):
     )
     with pytest.raises(ValueError, match="exchange-free"):
         submission.submit_partitioned(q)
-    # group_by with an engine-order-dependent agg ("first") cannot be
-    # merged across vertices either
+    # a Decomposable group_by has no driver-mergeable partial form
+    import jax.numpy as jnp
+
+    from dryad_tpu import ColumnType, Decomposable
+
+    dec = Decomposable(
+        seed=lambda cols: {"acc": cols["v"]},
+        merge=lambda a, b: {"acc": jnp.maximum(a["acc"], b["acc"])},
+        state_cols=["acc"],
+        out_fields=[("acc", ColumnType.FLOAT32)],
+    )
     q2 = ctx.from_arrays(
         {"k": np.arange(8, dtype=np.int32),
          "v": np.ones(8, np.float32)}
-    ).group_by("k", {"f": ("first", "v")})
+    ).group_by("k", decomposable=dec)
     with pytest.raises(ValueError, match="exchange-free"):
         submission.submit_partitioned(q2)
 
@@ -239,4 +248,36 @@ def test_partitioned_rejects_mid_plan_group_by(submission):
         .where(_even)
     )
     with pytest.raises(ValueError, match="use submit"):
+        submission.submit_partitioned(q, nparts=4)
+
+
+def test_partitioned_group_by_first_merges_engine_order(submission):
+    """'first' partials merge to the engine-order first because
+    assembly concatenates partition results in part-id order."""
+    n = 1200
+    k = (np.arange(n, dtype=np.int32) % 7)
+    v = np.arange(n, dtype=np.float32)  # engine order = ascending v
+    ctx = DryadContext(num_partitions_=1)
+    q = ctx.from_arrays({"k": k, "v": v}).group_by(
+        "k", {"f": ("first", "v"), "c": ("count", None)}
+    )
+    out = submission.submit_partitioned(q, nparts=4)
+    for kk, f in zip(out["k"], out["f"]):
+        assert int(f) == int(kk)  # first occurrence of key kk is row kk
+
+
+def test_store_backed_first_refuses_partial_merge(submission, tmp_path):
+    """'first' over a STORE-backed input must not partial-merge:
+    slice_binding deals store partitions round-robin, so part-id-concat
+    order is not engine order there (code-review r4)."""
+    src = DryadContext(num_partitions_=1)
+    src.from_arrays(
+        {"k": (np.arange(40, dtype=np.int32) % 5),
+         "v": np.arange(40, dtype=np.float32)}
+    ).to_store(str(tmp_path / "s1"))
+    ctx = DryadContext(num_partitions_=1)
+    q = ctx.from_store(str(tmp_path / "s1")).group_by(
+        "k", {"f": ("first", "v")}
+    )
+    with pytest.raises(ValueError, match="exchange-free"):
         submission.submit_partitioned(q, nparts=4)
