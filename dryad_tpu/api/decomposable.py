@@ -26,6 +26,16 @@ class Decomposable:
     state_cols: physical state column names produced by ``seed``.
     out_fields: logical (name, ColumnType) list for the final output
     columns (after ``finalize`` if present, else the state columns).
+    state_fields: OPTIONAL logical (name, ColumnType) list typing the
+    state columns themselves.  When given, a terminal
+    ``group_by(decomposable=...)`` additionally qualifies for
+    independent-vertex submission (``LocalJobSubmission
+    .submit_partitioned``): each vertex reduces its partition to typed
+    state rows, the driver merges the assembled partials with ``merge``
+    and runs ``finalize`` once — the reference's machine-level partial
+    aggregation applied to custom combiners
+    (``DrDynamicAggregateManager``).  Without it, decomposable plans
+    keep the gang path (state dtypes are unknown until trace).
     """
 
     seed: Callable[[Dict], Dict]
@@ -33,3 +43,4 @@ class Decomposable:
     state_cols: Sequence[str]
     out_fields: Sequence[Tuple[str, ColumnType]]
     finalize: Optional[Callable[[Dict], Dict]] = None
+    state_fields: Optional[Sequence[Tuple[str, ColumnType]]] = None

@@ -851,11 +851,12 @@ class LocalJobSubmission:
         from dryad_tpu.api.query import Query
 
         node = query.node
+        dec = node.params.get("decomposable")
+        if node.kind == "group_by" and dec is not None:
+            return self._rewrite_partial_decomposable(query, node, dec)
         agg_list = node.params.get("aggs")
-        if (
-            not agg_list
-            or node.params.get("decomposable") is not None
-            or any(op not in self._MERGEABLE_AGGS for op, _c, _o in agg_list)
+        if not agg_list or any(
+            op not in self._MERGEABLE_AGGS for op, _c, _o in agg_list
         ):
             return None
         if any(op == "first" for op, _c, _o in agg_list):
@@ -896,11 +897,45 @@ class LocalJobSubmission:
             return pq, ("aggregate", [], plan, query.schema), inner.node
         return None
 
+    def _rewrite_partial_decomposable(self, query, node, dec):
+        """Custom-combiner vertex partials: qualify when the
+        Decomposable types its state columns (``state_fields``) — each
+        vertex emits per-partition state rows, the driver merges with
+        the user's associative ``merge`` and runs ``finalize`` once
+        (the reference's machine-level partial aggregation for custom
+        combiners, ``DrDynamicAggregateManager``)."""
+        import dataclasses as _dc
+
+        from dryad_tpu.api.query import Query
+
+        if dec.state_fields is None:
+            return None
+        if {n for n, _ct in dec.state_fields} != set(dec.state_cols):
+            raise ValueError(
+                "Decomposable.state_fields names "
+                f"{[n for n, _ct in dec.state_fields]} must match "
+                f"state_cols {list(dec.state_cols)}"
+            )
+        if any(ct.is_split for _n, ct in dec.state_fields):
+            return None  # split-word states can't merge on the host
+        inner = Query(query.ctx, node.inputs[0])
+        partial_dec = _dc.replace(
+            dec, out_fields=list(dec.state_fields), finalize=None
+        )
+        pq = inner.group_by(
+            list(node.params["keys"]), decomposable=partial_dec
+        )
+        return pq, (
+            "group_dec", list(node.params["keys"]), dec, query.schema
+        ), inner.node
+
     def _merge_partials(self, table, merge):
         """Final merge of assembled per-vertex partial results on the
         driver (the aggregation tree's root; reference
         ``DrDynamicAggregateManager`` final vertex)."""
         kind, keys, plan, out_schema = merge
+        if kind == "group_dec":
+            return self._merge_dec_partials(table, keys, plan, out_schema)
         cols = {k: np.asarray(v) for k, v in table.items()}
         n = len(next(iter(cols.values()), []))
 
@@ -1001,6 +1036,55 @@ class LocalJobSubmission:
                 if f.ctype is ColumnType.STRING and f.name in arrays:
                     for s in np.unique(np.asarray(arrays[f.name], object)):
                         query.ctx.dictionary._map[hash64_str(str(s))] = str(s)
+
+    def _merge_dec_partials(self, table, keys, dec, out_schema):
+        """Reduce assembled per-vertex STATE rows with the user's
+        associative ``merge`` — vectorized across ALL groups at once,
+        one round per duplicate rank (<= nparts-1 rounds, each a single
+        user-merge call) — then run ``finalize`` once over the merged
+        groups."""
+        state_names = [n for n, _ct in dec.state_fields]
+        cols = {k: np.asarray(v) for k, v in table.items()}
+        n = len(next(iter(cols.values()), []))
+        tups = list(zip(*[cols[k].tolist() for k in keys])) if n else []
+        index: Dict[tuple, list] = {}
+        for i, t in enumerate(tups):
+            index.setdefault(t, []).append(i)
+        groups = list(index.items())
+        # Pad every group's row list to the same depth and fold rounds:
+        # merge(acc, rows[j]) vectorized across ALL groups at once.
+        acc = {
+            c: np.asarray([cols[c][idxs[0]] for _t, idxs in groups])
+            for c in state_names
+        }
+        depth = max((len(idxs) for _t, idxs in groups), default=1)
+        for j in range(1, depth):
+            rows_j = [
+                idxs[j] if j < len(idxs) else idxs[0]
+                for _t, idxs in groups
+            ]
+            nxt = {c: cols[c][rows_j] for c in state_names}
+            merged = dec.merge(acc, nxt)
+            has_j = np.asarray([j < len(idxs) for _t, idxs in groups])
+            acc = {
+                c: np.where(has_j, np.asarray(merged[c]), acc[c])
+                for c in state_names
+            }
+        # one key-array build, preserving the assembled dtype (int32
+        # keys stay int32; string keys stay object)
+        key_arrays = {
+            k: np.asarray([t[i] for t, _ in groups], dtype=cols[k].dtype)
+            for i, k in enumerate(keys)
+        }
+        full = dict(key_arrays)
+        full.update(acc)
+        if dec.finalize is not None:
+            full = {k: np.asarray(v) for k, v in dec.finalize(full).items()}
+        result: Dict[str, np.ndarray] = dict(key_arrays)
+        for name, _ct in dec.out_fields:
+            dt = out_schema.field(name).ctype.numpy_dtype
+            result[name] = np.asarray(full[name]).astype(dt)
+        return result
 
     def inject_delay(
         self, worker: int, seconds: float, count: int = 1

@@ -160,7 +160,7 @@ def _build_pipeline(rng, depth):
     return steps
 
 
-@pytest.mark.parametrize("seed", range(20))
+@pytest.mark.parametrize("seed", range(28))
 def test_random_pipeline_device_matches_localdebug(seed):
     rng = np.random.default_rng(seed)
     tbl = _rand_table(rng, int(rng.integers(50, 400)))

@@ -281,3 +281,57 @@ def test_store_backed_first_refuses_partial_merge(submission, tmp_path):
     )
     with pytest.raises(ValueError, match="exchange-free"):
         submission.submit_partitioned(q, nparts=4)
+
+
+def test_partitioned_decomposable_partials(submission):
+    """A typed-state Decomposable (state_fields) runs as per-vertex
+    custom-combiner partials with a driver-side merge + finalize —
+    the reference's machine-level partial aggregation for custom
+    combiners."""
+    import jax.numpy as jnp
+
+    from dryad_tpu import ColumnType, Decomposable
+
+    rng = np.random.default_rng(23)
+    n = 3000
+    tbl = {
+        "k": rng.integers(0, 12, n).astype(np.int32),
+        "v": rng.standard_normal(n).astype(np.float32),
+    }
+    dec = Decomposable(
+        seed=lambda cols: {
+            "cnt": jnp.ones_like(cols["v"]),
+            "s1": cols["v"],
+            "s2": cols["v"] * cols["v"],
+        },
+        merge=lambda a, b: {
+            "cnt": a["cnt"] + b["cnt"],
+            "s1": a["s1"] + b["s1"],
+            "s2": a["s2"] + b["s2"],
+        },
+        state_cols=["cnt", "s1", "s2"],
+        state_fields=[
+            ("cnt", ColumnType.FLOAT32),
+            ("s1", ColumnType.FLOAT32),
+            ("s2", ColumnType.FLOAT32),
+        ],
+        finalize=lambda cols: {
+            **cols,
+            "var": cols["s2"] / cols["cnt"]
+            - (cols["s1"] / cols["cnt"]) ** 2,
+        },
+        out_fields=[("var", ColumnType.FLOAT32)],
+    )
+    ctx = DryadContext(num_partitions_=1)
+    q = ctx.from_arrays(tbl).group_by("k", decomposable=dec)
+    out = submission.submit_partitioned(q, nparts=5)
+    assert sorted(out["k"].tolist()) == sorted(
+        np.unique(tbl["k"]).tolist()
+    )
+    for k, var in zip(out["k"], out["var"]):
+        vs = tbl["v"][tbl["k"] == k]
+        np.testing.assert_allclose(
+            var, vs.var(), rtol=1e-3, atol=1e-4
+        )
+    kinds = [e["kind"] for e in submission.events.events()]
+    assert "vertex_partials_merged" in kinds
