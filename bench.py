@@ -224,18 +224,12 @@ def wordcount_metric(n: int, vocab_size: int = 1 << 14):
             )
             assert int(np.sum(out["count"])) == n
 
-        t0 = time.perf_counter()
-        run()  # compile + first ingest (both cached for later reps)
-        compile_s = time.perf_counter() - t0
-        log(f"wordcount compiled+warmed in {compile_s:.1f}s")
         # Warm reps reuse the device-resident ingest (context device
         # cache): they measure dispatch + device pipeline + egress, the
         # steady-state of repeated queries over a resident table.
-        best, times = timed_reps(run)
-        return rep_record(
-            "wordcount_rows_per_sec", n, times,
-            {"vocab": vocab_size, "compile_s": round(compile_s, 1),
-             "ingest_cached": True},
+        return compile_then_reps(
+            "wordcount_rows_per_sec", run, n,
+            {"vocab": vocab_size, "ingest_cached": True},
         )
     finally:
         os.unlink(path)
@@ -267,14 +261,49 @@ def wordcount_dense_metric(n: int, vocab_size: int = 1 << 14):
         ).collect()
         assert int(np.sum(out["count"])) == n
 
+    return compile_then_reps(
+        "wordcount_dense_rows_per_sec", run, n, {"vocab": vocab_size}
+    )
+
+
+def compile_then_reps(name: str, run, rows: int, extra: dict = {}):
+    """Shared end-to-end measurement protocol: one warm run (compile +
+    ingest, both cached), then timed reps of the steady state."""
     t0 = time.perf_counter()
     run()
     compile_s = time.perf_counter() - t0
-    log(f"wordcount_dense compiled+warmed in {compile_s:.1f}s")
+    log(f"{name} compiled+warmed in {compile_s:.1f}s")
     best, times = timed_reps(run)
     return rep_record(
-        "wordcount_dense_rows_per_sec", n, times,
-        {"vocab": vocab_size, "compile_s": round(compile_s, 1)},
+        name, rows, times, {"compile_s": round(compile_s, 1), **extra}
+    )
+
+
+def groupby_e2e_metric(n: int, keys: int = 1 << 12):
+    """GroupBy end-to-end THROUGH DryadContext: ingest-bounded INT32
+    keys ride the int auto-dense rewrite (MXU bucket / scatter path,
+    no shuffle) — the engine's ACTUAL general-key group path for the
+    common categorical shape, vs the raw sort-path kernel that
+    ``group_reduce_rows_per_sec`` measures."""
+    from dryad_tpu import DryadContext
+
+    rng = np.random.default_rng(5)
+    tbl = {
+        "k": rng.integers(0, keys, n).astype(np.int32),
+        "v": rng.standard_normal(n).astype(np.float32),
+    }
+    ctx = DryadContext()
+    q = ctx.from_arrays(tbl).group_by(
+        "k", {"c": ("count", None), "s": ("sum", "v")}
+    )
+
+    def run():
+        out = q.collect()
+        assert int(np.sum(out["c"])) == n
+
+    return compile_then_reps(
+        "groupby_e2e_rows_per_sec", run, n,
+        {"keys": keys, "ingest_cached": True, "path": "int-auto-dense"},
     )
 
 
@@ -338,14 +367,8 @@ def terasort_metric(n: int):
         out = q.order_by(["key"]).collect()
         assert len(out["key"]) == n
 
-    t0 = time.perf_counter()
-    run()  # compile + first ingest (both cached for later reps)
-    compile_s = time.perf_counter() - t0
-    log(f"terasort compiled+warmed in {compile_s:.1f}s")
-    best, times = timed_reps(run)
-    return rep_record(
-        "terasort_rows_per_sec", n, times,
-        {"compile_s": round(compile_s, 1), "ingest_cached": True},
+    return compile_then_reps(
+        "terasort_rows_per_sec", run, n, {"ingest_cached": True}
     )
 
 
@@ -362,6 +385,7 @@ ROOFLINE = {
     "dense_xla_rows_per_sec": 2.5e9,
     "wordcount_rows_per_sec": 7.5e9,         # count-only dense route
     "wordcount_dense_rows_per_sec": 7.5e9,
+    "groupby_e2e_rows_per_sec": 2.5e9,       # int-auto-dense, cnt+sum
 }
 
 
@@ -513,6 +537,9 @@ def main() -> None:
         ("hdfs_ingest_rows_per_sec",
          lambda: hdfs_ingest_metric(1 << 21 if accel else 1 << 19),
          60 if accel else 25, False),
+        ("groupby_e2e_rows_per_sec",
+         lambda: groupby_e2e_metric(1 << 22 if accel else 1 << 20),
+         60 if accel else 20, False),
     ]
     if platform in ("tpu", "axon"):
         # The Pallas kernel only truly runs on TPU; elsewhere the number

@@ -18,6 +18,7 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         ("join_groupby.py", [], "region 0:"),
         ("analytics_cached.py", [], "distinct users: 2000"),
         ("pagerank_dowhile.py", [], "top node matches numpy PageRank: OK"),
+        ("topk_per_key_hdfs.py", [], "ranked reviews considered: 100"),
     ],
 )
 def test_sample_runs(script, args, expect):
