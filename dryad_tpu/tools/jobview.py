@@ -480,28 +480,78 @@ def _load_tolerant(path: str) -> List[Dict[str, Any]]:
     return out
 
 
-def follow(path: str, interval: float = 1.0) -> None:
-    """LIVE view (the JobBrowser's running-job mode): re-render whenever
-    the event log grows; Ctrl-C to stop."""
+def _watch_events(
+    path: str, interval: float, max_rounds: Optional[int] = None
+):
+    """Yield a fresh event list each time the log file changes — the
+    ONE change-detection loop behind both live renderers.  Bounded by
+    ``max_rounds`` for tests; swallows Ctrl-C as a clean stop."""
     import os
     import time
 
     last = -1
+    rounds = 0
     try:
-        while True:
+        while max_rounds is None or rounds < max_rounds:
+            rounds += 1
             try:
                 size = os.path.getsize(path)
             except OSError:
                 size = -1
             if size != last:
                 last = size
-                events = _load_tolerant(path) if size > 0 else []
-                print("\x1b[2J\x1b[H", end="")  # clear screen, home
-                print(_render_stream(events))
-                print(f"\n[watching {path} — Ctrl-C to stop]")
+                yield _load_tolerant(path) if size > 0 else []
             time.sleep(interval)
     except KeyboardInterrupt:
-        pass
+        return
+
+
+def _submission_html(text: str, extra_head: str = "") -> str:
+    """The submission-log report page (shared by the one-shot --html
+    path and the live page)."""
+    import html as H
+
+    return (
+        "<!doctype html><html><head><meta charset='utf-8'>"
+        f"{extra_head}<title>dryad_tpu submission log</title></head>"
+        f"<body><pre>{H.escape(text)}</pre></body></html>"
+    )
+
+
+def follow(path: str, interval: float = 1.0) -> None:
+    """LIVE view (the JobBrowser's running-job mode): re-render whenever
+    the event log grows; Ctrl-C to stop."""
+    for events in _watch_events(path, interval):
+        print("\x1b[2J\x1b[H", end="")  # clear screen, home
+        print(_render_stream(events))
+        print(f"\n[watching {path} — Ctrl-C to stop]")
+
+
+def follow_html(
+    path: str, out: str, interval: float = 1.0, max_rounds: Optional[int] = None
+) -> None:
+    """LIVE HTML view: re-render the report whenever the event log
+    grows; the page self-refreshes (the JobBrowser running-job GUI as
+    a static file any browser can watch).  ``max_rounds`` bounds the
+    loop for tests."""
+    import os
+    import time
+
+    refresh = f'<meta http-equiv="refresh" content="{max(1, int(interval))}">'
+    for events in _watch_events(path, interval, max_rounds):
+        if {e["kind"] for e in events} & {
+            "vertex_job_start", "gang_run_start"
+        }:
+            text, _ok = fold_submission(events)
+            page = _submission_html(text, extra_head=refresh)
+        else:
+            page = render_html(build_job(events)).replace(
+                "</title>", f"</title>{refresh}", 1
+            )
+        tmp = f"{out}.tmp"
+        with open(tmp, "w") as fh:
+            fh.write(page)
+        os.replace(tmp, out)  # atomic: the browser never sees a torn page
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -521,27 +571,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     if len(argv) != 1:
         print(
             "usage: python -m dryad_tpu.tools.jobview [--html out.html] "
-            "[--follow] <events.jsonl>"
+            "[--follow] <events.jsonl>   (--follow --html = live page)"
         )
         return 2
     if live:
         if html_out:
-            print("--follow and --html are mutually exclusive")
-            return 2
-        follow(argv[0])
+            print(f"live HTML -> {html_out} (Ctrl-C to stop)")
+            follow_html(argv[0], html_out)
+        else:
+            follow(argv[0])
         return 0
     events = EventLog.load(argv[0])
     if {e["kind"] for e in events} & {"vertex_job_start", "gang_run_start"}:
         text, ok = fold_submission(events)
         if html_out:
-            import html as H
-
             with open(html_out, "w") as fh:
-                fh.write(
-                    "<!doctype html><html><head><meta charset='utf-8'>"
-                    "<title>dryad_tpu submission log</title></head><body>"
-                    f"<pre>{H.escape(text)}</pre></body></html>"
-                )
+                fh.write(_submission_html(text))
             print(f"wrote {html_out}")
         print(text)
         return 0 if ok else 1

@@ -356,3 +356,34 @@ def test_explain_svg(rng):
     assert "exchange" in svg and "<rect" in svg and "marker-end" in svg
     # every stage box and input ellipse is connected
     assert svg.count("<line") >= svg.count("<rect")
+
+
+def test_jobview_live_html(tmp_path, rng):
+    """--follow --html renders a self-refreshing live page that tracks
+    event-log growth (the JobBrowser running-job GUI as a static
+    file)."""
+    import json as J
+
+    from dryad_tpu.tools.jobview import follow_html
+
+    log = tmp_path / "events.jsonl"
+    out = tmp_path / "live.html"
+    evs = [
+        {"ts": 0.0, "kind": "job_start", "stages": 2},
+        {"ts": 0.1, "kind": "stage_start", "stage": 1, "name": "input+group_by",
+         "version": 1, "boost": 1},
+        {"ts": 0.5, "kind": "stage_complete", "stage": 1,
+         "name": "input+group_by", "version": 1, "seconds": 0.4},
+    ]
+    log.write_text("".join(J.dumps(e) + "\n" for e in evs))
+    follow_html(str(log), str(out), interval=0.05, max_rounds=2)
+    page = out.read_text()
+    assert "http-equiv=\"refresh\"" in page and "input+group_by" in page
+
+    # append completion events; another round must pick them up
+    evs2 = evs + [
+        {"ts": 0.9, "kind": "job_complete"},
+    ]
+    log.write_text("".join(J.dumps(e) + "\n" for e in evs2))
+    follow_html(str(log), str(out), interval=0.05, max_rounds=2)
+    assert "OK" in out.read_text()
