@@ -9,12 +9,15 @@ each workload, mirroring the reference's LocalDebug LINQ-to-Objects path
 
 from __future__ import annotations
 
+import math
 from typing import Dict, List, Sequence
 
 import numpy as np
 
 
 def _rows(table: Dict[str, np.ndarray]) -> List[tuple]:
+    """Raw row tuples (floats UNROUNDED — tolerance is applied at
+    comparison, not by quantization)."""
     names = sorted(table.keys())
     cols = [np.asarray(table[n]) for n in names]
     n = len(cols[0]) if cols else 0
@@ -24,19 +27,65 @@ def _rows(table: Dict[str, np.ndarray]) -> List[tuple]:
         for c in cols:
             v = c[i]
             if isinstance(v, (np.floating, float)):
-                row.append(round(float(v), 4))
+                row.append(float(v))
             else:
                 row.append(v.item() if hasattr(v, "item") else v)
         out.append(tuple(row))
     return out
 
 
+def _sort_key(row: tuple) -> tuple:
+    # quantized floats group eps-close rows for a stable pairing order
+    return tuple(
+        round(v, 4) if isinstance(v, float) else v for v in row
+    )
+
+
+def _cells_close(x, y) -> bool:
+    """Cell equality: numeric pairs compare relative-aware (two
+    legitimate f32 summation orders differ by ~ulp); mixed or
+    non-numeric types compare exactly."""
+    num = (int, float, bool)
+    if isinstance(x, num) and isinstance(y, num) and (
+        isinstance(x, float) or isinstance(y, float)
+    ):
+        return math.isclose(float(x), float(y), rel_tol=2e-4, abs_tol=1e-6)
+    return x == y
+
+
+def _row_close(ra: tuple, rb: tuple) -> bool:
+    return len(ra) == len(rb) and all(
+        _cells_close(x, y) for x, y in zip(ra, rb)
+    )
+
+
 def check(actual: Dict[str, np.ndarray], expected: Dict[str, np.ndarray]) -> None:
-    """Order-insensitive table equality (Validate.Check analog)."""
+    """Order-insensitive table equality (Validate.Check analog).
+
+    Rows sort by a quantized key and zip-compare with float tolerance;
+    rows the zip mispairs (eps-close values straddling a quantization
+    boundary can sort differently in the two tables) fall back to
+    multiset matching within tolerance."""
     assert sorted(actual.keys()) == sorted(expected.keys()), (
         f"column mismatch: {sorted(actual.keys())} vs {sorted(expected.keys())}"
     )
-    a, e = sorted(_rows(actual)), sorted(_rows(expected))
+    a = sorted(_rows(actual), key=_sort_key)
+    e = sorted(_rows(expected), key=_sort_key)
     assert len(a) == len(e), f"row count {len(a)} != {len(e)}\n{a[:5]}\n{e[:5]}"
-    for i, (ra, re_) in enumerate(zip(a, e)):
-        assert ra == re_, f"row {i}: {ra} != {re_}"
+    leftover_a = []
+    leftover_e = []
+    for ra, re_ in zip(a, e):
+        if not _row_close(ra, re_):
+            leftover_a.append(ra)
+            leftover_e.append(re_)
+    # rare fallback: re-pair the mismatched remainder as a multiset
+    for ra in leftover_a:
+        hit = next(
+            (j for j, re_ in enumerate(leftover_e) if _row_close(ra, re_)),
+            None,
+        )
+        assert hit is not None, (
+            f"row {ra} has no tolerant match; nearest leftovers: "
+            f"{leftover_e[:3]}"
+        )
+        leftover_e.pop(hit)
