@@ -105,9 +105,11 @@ _STEPS = {
         ).select(lambda c: {"k": c["k"], "g": c["g"],
                             "v": c["v"] + c["v_r"]})
     ),
-    "semi_join": (  # semi-join filter on even keys
+    "semi_join": (  # semi-join filter on even keys; distinct right —
+        # existence only needs the key set, and a duplicate-heavy right
+        # would blow the pair-expansion budget (fuzz seed 271)
         lambda q: q.semi_join(
-            q.where(_where_kmod).project(["k"]), "k"
+            q.where(_where_kmod).project(["k"]).distinct(), "k"
         )
     ),
     "gj_selector": (  # full GroupJoin: top-3-per-key self-join selector

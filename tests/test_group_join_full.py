@@ -257,3 +257,39 @@ def test_ranked_join_rank_set_engine_order(ctx, dbg):
     lid_to_key = dict(zip(got["gj_lid"].tolist(), got["k"].tolist()))
     for lid, ranks in by_lid.items():
         assert sorted(ranks) == list(range(counts[lid_to_key[lid]]))
+
+
+def test_selector_string_keys(ctx, dbg):
+    """Full GroupJoin over STRING keys (split hash-word equality):
+    top-1 score per word, DefaultIfEmpty for unmatched words."""
+    words = np.array(["ant", "bee", "cat", "dog"], object)
+    rng = np.random.default_rng(17)
+    left = {"w": words, "tag": np.arange(4, dtype=np.int32)}
+    right = {
+        "w": words[rng.integers(0, 3, 40)],  # "dog" never matches
+        "score": rng.uniform(0, 10, 40).astype(np.float32),
+    }
+
+    def q(c):
+        return (
+            c.from_arrays(left)
+            .group_join(
+                c.from_arrays(right), "w",
+                order=[("score", True)],
+                selector=lambda p: p.where(lambda c_: c_["gj_rank"] == 0)
+                .group_by("gj_lid", {"best": ("sum", "score")}),
+                defaults={"best": -1.0},
+            )
+            .collect()
+        )
+
+    check(q(ctx), q(dbg))
+    got = q(ctx)
+    by_w = dict(zip([str(w) for w in got["w"]], got["best"].tolist()))
+    assert by_w["dog"] == -1.0
+    for w in ("ant", "bee", "cat"):
+        mask = right["w"].astype(str) == w
+        np.testing.assert_allclose(
+            by_w[w], right["score"][mask].max(), rtol=1e-5
+        )
+    assert sorted(got["tag"].tolist()) == [0, 1, 2, 3]
