@@ -68,21 +68,46 @@ def _hi_width(num_buckets: int) -> int:
     return _round_up(max(1, -(-num_buckets // _LO)), 8)
 
 
-def _row_block(a_pad: int, n_vals: int = 1, planes_per_val: int = 2) -> Optional[int]:
+def _stack_stride(a_pad: int) -> int:
+    """Sublane stride of one plane in the stacked hi factor: bf16 tiles
+    are (16, 128), so planes start on 16-sublane boundaries (extra
+    iota rows past ``a_pad`` compare unequal to every hi index and
+    contribute zero)."""
+    return _round_up(a_pad, 16)
+
+
+def _stacking_enabled(a_pad: int) -> bool:
+    """Stacked-plane formulation applies below the 128-sublane pass
+    boundary; DRYAD_TPU_BUCKET_STACK=0 is the on-chip triage hatch
+    (per-term dots).  Shared by the kernel AND the VMEM sizing so the
+    hatch does not run an unstacked kernel against a stacked budget."""
+    return a_pad <= 128 and os.environ.get(
+        "DRYAD_TPU_BUCKET_STACK", "1") != "0"
+
+
+def _row_block(a_pad: int, n_vals: int, total_planes: int) -> Optional[int]:
     """Rows per grid step, multiple of 128 (rows ride the lane dim),
-    sized to the VMEM budget: per-row cost is the hi one-hot plus the
-    lo one-hot plus ``planes_per_val`` rhs planes per value column
-    (split-bf16 accumulation uses two); the (A, 128) accumulators are
-    resident off the top.  None when the accumulators alone blow the
-    budget (huge num_buckets) — callers must use the XLA fallback,
-    which has no VMEM ceiling."""
-    acc_bytes = a_pad * _LO * 4 * (1 + n_vals)
+    sized to the VMEM budget.  ``total_planes`` = 1 (counts) + sum of
+    split-bf16 terms over the value columns.  Per-row live set: the
+    inputs, the (128, R) lo one-hot, and — stacked formulation,
+    a_pad <= 128 — the (planes * stride, R) hi stack; the f32
+    accumulators and the dot output are resident off the top.  None
+    when the fixed arrays alone blow the budget (huge num_buckets) —
+    callers must use the XLA fallback, which has no VMEM ceiling."""
+    if _stacking_enabled(a_pad):
+        hi_rows = total_planes * _stack_stride(a_pad) + _stack_stride(a_pad)
+        out_rows = total_planes * _stack_stride(a_pad)
+    else:
+        # unstacked formulation: hi one-hot + per-term lo-side planes
+        hi_rows = a_pad + 2 * _LO
+        out_rows = a_pad
+    acc_bytes = a_pad * _LO * 4 * (1 + n_vals) + out_rows * _LO * 4
     left = _VMEM_BUDGET - acc_bytes
     if left <= 0:
         return None
-    r = left // (
-        4 * (a_pad + (1 + planes_per_val * n_vals) * _LO + 4)
-    )
+    # one-hots budgeted at 4B/element (bf16 payload, 2x slack for
+    # Mosaic relayout scratch), inputs at their real widths.
+    r = left // (4 * (hi_rows + _LO) + 5 + 4 * n_vals + 16)
     if r < 128:
         return None
     return min(8192, (r // 128) * 128)
@@ -131,9 +156,25 @@ def _make_kernel(n_vals: int, a_pad: int, splits: Tuple[int, ...] = ()):
     are exactly representable, and the f32 accumulator adds them — so
     2 terms give ~2^-16 relative representation error (float columns)
     and 3 terms keep integers exact to 2^24 (the documented dense-path
-    contract), at 2-3 native-rate passes instead of the HIGHEST
-    (f32-rate, ~6x slower) pass the round-3 kernel paid (BASELINE.md
-    round-4 pass-count analysis)."""
+    contract).
+
+    STACKED PLANES (a_pad <= 128): an MXU pass costs the same for any
+    output sublane extent <= 128 (the contraction length R, not the
+    output tile, is the clock — BASELINE.md pass-count analysis), so
+    the count plane and every value-term plane (``oh_hi * t`` — the
+    term multiplied into the SMALL A-row factor, not the 128-row lo
+    factor, cutting the VPU multiply 128/A-fold) stack into ONE hi
+    factor of (planes * stride, R) and ONE dot per row block.  At
+    K=4096 (A=32) count + one float column = 3 planes = 96 sublanes =
+    ONE native pass, vs 3 separate dots before (and vs 1 + ~6 f32-rate
+    passes in round 3).  Planes sit on 16-sublane strides (bf16 tile
+    alignment); the padded iota rows never match a hi index, so they
+    only add zeros.  For a_pad > 128 every plane is already >= 1 full
+    pass and stacking buys nothing: the per-term dots remain, with the
+    term multiplied into whichever factor is smaller (the lo plane)."""
+
+    stride = _stack_stride(a_pad)
+    stacked = _stacking_enabled(a_pad)
 
     def kernel(*refs):
         k_ref, m_ref = refs[0], refs[1]
@@ -150,8 +191,6 @@ def _make_kernel(n_vals: int, a_pad: int, splits: Tuple[int, ...] = ()):
         # mask folded into the lo factor zeroes invalid rows out of both
         # the counts and every sum in one place.
         oh_lo = (((kb & (_LO - 1)) == lo_iota) & mb).astype(jnp.bfloat16)
-        hi_iota = jax.lax.broadcasted_iota(jnp.int32, (a_pad, R), 0)
-        oh_hi = ((kb >> _LO_SHIFT) == hi_iota).astype(jnp.bfloat16)
 
         @pl.when(i == 0)
         def _init():
@@ -160,20 +199,48 @@ def _make_kernel(n_vals: int, a_pad: int, splits: Tuple[int, ...] = ()):
                 s[...] = jnp.zeros((a_pad, _LO), jnp.float32)
 
         contract_lanes = (((1,), (1,)), ((), ()))
-        cnt_ref[...] += jax.lax.dot_general(
-            oh_hi, oh_lo, contract_lanes,
-            preferred_element_type=jnp.float32,
-        )
-        for j, (v_ref, s_ref) in enumerate(zip(v_refs, sum_refs)):
-            v = v_ref[...].astype(jnp.float32)  # (1, R)
-            acc = None
-            for t in _split_terms(v, splits[j] if splits else 2):
-                d = jax.lax.dot_general(
-                    oh_hi, oh_lo * t, contract_lanes,
-                    preferred_element_type=jnp.float32,
-                )
-                acc = d if acc is None else acc + d
-            s_ref[...] += acc
+        if stacked:
+            hi_iota = jax.lax.broadcasted_iota(jnp.int32, (stride, R), 0)
+            oh_hi = ((kb >> _LO_SHIFT) == hi_iota).astype(jnp.bfloat16)
+            planes = [oh_hi]
+            for j, v_ref in enumerate(v_refs):
+                v = v_ref[...].astype(jnp.float32)  # (1, R)
+                for t in _split_terms(v, splits[j] if splits else 2):
+                    planes.append(oh_hi * t)
+            stack = (
+                planes[0] if len(planes) == 1
+                else jnp.concatenate(planes, axis=0)
+            )
+            out = jax.lax.dot_general(
+                stack, oh_lo, contract_lanes,
+                preferred_element_type=jnp.float32,
+            )  # (planes * stride, 128) f32
+            cnt_ref[...] += out[:a_pad]
+            off = stride
+            for j, s_ref in enumerate(sum_refs):
+                acc = None
+                for _ in range(splits[j] if splits else 2):
+                    d = out[off : off + a_pad]
+                    acc = d if acc is None else acc + d
+                    off += stride
+                s_ref[...] += acc
+        else:
+            hi_iota = jax.lax.broadcasted_iota(jnp.int32, (a_pad, R), 0)
+            oh_hi = ((kb >> _LO_SHIFT) == hi_iota).astype(jnp.bfloat16)
+            cnt_ref[...] += jax.lax.dot_general(
+                oh_hi, oh_lo, contract_lanes,
+                preferred_element_type=jnp.float32,
+            )
+            for j, (v_ref, s_ref) in enumerate(zip(v_refs, sum_refs)):
+                v = v_ref[...].astype(jnp.float32)  # (1, R)
+                acc = None
+                for t in _split_terms(v, splits[j] if splits else 2):
+                    d = jax.lax.dot_general(
+                        oh_hi, oh_lo * t, contract_lanes,
+                        preferred_element_type=jnp.float32,
+                    )
+                    acc = d if acc is None else acc + d
+                s_ref[...] += acc
 
     return kernel
 
@@ -267,7 +334,7 @@ def bucket_sum_count(
             ]
 
     splits = _val_splits(values)
-    R = _row_block(a_pad, len(values), max(splits, default=2))
+    R = _row_block(a_pad, len(values), 1 + sum(splits))
     if interpret is True and (pl is None or R is None):
         # An explicit interpret=True means the caller wants the Pallas
         # kernel exercised; silently taking the XLA fallback would stop

@@ -122,9 +122,9 @@ def test_huge_bucket_count_uses_fallback(rng):
     VMEM ceiling (code-review regression)."""
     from dryad_tpu.ops.pallas_bucket import _hi_width, _row_block
 
-    assert _row_block(_hi_width(300)) is not None
+    assert _row_block(_hi_width(300), 1, 3) is not None
     big = 1 << 20
-    assert _row_block(_hi_width(big), n_vals=2) is None
+    assert _row_block(_hi_width(big), n_vals=2, total_planes=5) is None
     n = 2000
     k = rng.integers(0, big, n).astype(np.int32)
     v = np.ones(n, np.float32)

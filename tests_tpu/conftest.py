@@ -8,13 +8,16 @@ backend is unreachable — remote-TPU init can hang, so reachability is
 probed in a subprocess with a hard timeout (the bench.py pattern).
 """
 
+import os
 import subprocess
 import sys
+import time
 
 import pytest
 
 
-def _probe_backend(timeout: float = 90.0):
+def _probe_once(timeout: float):
+    """Returns (platform | None, timed_out)."""
     probe = "import jax; d = jax.devices()[0]; print('PLATFORM=' + d.platform)"
     try:
         out = subprocess.run(
@@ -22,11 +25,37 @@ def _probe_backend(timeout: float = 90.0):
             capture_output=True, text=True, timeout=timeout,
         )
     except subprocess.TimeoutExpired:
-        return None
+        return None, True
     for line in out.stdout.splitlines():
         if line.startswith("PLATFORM="):
-            return line.split("=", 1)[1]
-    return None
+            return line.split("=", 1)[1], False
+    return None, False
+
+
+def _probe_backend():
+    """The tunnel FLAPS — a single stalled init must not skip the whole
+    suite (round-4: a 90s one-shot probe skipped all 8 tests seconds
+    after a successful bench run on the same chip).  Retry over a
+    window, both env-overridable.  Only a TIMED-OUT probe retries — an
+    instant failure (broken jax, no backend registered) is
+    deterministic and skips immediately."""
+    timeout = float(os.environ.get("DRYAD_TPU_PROBE_TIMEOUT", "90"))
+    window = float(os.environ.get("DRYAD_TPU_PROBE_WINDOW", "240"))
+    # A FAST failure (probe exits with an error in seconds) is usually
+    # deterministic (broken jax, no backend) but can also be a flap
+    # closing the socket mid-handshake — so fast failures get a short
+    # retry grace instead of the full hang window.
+    fast_grace = min(window, 45.0)
+    t0 = time.monotonic()
+    while True:
+        platform, timed_out = _probe_once(timeout)
+        if platform is not None:
+            return platform
+        elapsed = time.monotonic() - t0
+        limit = window if timed_out else fast_grace
+        if elapsed + (timeout if timed_out else 10.0) > limit:
+            return None
+        time.sleep(10.0)
 
 
 def pytest_collection_modifyitems(config, items):
