@@ -520,8 +520,7 @@ class DryadContext:
         P = num_partitions(self.mesh)
         cap = batch.capacity // P
         parts = []
-        valid = np.asarray(batch.valid)
-        host_cols = {c: np.asarray(v) for c, v in batch.data.items()}
+        valid, host_cols = batch.fetch_host()  # overlapped d2h copies
         for i in range(P):
             sl = slice(i * cap, (i + 1) * cap)
             m = valid[sl]

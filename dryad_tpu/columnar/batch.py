@@ -14,7 +14,7 @@ columns: logical INT64/STRING columns are two uint32 word columns (see
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -200,18 +200,32 @@ class ColumnBatch:
         valid[:n] = True
         return ColumnBatch(data, jnp.asarray(valid))
 
+    def fetch_host(self) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
+        """(valid, columns) on the host, via ONE ``jax.device_get`` so
+        PJRT overlaps all the device->host copies (copy_to_host_async
+        then a single block).  A per-column ``np.asarray`` loop pays
+        one synchronous transfer round-trip per column, which dominates
+        egress through a high-latency link (BASELINE.md round-4:
+        ~70 ms/round-trip through the tunnel x 4-5 columns per rep)."""
+        import jax
+
+        assert "#valid" not in self.data, "'#valid' is a reserved name"
+        host = jax.device_get({"#valid": self.valid, **self.data})
+        valid = host.pop("#valid")
+        return valid, host
+
     def to_numpy(
         self,
         schema: Schema,
         dictionary: Optional[StringDictionary] = None,
     ) -> Dict[str, np.ndarray]:
         """Decode valid rows back to host logical columns."""
-        valid = np.asarray(self.valid)
+        valid, host = self.fetch_host()
         out: Dict[str, np.ndarray] = {}
         for f in schema.fields:
             if f.ctype == ColumnType.STRING:
-                lo = np.asarray(self.data[f"{f.name}#h0"])[valid]
-                hi = np.asarray(self.data[f"{f.name}#h1"])[valid]
+                lo = host[f"{f.name}#h0"][valid]
+                hi = host[f"{f.name}#h1"][valid]
                 hashes = join64(lo, hi)
                 if dictionary is None:
                     out[f.name] = hashes  # fall back to raw hashes
@@ -220,15 +234,15 @@ class ColumnBatch:
                         dictionary.lookup_all(hashes), dtype=object
                     )
             elif f.ctype == ColumnType.INT64:
-                lo = np.asarray(self.data[f"{f.name}#h0"])[valid]
-                hi = np.asarray(self.data[f"{f.name}#h1"])[valid]
+                lo = host[f"{f.name}#h0"][valid]
+                hi = host[f"{f.name}#h1"][valid]
                 out[f.name] = join64(lo, hi, signed=True)
             elif f.ctype == ColumnType.FLOAT64:
                 from dryad_tpu.columnar.schema import ordered_i64_to_f64
 
-                lo = np.asarray(self.data[f"{f.name}#h0"])[valid]
-                hi = np.asarray(self.data[f"{f.name}#h1"])[valid]
+                lo = host[f"{f.name}#h0"][valid]
+                hi = host[f"{f.name}#h1"][valid]
                 out[f.name] = ordered_i64_to_f64(join64(lo, hi, signed=True))
             else:
-                out[f.name] = np.asarray(self.data[f.name])[valid]
+                out[f.name] = np.asarray(host[f.name])[valid]
         return out

@@ -657,8 +657,7 @@ class GraphExecutor:
                 for n, v in b.data.items()
             }
         else:
-            valid = np.asarray(b.valid)
-            host_cols = {n: np.asarray(v) for n, v in b.data.items()}
+            valid, host_cols = b.fetch_host()  # overlapped d2h copies
         schema = p["schema"]
         phys = schema.device_names()
         expected = {n: _phys_np_dtype(n, schema) for n in phys}

@@ -47,13 +47,21 @@ def _probe_backend():
     # retry grace instead of the full hang window.
     fast_grace = min(window, 45.0)
     t0 = time.monotonic()
+    hard_limit = window
     while True:
-        platform, timed_out = _probe_once(timeout)
+        elapsed = time.monotonic() - t0
+        # Never let a single probe run past the window: total probe
+        # time stays <= window no matter how fast-fails and hangs
+        # interleave (a caller's subprocess budget relies on this).
+        allowed = hard_limit - elapsed
+        if allowed < 5.0:
+            return None
+        platform, timed_out = _probe_once(min(timeout, allowed))
         if platform is not None:
             return platform
-        elapsed = time.monotonic() - t0
-        limit = window if timed_out else fast_grace
-        if elapsed + (timeout if timed_out else 10.0) > limit:
+        if not timed_out:
+            hard_limit = min(hard_limit, fast_grace)
+        if time.monotonic() - t0 + 10.0 > hard_limit:
             return None
         time.sleep(10.0)
 
