@@ -30,7 +30,7 @@ from dryad_tpu.plan import infer
 from dryad_tpu.plan.nodes import Node, PartitionInfo
 
 KeyArg = Union[str, Sequence[str]]
-OrderArg = Union[str, Tuple[str, bool]]
+OrderArg = Union[str, Tuple[str, Union[bool, str]]]  # bool True / "desc" = descending
 
 JOIN_STRATEGIES = ("shuffle", "broadcast", "auto")
 
@@ -60,7 +60,19 @@ def _keys(k: KeyArg) -> List[str]:
 def _order_keys(keys: Sequence[OrderArg]) -> List[Tuple[str, bool]]:
     out: List[Tuple[str, bool]] = []
     for k in keys:
-        out.append((k, False) if isinstance(k, str) else (k[0], bool(k[1])))
+        if isinstance(k, str):
+            out.append((k, False))
+            continue
+        name, d = k[0], k[1]
+        # accept "asc"/"desc" strings: a bare bool(...) would read the
+        # truthy string "asc" as DESCENDING — a silent wrong order.
+        if isinstance(d, str):
+            if d not in ("asc", "desc"):
+                raise ValueError(
+                    f"order direction for {name!r} must be 'asc', 'desc' "
+                    f"or a bool (True=descending), got {d!r}")
+            d = d == "desc"
+        out.append((name, bool(d)))
     return out
 
 

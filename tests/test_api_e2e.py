@@ -443,3 +443,16 @@ def test_cache_release_and_stale_binding_error(rng):
     c2 = src.group_by("k", {"c": ("count", None)}).cache()
     with pytest.raises(ValueError, match="release"):
         ctx.release(c2.where(lambda cols: cols["c"] > 0))
+
+
+def test_order_by_direction_strings(ctx):
+    """("col", "asc"/"desc") string directions parse correctly — a bare
+    bool() would read the truthy "asc" as DESCENDING (silent wrong
+    order) — and unknown direction strings raise."""
+    tbl = {"a": np.array([5, 1, 9, 3], np.int32)}
+    up = ctx.from_arrays(tbl).order_by([("a", "asc")]).collect()
+    assert list(up["a"]) == [1, 3, 5, 9]
+    down = ctx.from_arrays(tbl).order_by([("a", "desc")]).collect()
+    assert list(down["a"]) == [9, 5, 3, 1]
+    with pytest.raises(ValueError, match="direction"):
+        ctx.from_arrays(tbl).order_by([("a", "ascending")]).collect()
