@@ -245,29 +245,65 @@ def _make_kernel(n_vals: int, a_pad: int, splits: Tuple[int, ...] = ()):
     return kernel
 
 
+# "axon" is a tunneled-TPU PJRT plugin whose backend keeps its own
+# name; its MLIR lowerings alias to TPU, so Pallas compiles for it.
+# Single source of truth for the alias set — probe_perf.py keys its
+# persisted recommendation off this too.
+TPU_PLATFORMS = ("tpu", "axon")
+
+
 def _on_tpu() -> bool:
-    # "axon" is a tunneled-TPU PJRT plugin whose backend keeps its own
-    # name; its MLIR lowerings alias to TPU, so Pallas compiles for it.
     try:
-        if jax.default_backend() in ("tpu", "axon"):
+        if jax.default_backend() in TPU_PLATFORMS:
             return True
-        return getattr(jax.devices()[0], "platform", "") in ("tpu", "axon")
+        return getattr(jax.devices()[0], "platform", "") in TPU_PLATFORMS
     except Exception:  # pragma: no cover
         return False
 
 
+_PROBE_STRATEGY: dict = {}
+
+
+def _probed_strategy(platform: str) -> Optional[str]:
+    """Measured winner from ``probe_perf.py``'s persisted artifact
+    (PROBE_TPU.json at the repo root), cached per process."""
+    if platform in _PROBE_STRATEGY:
+        return _PROBE_STRATEGY[platform]
+    rec = None
+    try:
+        import json
+
+        path = os.environ.get("DRYAD_TPU_PROBE_FILE") or os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))), "PROBE_TPU.json")
+        if os.path.exists(path):
+            with open(path) as fh:
+                entry = json.load(fh).get(platform)
+            if entry and entry.get("recommend") in ("matmul", "scatter"):
+                rec = entry["recommend"]
+    except (OSError, ValueError):  # pragma: no cover - malformed artifact
+        rec = None
+    _PROBE_STRATEGY[platform] = rec
+    return rec
+
+
 def _default_strategy() -> str:
     """Bucket-reduce strategy: one-hot MXU matmul vs plain scatter-add
-    (``segment_sum`` on unsorted keys — no sort).  The CPU probe
-    (``probe_perf.py``, BASELINE.md) measured scatter ~100x faster than
-    the sort path and well above the factorized matmul on CPU; on TPU
-    scatters have historically serialized, so the matmul stays default
-    until the on-chip probe demonstrates otherwise.  Override with
-    ``DRYAD_TPU_BUCKET_STRATEGY=matmul|scatter``."""
+    (``segment_sum`` on unsorted keys — no sort).  Priority: explicit
+    env ``DRYAD_TPU_BUCKET_STRATEGY=matmul|scatter`` > on TPU only,
+    the measured winner persisted by ``probe_perf.py``
+    (PROBE_TPU.json — the artifact carries CHIP truth; off-TPU records
+    are ignored so a committed or stale file can never flip CPU test
+    runs) > platform default (matmul on TPU — scatters have
+    historically serialized there; scatter elsewhere, measured ~100x
+    over the sort path on CPU, BASELINE.md)."""
     env = os.environ.get("DRYAD_TPU_BUCKET_STRATEGY")
     if env in ("matmul", "scatter"):
         return env
-    return "matmul" if _on_tpu() else "scatter"
+    if _on_tpu():
+        probed = _probed_strategy("tpu")
+        return probed if probed is not None else "matmul"
+    return "scatter"
 
 
 def _scatter_bucket(

@@ -94,6 +94,21 @@ def main():
             a, b = jax.lax.sort((k, v), num_keys=1)
             return a[0] + b[0]
 
+        # F/G: permutation scatter + gather — the reorder primitives a
+        # radix/counting sort would pay per pass (ops/segmented.py sort
+        # replacement is viable only if one of these runs HBM-bound).
+        _MIX = jnp.uint32(2654435761)
+
+        def perm_scatter_body(k, v, valid):
+            perm = (k.astype(jnp.uint32) * _MIX + jnp.uint32(12345)) % n
+            out = jnp.zeros((n,), v.dtype).at[perm].set(v, mode="drop")
+            return out[0] + out[n - 1]
+
+        def perm_gather_body(k, v, valid):
+            perm = (k.astype(jnp.uint32) * _MIX + jnp.uint32(12345)) % n
+            out = v[perm]
+            return out[0] + out[n - 1]
+
         def looped(body16):
             @jax.jit
             def f(k, v, valid):
@@ -113,8 +128,12 @@ def main():
             ("B bare_sort", lambda: float(bare_sort(k, v)), None),
             ("C scatter_add", single(scatter_body), scatter_body),
             ("D dense_xla", single(dense_body(False)), dense_body(False)),
+            ("F perm_scatter", single(perm_scatter_body), perm_scatter_body),
+            ("G perm_gather", single(perm_gather_body), perm_gather_body),
         ]
-        if d.platform in ("tpu", "axon"):
+        from dryad_tpu.ops.pallas_bucket import TPU_PLATFORMS
+
+        if d.platform in TPU_PLATFORMS:
             cases.append(
                 ("E dense_pallas", single(dense_body(None)), dense_body(None))
             )
@@ -148,14 +167,41 @@ def main():
             rec = "scatter" if scat > mxu else "matmul"
             import json
 
-            print(json.dumps({
+            from dryad_tpu.ops.pallas_bucket import TPU_PLATFORMS
+
+            plat_key = "tpu" if d.platform in TPU_PLATFORMS else d.platform
+            record = {
                 "probe": "bucket_strategy", "n": n,
-                "platform": d.platform,
+                "platform": plat_key,
                 "matmul_rows_s": round(mxu, 1),
                 "scatter_rows_s": round(scat, 1),
                 "recommend": rec,
                 "env": f"DRYAD_TPU_BUCKET_STRATEGY={rec}",
-            }), flush=True)
+            }
+            print(json.dumps(record), flush=True)
+            # Persist so ops/pallas_bucket._default_strategy picks the
+            # measured winner up automatically (env still overrides).
+            import os
+
+            out_path = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "PROBE_TPU.json"
+            )
+            try:
+                existing = {}
+                if os.path.exists(out_path):
+                    try:
+                        with open(out_path) as fh:
+                            existing = json.load(fh)
+                    except ValueError:
+                        existing = {}  # truncated prior write: start over
+                existing[plat_key] = record
+                tmp = out_path + ".tmp"
+                with open(tmp, "w") as fh:
+                    json.dump(existing, fh, indent=1)
+                os.replace(tmp, out_path)  # atomic: no torn artifact
+                log(f"wrote {out_path}")
+            except OSError as e:
+                log(f"could not write {out_path}: {e}")
     log("done")
 
 

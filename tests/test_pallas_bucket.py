@@ -163,3 +163,34 @@ def test_float_split_accuracy_vs_f64(rng):
     sums, _ = bucket_sum_count(k, [v], np.ones(n, bool), K, interpret=True)
     ref = np.bincount(k, weights=v.astype(np.float64), minlength=K)
     np.testing.assert_allclose(np.asarray(sums[0]), ref, rtol=3e-5)
+
+
+def test_probed_strategy_artifact(tmp_path, monkeypatch):
+    """probe_perf.py's persisted recommendation is read for the TPU
+    platform (env still wins); off-TPU records are ignored so a stale
+    artifact can't flip CPU runs; malformed artifacts fall back."""
+    import json
+
+    from dryad_tpu.ops import pallas_bucket as pb
+
+    art = tmp_path / "PROBE_TPU.json"
+    art.write_text(json.dumps(
+        {"cpu": {"recommend": "matmul"}, "tpu": {"recommend": "scatter"}}))
+    monkeypatch.setenv("DRYAD_TPU_PROBE_FILE", str(art))
+    monkeypatch.delenv("DRYAD_TPU_BUCKET_STRATEGY", raising=False)
+    pb._PROBE_STRATEGY.clear()
+    # the reader consults the artifact's tpu record
+    assert pb._probed_strategy("tpu") == "scatter"
+    # ...but on the CPU backend the artifact is IGNORED: still scatter
+    # by platform default, even though the file says matmul for cpu
+    assert pb._default_strategy() == "scatter"
+    # env override beats everything
+    monkeypatch.setenv("DRYAD_TPU_BUCKET_STRATEGY", "matmul")
+    assert pb._default_strategy() == "matmul"
+    monkeypatch.delenv("DRYAD_TPU_BUCKET_STRATEGY")
+    # malformed artifact -> None from the reader, defaults hold
+    art.write_text("{not json")
+    pb._PROBE_STRATEGY.clear()
+    assert pb._probed_strategy("tpu") is None
+    assert pb._default_strategy() == "scatter"
+    pb._PROBE_STRATEGY.clear()
