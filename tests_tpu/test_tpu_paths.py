@@ -30,7 +30,17 @@ def test_pallas_bucket_kernel_on_chip(jaxmod, ):
     ref_cnt = np.bincount(k, minlength=K)
     ref_sum = np.bincount(k, weights=v, minlength=K)
     np.testing.assert_array_equal(np.asarray(cnt), ref_cnt)
-    np.testing.assert_allclose(np.asarray(sums[0]), ref_sum, rtol=1e-4)
+    # Split-bf16 error contract (BASELINE.md round-4): ~2^-16 per
+    # ELEMENT, so the bound scales with the per-bucket sum of |v|
+    # (cancellation makes a pure rtol vs the result meaningless).
+    ref_abs = np.bincount(k, weights=np.abs(v), minlength=K)
+    tol = 2.0**-16 * ref_abs + 1e-6
+    err = np.abs(np.asarray(sums[0]) - ref_sum)
+    worst = int(np.argmax(err - tol))
+    assert np.all(err <= tol), (
+        f"bucket {worst}: err {err[worst]:.3e} exceeds split-bf16 "
+        f"bound {tol[worst]:.3e}"
+    )
 
 
 def test_group_reduce_on_chip(jaxmod):
