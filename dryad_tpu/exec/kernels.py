@@ -425,8 +425,7 @@ def _k_topk(ctx: StageContext, p) -> None:
     shuffle)."""
     b = ctx.slots[p["slot"]]
     operands = p["operands_fn"](b)
-    order = SORT.sort_order_by_operands(operands, b.valid)
-    sb = b.take(order)  # local sort; valid rows first
+    sb = SORT.sort_batch_by_operands(b, operands)  # local sort; valid rows first
     n = int(p["n"])
     # head size never exceeds the partition capacity: slicing past the
     # array would clamp and the gather arithmetic below would duplicate
@@ -436,8 +435,8 @@ def _k_topk(ctx: StageContext, p) -> None:
         {c: v[:n_pad] for c, v in sb.data.items()}, sb.valid[:n_pad]
     )
     gb = _gather_all(head, ctx.axes)  # every partition: all P heads
-    gorder = SORT.sort_order_by_operands(p["operands_fn"](gb), gb.valid)
-    gsb = gb.take(gorder)  # identical globally-sorted array everywhere
+    # identical globally-sorted array everywhere
+    gsb = SORT.sort_batch_by_operands(gb, p["operands_fn"](gb))
     me = jax.lax.axis_index(ctx.axes)
     start = me * n_pad
     pos = start + jnp.arange(n_pad, dtype=jnp.int32)
@@ -454,8 +453,7 @@ def _k_topk(ctx: StageContext, p) -> None:
 
 def _k_local_sort(ctx: StageContext, p) -> None:
     b = ctx.slots[p["slot"]]
-    order = SORT.sort_order_by_operands(p["operands_fn"](b), b.valid)
-    ctx.slots[p["slot"]] = b.take(order)
+    ctx.slots[p["slot"]] = SORT.sort_batch_by_operands(b, p["operands_fn"](b))
 
 
 # -- multi-input -----------------------------------------------------------
@@ -641,8 +639,7 @@ def _exchange_by_rank(
     ctx.overflow = ctx.overflow | ovf
     out, ovf2 = SH.resize(out, per)
     ctx.overflow = ctx.overflow | ovf2
-    order = SORT.sort_order_by_operands([out.data["#rank"]], out.valid)
-    return out.take(order)
+    return SORT.sort_batch_by_operands(out, [out.data["#rank"]])
 
 
 def _k_zip(ctx: StageContext, p) -> None:

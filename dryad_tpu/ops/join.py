@@ -20,8 +20,7 @@ import jax.numpy as jnp
 
 from dryad_tpu.columnar.batch import ColumnBatch
 from dryad_tpu.ops.hash import hash_columns
-from dryad_tpu.ops.sort import sort_order_by_operands
-from dryad_tpu.ops.sortkeys import sort_order
+from dryad_tpu.ops.sort import sort_batch_by_operands, sort_carry
 
 
 def _suffixed(phys_name: str, suffix: str) -> str:
@@ -48,9 +47,14 @@ def _probe_ranges(
     """
     rhash = hash_columns([right.data[k] for k in right_keys]) >> 1
     rhash = jnp.where(right.valid, rhash, jnp.uint32(0xFFFFFFFF))
-    order = jnp.argsort(rhash)  # sentinel rows last
-    rs = right.take(order)
-    rhash_sorted = rhash[order]
+    # Stable sort by hash carrying the batch + the hash itself through
+    # lax.sort (sentinel rows last — valid-first ordering is identical
+    # here because only invalid rows hold the sentinel hash).
+    names = right.columns
+    vs, (rhash_sorted,), carried = sort_carry(
+        [rhash], right.valid, [right.data[n] for n in names]
+    )
+    rs = ColumnBatch(dict(zip(names, carried)), vs)
 
     lhash = hash_columns([left.data[k] for k in left_keys]) >> 1
     start = jnp.searchsorted(rhash_sorted, lhash, side="left")
@@ -216,10 +220,9 @@ def hash_join_ranked(
     partitionings.  Without, ranks follow the right side's engine order.
     """
     if len(order_operands):
-        pre = sort_order_by_operands(order_operands, right.valid)
-        right = right.take(pre)
-    # _probe_ranges' argsort is stable, so the operand order survives
-    # within each equal-hash run.
+        right = sort_batch_by_operands(right, order_operands)
+    # _probe_ranges' hash sort is stable (sort_carry, is_stable=True),
+    # so the operand order survives within each equal-hash run.
     rs, lhash, start, counts = _probe_ranges(left, right, left_keys, right_keys)
     li, ri, pair_valid, overflow, offsets = _expand_pairs(
         start, counts, out_capacity

@@ -25,13 +25,60 @@ from dryad_tpu.ops.sortkeys import to_sortable_u32
 def sort_order_by_operands(
     operands: Sequence[jax.Array], valid: jax.Array
 ) -> jax.Array:
-    """Stable permutation: valid rows first, lexicographic by uint32 operands."""
+    """Stable permutation: valid rows first, lexicographic by uint32 operands.
+
+    Prefer :func:`sort_batch_by_operands` / :func:`sort_carry` when the
+    goal is sorted DATA: applying this permutation with ``take()``
+    costs one gather per column (~42 ms/column at n=4M on v5e,
+    `probe_sortops.py`), while carrying the columns through
+    ``lax.sort`` as extra operands is free (~14.5 ms total vs 99 ms
+    for sort-index + 2 gathers).  Use the permutation form only when
+    the order must be applied to something that cannot ride the sort.
+    """
     n = valid.shape[0]
     ops: List[jax.Array] = [jnp.logical_not(valid).astype(jnp.uint32)]
     ops.extend(o.astype(jnp.uint32) for o in operands)
     ops.append(jnp.arange(n, dtype=jnp.int32))
     res = jax.lax.sort(tuple(ops), num_keys=len(ops) - 1, is_stable=True)
     return res[-1]
+
+
+def sort_carry(
+    operands: Sequence[jax.Array],
+    valid: jax.Array,
+    carry: Sequence[jax.Array] = (),
+) -> Tuple[jax.Array, List[jax.Array], List[jax.Array]]:
+    """Stable sort (valid rows first, lexicographic by uint32 operands)
+    carrying payload arrays through the sort as extra ``lax.sort``
+    operands.
+
+    Returns ``(sorted_valid, sorted_operands, sorted_carry)``.  The
+    permutation is identical to ``take(sort_order_by_operands(...))``
+    (same stable key comparison), but chip-measured ~7x cheaper than
+    sort-index-then-gather for 2 payload columns at n=4M
+    (`probe_sortops.py`: 14.5 ms vs 99 ms; extra operands are ~free).
+    """
+    inv = jnp.logical_not(valid).astype(jnp.uint32)
+    ops = (inv,) + tuple(o.astype(jnp.uint32) for o in operands)
+    res = jax.lax.sort(ops + tuple(carry), num_keys=len(ops), is_stable=True)
+    return (
+        res[0] == 0,
+        list(res[1:len(ops)]),
+        list(res[len(ops):]),
+    )
+
+
+def sort_batch_by_operands(
+    batch: ColumnBatch, operands: Sequence[jax.Array]
+) -> ColumnBatch:
+    """Sort a whole batch by uint32 operands (valid rows first), every
+    column carried through one ``lax.sort`` — the data-movement-optimal
+    replacement for ``batch.take(sort_order_by_operands(...))``."""
+    names = batch.columns
+    valid, _, carried = sort_carry(
+        operands, batch.valid, [batch.data[n] for n in names]
+    )
+    return ColumnBatch(dict(zip(names, carried)), valid)
 
 
 def sample_splitters(
@@ -50,9 +97,7 @@ def sample_splitters(
     election, minus the host round-trip.
     """
     P, m = num_partitions, samples_per_partition
-    n = valid.shape[0]
-    order = sort_order_by_operands([key_u32], valid)
-    ks = key_u32[order]
+    _, (ks,), _ = sort_carry([key_u32], valid)
     count = jnp.sum(valid.astype(jnp.int32))
 
     # Evenly spaced sample positions in the valid prefix.
@@ -102,11 +147,11 @@ def sample_splitters_multi(
     proportions.  Returns one ``(P-1,)`` splitter array per word.
     """
     P, m = num_partitions, samples_per_partition
-    order = sort_order_by_operands(list(words), valid)
+    _, sorted_words, _ = sort_carry(list(words), valid)
     count = jnp.sum(valid.astype(jnp.int32))
     pos = (jnp.arange(m, dtype=jnp.float32) + 0.5) * count.astype(jnp.float32) / m
     idx = jnp.clip(pos.astype(jnp.int32), 0, jnp.maximum(count - 1, 0))
-    samples = [w[order][idx] for w in words]
+    samples = [w[idx] for w in sorted_words]
     sample_valid = jnp.full((m,), count > 0)
 
     gathered = [
