@@ -42,6 +42,13 @@ def sort_order(
 
     Invalid rows sort last (their key is forced to the max), so a batch
     gathered by this order is simultaneously compacted and sorted.
+
+    NOTE: when the goal is sorted DATA, prefer
+    ``ops.sort.sort_batch_by_operands`` / ``sort_carry`` — applying a
+    permutation with ``take()`` costs ~42 ms per gathered column at
+    n=4M on v5e, while carrying columns through ``lax.sort`` is free
+    (BASELINE.md round-4).  Use the permutation form only when the
+    order must be applied to something that cannot ride the sort.
     """
     n = valid.shape[0]
     desc = list(descending) if descending is not None else [False] * len(key_cols)

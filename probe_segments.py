@@ -55,11 +55,14 @@ def main():
     def candidate(k, v, valid):
         start, seg = layout(k, valid)
         nvalid = jnp.sum(valid.astype(jnp.int32))
-        idx = jnp.where(start, seg, cap + 1)
+        # Same formulation as the shipped kernel (ops/segmented.py):
+        # out-of-range sentinel cap+2 so non-start rows are genuinely
+        # dropped, and NO unique_indices promise.
+        idx = jnp.where(start, seg, cap + 2)
         start_pos = (
             jnp.full((cap + 2,), nvalid, jnp.int32)
-            .at[idx].set(jnp.arange(cap, dtype=jnp.int32), mode="drop",
-                         unique_indices=True)[: cap + 1]
+            .at[idx].set(jnp.arange(cap, dtype=jnp.int32),
+                         mode="drop")[: cap + 1]
         )
         cnt = start_pos[1:] - start_pos[:cap]
         csum = jnp.cumsum(jnp.where(valid, v, 0.0))
