@@ -43,37 +43,64 @@ def sort_order_by_operands(
     return res[-1]
 
 
+def _carry_profitable() -> bool:
+    """Platform split for the payload-movement strategy.  On TPU,
+    carrying payload through ``lax.sort`` is free while each
+    post-sort gather costs ~42 ms/column at n=4M (`probe_sortops.py`);
+    on CPU it inverts — gathers are cheap and extra variadic sort
+    operands are not (bench round-4: the carry form cost the CPU
+    sort path ~1.4x).  Both forms produce the identical stable
+    permutation; only data movement differs."""
+    from dryad_tpu.ops.pallas_bucket import _on_tpu
+
+    return _on_tpu()
+
+
 def sort_carry(
     operands: Sequence[jax.Array],
     valid: jax.Array,
     carry: Sequence[jax.Array] = (),
 ) -> Tuple[jax.Array, List[jax.Array], List[jax.Array]]:
     """Stable sort (valid rows first, lexicographic by uint32 operands)
-    carrying payload arrays through the sort as extra ``lax.sort``
-    operands.
+    carrying payload arrays along.
 
     Returns ``(sorted_valid, sorted_operands, sorted_carry)``.  The
     permutation is identical to ``take(sort_order_by_operands(...))``
-    (same stable key comparison), but chip-measured ~7x cheaper than
+    (same stable key comparison).  On TPU the payload rides the sort
+    as extra ``lax.sort`` operands — chip-measured ~7x cheaper than
     sort-index-then-gather for 2 payload columns at n=4M
-    (`probe_sortops.py`: 14.5 ms vs 99 ms; extra operands are ~free).
+    (`probe_sortops.py`: 14.5 ms vs 99 ms); elsewhere the payload is
+    gathered by the sorted row index (cheaper off-TPU, bench round-4).
     """
     inv = jnp.logical_not(valid).astype(jnp.uint32)
     ops = (inv,) + tuple(o.astype(jnp.uint32) for o in operands)
-    res = jax.lax.sort(ops + tuple(carry), num_keys=len(ops), is_stable=True)
+    if not carry or _carry_profitable():
+        res = jax.lax.sort(
+            ops + tuple(carry), num_keys=len(ops), is_stable=True
+        )
+        return (
+            res[0] == 0,
+            list(res[1:len(ops)]),
+            list(res[len(ops):]),
+        )
+    n = valid.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    res = jax.lax.sort(ops + (idx,), num_keys=len(ops), is_stable=True)
+    order = res[-1]
     return (
         res[0] == 0,
         list(res[1:len(ops)]),
-        list(res[len(ops):]),
+        [c[order] for c in carry],
     )
 
 
 def sort_batch_by_operands(
     batch: ColumnBatch, operands: Sequence[jax.Array]
 ) -> ColumnBatch:
-    """Sort a whole batch by uint32 operands (valid rows first), every
-    column carried through one ``lax.sort`` — the data-movement-optimal
-    replacement for ``batch.take(sort_order_by_operands(...))``."""
+    """Sort a whole batch by uint32 operands (valid rows first) — the
+    data-movement-optimal replacement for
+    ``batch.take(sort_order_by_operands(...))`` (strategy per
+    :func:`_carry_profitable`)."""
     names = batch.columns
     valid, _, carried = sort_carry(
         operands, batch.valid, [batch.data[n] for n in names]

@@ -206,3 +206,30 @@ def test_deferred_overflow_window_on_chip(jaxmod):
     assert len(j["k"]) == len(np.unique(kk))
     kinds = [e["kind"] for e in ev.events()]
     assert "overflow_drain" in kinds
+
+
+def test_sort_carry_on_chip(jaxmod):
+    """The operand-carrying sort (round-4 rewrite of every
+    take(sort_order(...)) site) matches the permutation form on the
+    real chip, where the two lower very differently (one variadic
+    sort vs sort + per-column gathers)."""
+    import jax.numpy as jnp
+
+    from dryad_tpu.ops.sort import (
+        sort_carry,
+        sort_order_by_operands,
+    )
+    from dryad_tpu.ops.sortkeys import to_sortable_u32
+
+    rng = np.random.default_rng(9)
+    n = 1 << 14
+    keys = jnp.asarray(rng.integers(-5000, 5000, n).astype(np.int32))
+    valid = jnp.asarray(rng.random(n) < 0.85)
+    pf = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+    ops = [to_sortable_u32(keys)]
+
+    order = np.asarray(sort_order_by_operands(ops, valid))
+    v, (sk,), (spf,) = sort_carry(ops, valid, [pf])
+    np.testing.assert_array_equal(np.asarray(v), np.asarray(valid)[order])
+    np.testing.assert_array_equal(np.asarray(sk), np.asarray(ops[0])[order])
+    np.testing.assert_array_equal(np.asarray(spf), np.asarray(pf)[order])
