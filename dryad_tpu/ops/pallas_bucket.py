@@ -110,7 +110,13 @@ def _row_block(a_pad: int, n_vals: int, total_planes: int) -> Optional[int]:
     r = left // (4 * (hi_rows + _LO) + 5 + 4 * n_vals + 16)
     if r < 128:
         return None
-    return min(8192, (r // 128) * 128)
+    r = min(8192, (r // 128) * 128)
+    # Experiment hatch: force the row block (rounded to 128, clamped to
+    # the VMEM-derived value) — for on-chip R sweeps (sweep_bucket.py).
+    forced = os.environ.get("DRYAD_TPU_BUCKET_R")
+    if forced:
+        r = min(r, max(128, (int(forced) // 128) * 128))
+    return r
 
 
 def _split_terms(v, n: int):

@@ -10,13 +10,15 @@ module, pre-shuffle) + post-shuffle final reduce — the
 Seed/Accumulate/RecursiveAccumulate/FinalReduce decomposition of
 ``LinqToDryad/IDecomposable.cs:35-71``.
 
-Kernel-strategy note (BASELINE.md roofline; ``probe_perf.py``): the
-sort is the dominant cost here — a raw scatter-add (``segment_sum`` on
-unsorted keys) measures ~100x faster on CPU, but XLA:TPU scatters have
-historically serialized, so switching the general path (or adding an
-auto dense/scatter selection for bounded int keys) awaits the on-chip
-probe numbers.  The bounded-key fast path already exists:
-``group_by(dense=K)`` (``ops/pallas_bucket.py``).
+Kernel-strategy note — SETTLED ON CHIP (BASELINE.md round-4;
+``probe_perf.py`` → ``PROBE_TPU.json``): raw scatter-adds serialize on
+TPU (7×10⁷ rows/s, 22× under the matmul bucket path), so the general
+path stays sort-based and the bounded-key fast path stays the MXU
+kernel (``group_by(dense=K)``, auto-selected for dictionary STRING
+and ingest-bounded INT32 keys).  Within the sort path, the sort
+carries all columns as ``lax.sort`` operands (``ops/sort.py``) and
+counts come from one shared start-position scatter — the measured
+optimum of the round-4 rewrite (2.47→6.0 ×10⁷ rows/s on v5e).
 """
 
 from __future__ import annotations
