@@ -77,6 +77,21 @@ def _infer_schema(arrays: Dict[str, np.ndarray]) -> Schema:
     return Schema(fields)
 
 
+def _fetch_with_miss(batch, deferred):
+    """Fetch a result batch host-side with the job's deferred dict-miss
+    counters riding the same ``device_get``, resolve the deferred tail
+    (raises on a nonzero counter), and return ``(valid, host_cols)``."""
+    miss = deferred.miss_arrays()
+    fetched = batch.fetch_host(extra=miss)
+    if miss:
+        valid, host_cols, miss_vals = fetched
+    else:
+        valid, host_cols = fetched
+        miss_vals = []
+    deferred.finish(miss_vals)
+    return valid, host_cols
+
+
 class DryadContext:
     def __init__(
         self,
@@ -463,7 +478,7 @@ class DryadContext:
         self._binding_fp_cache[node.id] = fp
         return fp
 
-    def _execute_device(self, query: Query) -> ColumnBatch:
+    def _execute_device(self, query: Query, defer_miss: bool = False):
         graph = lower(
             [query.node], self.config, self.dictionary,
             P=num_partitions(self.mesh) if self.mesh is not None else None,
@@ -476,6 +491,12 @@ class DryadContext:
             binding_fps = {
                 nid: self._binding_fp(n) for nid, n in graph.inputs.items()
             }
+        if defer_miss:
+            results, deferred = self.executor.execute(
+                graph, bindings, binding_fps, defer_miss=True
+            )
+            sid, oidx = graph.outputs[query.node.id]
+            return results[(sid, oidx)], deferred
         results = self.executor.execute(graph, bindings, binding_fps)
         sid, oidx = graph.outputs[query.node.id]
         return results[(sid, oidx)]
@@ -486,8 +507,15 @@ class DryadContext:
 
             interp = LocalDebugInterpreter(self)
             return interp.run_to_logical(query.node)
-        batch = self._execute_device(query)
-        table = batch.to_numpy(query.schema, self.dictionary)
+        # The dict-miss counters ride the SAME device_get as the job
+        # outputs (one tunnel round-trip instead of two, BASELINE.md
+        # round-4); the deferred check still raises before any result
+        # reaches the caller.
+        batch, deferred = self._execute_device(query, defer_miss=True)
+        valid, host_cols = _fetch_with_miss(batch, deferred)
+        table = batch.to_numpy(
+            query.schema, self.dictionary, _host=(valid, host_cols)
+        )
         if self._codecs:
             from dryad_tpu.columnar.codecs import collapse_table
 
@@ -516,11 +544,12 @@ class DryadContext:
                 self.config.intermediate_compression,
             )
             return JobHandle(table, path)
-        batch = self._execute_device(query)
+        batch, deferred = self._execute_device(query, defer_miss=True)
         P = num_partitions(self.mesh)
         cap = batch.capacity // P
         parts = []
-        valid, host_cols = batch.fetch_host()  # overlapped d2h copies
+        # overlapped d2h copies; miss counters ride the same transfer
+        valid, host_cols = _fetch_with_miss(batch, deferred)
         for i in range(P):
             sl = slice(i * cap, (i + 1) * cap)
             m = valid[sl]
@@ -531,7 +560,12 @@ class DryadContext:
             path, parts, query.schema, self.dictionary,
             self.config.intermediate_compression,
         )
-        return JobHandle(batch.to_numpy(query.schema, self.dictionary), path)
+        return JobHandle(
+            batch.to_numpy(
+                query.schema, self.dictionary, _host=(valid, host_cols)
+            ),
+            path,
+        )
 
     # -- do_while support ----------------------------------------------------
     def _lower_loop_stage(self, plan_fn, schema: Schema, example: ColumnBatch):

@@ -200,27 +200,35 @@ class ColumnBatch:
         valid[:n] = True
         return ColumnBatch(data, jnp.asarray(valid))
 
-    def fetch_host(self) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
-        """(valid, columns) on the host, via ONE ``jax.device_get`` so
-        PJRT overlaps all the device->host copies (copy_to_host_async
-        then a single block).  A per-column ``np.asarray`` loop pays
-        one synchronous transfer round-trip per column, which dominates
-        egress through a high-latency link (BASELINE.md round-4:
-        ~70 ms/round-trip through the tunnel x 4-5 columns per rep)."""
-        import jax
-
+    def fetch_host(self, extra: Sequence[jax.Array] = ()):
+        """(valid, columns[, extras]) on the host, via ONE
+        ``jax.device_get`` so PJRT overlaps all the device->host copies
+        (copy_to_host_async then a single block).  A per-column
+        ``np.asarray`` loop pays one synchronous transfer round-trip
+        per column, which dominates egress through a high-latency link
+        (BASELINE.md round-4: ~70 ms/round-trip through the tunnel x
+        4-5 columns per rep).  ``extra`` arrays (e.g. deferred
+        dict-miss counters) ride the same transfer; when given, a third
+        list is returned."""
         assert "#valid" not in self.data, "'#valid' is a reserved name"
-        host = jax.device_get({"#valid": self.valid, **self.data})
+        host, extras = jax.device_get(
+            ({"#valid": self.valid, **self.data}, list(extra))
+        )
         valid = host.pop("#valid")
+        if extra:
+            return valid, host, extras
         return valid, host
 
     def to_numpy(
         self,
         schema: Schema,
         dictionary: Optional[StringDictionary] = None,
+        _host: Optional[Tuple[np.ndarray, Dict[str, np.ndarray]]] = None,
     ) -> Dict[str, np.ndarray]:
-        """Decode valid rows back to host logical columns."""
-        valid, host = self.fetch_host()
+        """Decode valid rows back to host logical columns.  ``_host``:
+        already-fetched ``(valid, columns)`` from :meth:`fetch_host`
+        (callers that batched the transfer with extra arrays)."""
+        valid, host = _host if _host is not None else self.fetch_host()
         out: Dict[str, np.ndarray] = {}
         for f in schema.fields:
             if f.ctype == ColumnType.STRING:

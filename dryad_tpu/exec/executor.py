@@ -54,6 +54,48 @@ def _stage_has_miss_guard(stage) -> bool:
     )
 
 
+class DeferredFinish:
+    """Tail of an ``execute(defer_miss=True)`` job: the dict-miss
+    counters whose readback the caller batches into its own
+    device->host transfer, plus the guarded checkpoint writes that
+    must not happen until those counters prove clean.
+
+    Contract: fetch ``miss_arrays()`` alongside the job outputs (one
+    ``device_get``), then call ``finish(host_vals)`` — it raises
+    ``StageFailedError`` on a nonzero counter (discarding the gated
+    checkpoints) and writes them otherwise.  ``finish()`` with no
+    argument falls back to its own readback."""
+
+    def __init__(self, executor, pending, ckpts):
+        self._executor = executor
+        self._pending = pending
+        self._ckpts = ckpts
+
+    def miss_arrays(self):
+        return [m for _, m in self._pending]
+
+    def finish(self, host_vals=None) -> None:
+        if host_vals is None:
+            host_vals = (
+                jax.device_get(self.miss_arrays()) if self._pending else []
+            )
+        if len(host_vals) != len(self._pending):
+            raise AssertionError(
+                f"DeferredFinish.finish: {len(host_vals)} host values for "
+                f"{len(self._pending)} pending miss counters — fetch "
+                "miss_arrays() alongside the outputs"
+            )
+        for (name, _), m in zip(self._pending, host_vals):
+            if int(m):
+                self._ckpts = []  # poisoned results: never persist
+                self._executor._raise_miss(name, int(m))
+        for stage, fp, outs in self._ckpts:
+            self._executor._write_checkpoint(stage, fp, outs)
+        self._ckpts = []
+        self._pending = []
+        self._executor.events.emit("job_complete")
+
+
 class StageFailedError(RuntimeError):
     pass
 
@@ -157,12 +199,20 @@ class GraphExecutor:
         graph: StageGraph,
         bindings: Dict[int, ColumnBatch],
         binding_fps: Optional[Dict[int, Optional[str]]] = None,
-    ) -> Dict[Tuple[int, int], ColumnBatch]:
+        defer_miss: bool = False,
+    ) -> Any:
         """Run all stages; returns (stage_id, out_idx) -> output batch.
 
         ``bindings``: plan-input node id -> mesh-sharded global batch.
         ``binding_fps``: plan-input node id -> content SHA-1 (or None if
         the binding can't be fingerprinted) for checkpoint identity.
+
+        ``defer_miss=True`` returns ``(results, DeferredFinish)``
+        instead: the dict-miss readback (and the checkpoint writes it
+        gates) are handed to the caller, who batches the counters into
+        its own device->host transfer and calls ``finish(host_vals)``
+        — saving one ~70 ms tunnel round-trip per job versus the
+        synchronous check (BASELINE.md).
         """
         self.events.emit("job_start", stages=len(graph.stages))
         results: Dict[Tuple[int, int], ColumnBatch] = {}
@@ -191,6 +241,15 @@ class GraphExecutor:
         finally:
             if not isinstance(profile, contextlib.nullcontext):
                 self._profiling = False
+        if defer_miss:
+            pending = self._pending_miss[mark:]
+            del self._pending_miss[mark:]
+            ckpts = self._pending_ckpt[mark_ckpt:]
+            del self._pending_ckpt[mark_ckpt:]
+            # job_complete is emitted by DeferredFinish.finish() once
+            # the miss counters prove clean — a miss-failed job must
+            # not be logged as completed (jobview counts on it).
+            return results, DeferredFinish(self, pending, ckpts)
         try:
             self._check_pending_miss(mark)
         except BaseException:
@@ -204,28 +263,33 @@ class GraphExecutor:
         self.events.emit("job_complete")
         return results
 
+    def _raise_miss(self, name: str, m: int) -> None:
+        self.events.emit("dict_miss", stage_name=name, rows=m)
+        raise StageFailedError(
+            f"stage {name!r}: {m} rows fall outside the dense "
+            "path's key domain (STRING values missing from the "
+            "context dictionary, or INT32 keys past their "
+            "ingest-time range — fabricated at run time?); the "
+            "dense kernel would drop them. Register/ingest the "
+            "values, or use group_by(salt=) to force the sort "
+            "path."
+        )
+
     def _check_pending_miss(self, mark: int = 0) -> None:
         """Drain deferred dictionary-miss counters added at or after
-        ``mark`` (one readback per string_code stage, after all
-        dispatches).  A nonzero count means rows carried STRING hash
-        words absent from the context dictionary — the dense kernel
-        dropped them, so fail loudly instead of returning a silently
-        wrong aggregate."""
+        ``mark`` (ONE batched readback for all guarded stages, after
+        all dispatches).  A nonzero count means rows carried STRING
+        hash words absent from the context dictionary — the dense
+        kernel dropped them, so fail loudly instead of returning a
+        silently wrong aggregate."""
         pending = self._pending_miss[mark:]
         del self._pending_miss[mark:]
-        for name, miss in pending:
-            m = int(miss)
-            if m:
-                self.events.emit("dict_miss", stage_name=name, rows=m)
-                raise StageFailedError(
-                    f"stage {name!r}: {m} rows fall outside the dense "
-                    "path's key domain (STRING values missing from the "
-                    "context dictionary, or INT32 keys past their "
-                    "ingest-time range — fabricated at run time?); the "
-                    "dense kernel would drop them. Register/ingest the "
-                    "values, or use group_by(salt=) to force the sort "
-                    "path."
-                )
+        if not pending:
+            return
+        vals = jax.device_get([m for _, m in pending])
+        for (name, _), m in zip(pending, vals):
+            if int(m):
+                self._raise_miss(name, int(m))
 
     def _execute_stages(self, graph, bindings, results, binding_fps, stage_fps):
         depth = max(1, self.config.overflow_sync_depth)
