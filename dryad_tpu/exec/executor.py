@@ -214,7 +214,23 @@ class GraphExecutor:
         — saving one ~70 ms tunnel round-trip per job versus the
         synchronous check (BASELINE.md).
         """
-        self.events.emit("job_start", stages=len(graph.stages))
+        # Topology rides the event log so jobview can redraw the DAG
+        # post-hoc — the reference JobBrowser reconstructs the graph
+        # from GM logs the same way (``JobBrowser/JOM/jobinfo.cs:62``).
+        topology = [
+            {
+                "id": s.id,
+                "name": s.name,
+                "deps": [
+                    ["in", idx] if ref == "plan_input" else [ref, idx]
+                    for ref, idx in s.input_refs
+                ],
+            }
+            for s in graph.stages
+        ]
+        self.events.emit(
+            "job_start", stages=len(graph.stages), topology=topology
+        )
         results: Dict[Tuple[int, int], ColumnBatch] = {}
         # do_while re-enters execute() through subquery_runner; only the
         # top-level call may own the profiler session.

@@ -50,6 +50,10 @@ class JobInfo:
     do_while_iters: int
     do_while_state_boost: int  # max loop-state capacity boost reached
     wall_seconds: float
+    # stage DAG from the job_start event ([{id, name, deps}]) — lets
+    # the report redraw the graph post-hoc, the way the reference
+    # JobBrowser rebuilds it from GM logs (JOM/jobinfo.cs:62)
+    topology: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
 
     @property
     def ok(self) -> bool:
@@ -94,6 +98,7 @@ def _fold_job(events: List[Dict[str, Any]]) -> JobInfo:
     started = completed = failed = False
     iters = 0
     state_boost = 0
+    topology: List[Dict[str, Any]] = []
     t0 = t1 = None
 
     def stage(ev) -> StageInfo:
@@ -111,6 +116,7 @@ def _fold_job(events: List[Dict[str, Any]]) -> JobInfo:
         if kind == "job_start":
             started = True
             declared = ev.get("stages", 0)
+            topology = ev.get("topology", topology)
         elif kind == "job_complete":
             completed = True
         elif kind == "job_failed":
@@ -143,7 +149,7 @@ def _fold_job(events: List[Dict[str, Any]]) -> JobInfo:
     wall = (t1 - t0) if (t0 is not None and t1 is not None) else 0.0
     return JobInfo(
         stages, declared, started, completed, failed, iters, state_boost,
-        wall,
+        wall, topology,
     )
 
 
@@ -348,9 +354,96 @@ def render_vertex_job(j: VertexJobInfo) -> str:
     return "\n".join(lines)
 
 
+def topology_svg(job: JobInfo) -> str:
+    """Self-contained SVG of the job's stage DAG, rebuilt from the
+    event log's job_start topology and colored by observed stage state
+    (green done, blue checkpoint-hit, red failed, grey not run) — the
+    JobBrowser drawing surface (``JobBrowser/Tools/drawingSurface.cs``)
+    over log data.  Empty string when the log predates topology
+    events."""
+    if not job.topology:
+        return ""
+    # layered layout: plan inputs on layer 0, each stage one past its
+    # deepest producer (same algorithm as tools/explain._layered_layout)
+    layer: Dict[str, int] = {}
+    for ent in job.topology:
+        deps = []
+        for ref, idx in ent["deps"]:
+            key = f"in{idx}" if ref == "in" else f"s{ref}"
+            if key.startswith("in"):
+                layer.setdefault(key, 0)
+            deps.append(layer.get(key, 0))
+        layer[f"s{ent['id']}"] = (max(deps) + 1) if deps else 1
+    cols: Dict[str, int] = {}
+    counts: Dict[int, int] = {}
+    for key, ly in layer.items():
+        cols[key] = counts.get(ly, 0)
+        counts[ly] = counts.get(ly, 0) + 1
+
+    BW, BH, GX, GY, PAD = 180, 40, 30, 56, 16
+    width = max(counts.values()) * (BW + GX) + PAD * 2
+    height = (max(layer.values()) + 1) * (BH + GY) + PAD * 2
+
+    def pos(key):
+        ly, c = layer[key], cols[key]
+        row_w = counts[ly] * BW + (counts[ly] - 1) * GX
+        x0 = (width - row_w) / 2 + c * (BW + GX)
+        return x0, PAD + ly * (BH + GY)
+
+    def esc(t: str) -> str:
+        return t.replace("&", "&amp;").replace("<", "&lt;").replace(">", "&gt;")
+
+    out = [
+        f'<svg xmlns="http://www.w3.org/2000/svg" width="{width:.0f}" '
+        f'height="{height:.0f}" font-family="monospace" font-size="11">',
+        '<defs><marker id="jv-arr" markerWidth="8" markerHeight="8" '
+        'refX="7" refY="4" orient="auto"><path d="M0,0 L8,4 L0,8 z" '
+        'fill="#555"/></marker></defs>',
+    ]
+    for ent in job.topology:  # edges first, under the boxes
+        x1, y1 = pos(f"s{ent['id']}")
+        for ref, idx in ent["deps"]:
+            key = f"in{idx}" if ref == "in" else f"s{ref}"
+            x0, y0 = pos(key)
+            out.append(
+                f'<line x1="{x0 + BW / 2:.0f}" y1="{y0 + BH:.0f}" '
+                f'x2="{x1 + BW / 2:.0f}" y2="{y1:.0f}" stroke="#555" '
+                'marker-end="url(#jv-arr)"/>'
+            )
+    for key, ly in layer.items():
+        x, y = pos(key)
+        if key.startswith("in"):
+            fill, label, sub = "#f4f6f6", f"input {key[2:]}", ""
+        else:
+            sid = int(key[1:])
+            s = job.stages.get(sid)
+            if s is None:
+                fill, sub = "#d5d8dc", "not run"
+            elif s.failures and not s.completed:
+                fill, sub = "#f5b7b1", f"{s.failures} fail"
+            elif s.from_checkpoint:
+                fill, sub = "#d6eaf8", "checkpoint"
+            elif s.completed:
+                fill, sub = "#d5f5e3", f"{s.seconds:.3f}s"
+            else:
+                fill, sub = "#fdebd0", "incomplete"
+            ent = next(e for e in job.topology if e["id"] == sid)
+            label = f"s{sid} {ent['name']}"
+        out.append(
+            f'<rect x="{x:.0f}" y="{y:.0f}" width="{BW}" height="{BH}" '
+            f'rx="6" fill="{fill}" stroke="#7f8c8d"/>'
+            f'<text x="{x + 8:.0f}" y="{y + 16:.0f}">{esc(label[:26])}</text>'
+            f'<text x="{x + 8:.0f}" y="{y + 31:.0f}" '
+            f'fill="#566573">{esc(sub)}</text>'
+        )
+    out.append("</svg>")
+    return "".join(out)
+
+
 def render_html(job: JobInfo) -> str:
-    """Standalone HTML report (the JobBrowser GUI analog): stage table
-    with duration bars, status badges, and the diagnosis list."""
+    """Standalone HTML report (the JobBrowser GUI analog): stage DAG
+    drawing, stage table with duration bars, status badges, and the
+    diagnosis list."""
     import html as H
 
     status = "FAILED" if job.failed else ("OK" if job.completed else "INCOMPLETE")
@@ -396,6 +489,7 @@ color:#fff;background:{color};font-weight:600}}
 <th>flags</th><th>state</th></tr>
 {"".join(rows)}
 </table>
+{f"<h2>Stage DAG</h2><div style='overflow-x:auto'>{topology_svg(job)}</div>" if job.topology else ""}
 <h2>Diagnosis</h2><ul>{diag}</ul>
 </body></html>"""
 

@@ -180,6 +180,12 @@ def test_jobview_html_report(tmp_path, rng):
     job = build_job(EventLog.load(logs[0]))
     html = render_html(job)
     assert "<html>" in html and "Diagnosis" in html and "OK" in html
+    # the stage DAG rebuilt from the logged topology (JobBrowser
+    # drawing-surface analog): every topology stage is drawn with its
+    # observed state
+    assert job.topology and "<svg" in html and "Stage DAG" in html
+    for ent in job.topology:
+        assert f"s{ent['id']} {ent['name']}"[:26] in html
 
     out = str(tmp_path / "report.html")
     assert main(["--html", out, logs[0]]) == 0
