@@ -583,9 +583,22 @@ class GraphExecutor:
                 )
                 if boost >= 2 ** self.config.max_shuffle_retries:
                     self.events.emit("job_failed", stage=stage.id, name=stage.name)
+                    # An expansion join that outgrows every boost is
+                    # usually a hot-key quadratic blowup — point at the
+                    # knob that actually bounds it.
+                    join_exp = any(
+                        "expansion" in op.params for op in stage.ops
+                    )
+                    hint = (
+                        "raise the join's expansion= argument (hot keys "
+                        "multiply pair counts quadratically), "
+                        "shuffle_slack, or partition count"
+                        if join_exp
+                        else "raise shuffle_slack or partition count"
+                    )
                     raise StageFailedError(
-                        f"stage {stage.name!r} still overflowing at boost {boost}; "
-                        f"raise shuffle_slack or partition count"
+                        f"stage {stage.name!r} still overflowing at "
+                        f"boost {boost}; {hint}"
                     )
                 boost *= 2
                 continue  # adaptive re-shape
