@@ -88,6 +88,29 @@ def main():
     log(f"device={d} platform={d.platform}")
     n = 1 << 22 if d.platform in ("tpu", "axon") else 1 << 16
 
+    if "--rsweep" in sys.argv:
+        # Count-only R-block sweep (BASELINE.md round-4 open question:
+        # count-only measured SLOWER than count+1float — suspect the
+        # VMEM-derived row block).  Each case re-imports nothing; the
+        # env must be set before tracing, which run_case guarantees by
+        # building a fresh jitted loop per case.
+        out = []
+        for r_force in (0, 7808, 5888, 3840, 2048, 1024):
+            name = f"count_R{r_force or 'auto'}"
+            if r_force:
+                os.environ["DRYAD_TPU_BUCKET_R"] = str(r_force)
+            else:
+                os.environ.pop("DRYAD_TPU_BUCKET_R", None)
+            try:
+                out.append(run_case(name, n, 4096, [], True, "matmul"))
+            except Exception as e:  # noqa: BLE001
+                log(f"{name} FAILED: {e}")
+        os.environ.pop("DRYAD_TPU_BUCKET_R", None)
+        log("--- rsweep summary ---")
+        for r in out:
+            log(f"{r['case']:>16}: {r['rows_per_sec']:.3e} rows/s")
+        return
+
     cases = [
         # flagship shape first so a mid-run tunnel death still decides;
         # strategy is EXPLICIT — off-TPU the default resolves to
