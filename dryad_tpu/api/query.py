@@ -827,6 +827,7 @@ class Query:
         strategy: str = "auto",
         selector: Optional[Callable[["Query"], "Query"]] = None,
         order: Optional[Sequence[OrderArg]] = None,
+        rank_limit: Optional[int] = None,
         lid_col: str = "gj_lid",
         rank_col: str = "gj_rank",
         suffix: str = "_r",
@@ -859,9 +860,22 @@ class Query:
           columns), ranks follow that value order within each group —
           deterministic under any partitioning.  Without it they
           follow the right side's engine order.
+
+          ``rank_limit=k`` bounds each group to its first k matches
+          BEFORE pair expansion, so hot keys stop multiplying pair
+          counts quadratically: top-k-per-key runs at ~k x left-rows
+          memory regardless of skew (a selector filtering
+          ``gj_rank < k`` sees identical pairs either way; matches
+          past rank k-1 are simply absent).  Without it, a key with m
+          left x m right occurrences expands m^2 pairs and a skewed
+          input can exceed every capacity boost.
         """
         lk = _keys(left_keys)
         rk = _keys(right_keys) if right_keys is not None else lk
+        if rank_limit is not None and selector is None:
+            raise ValueError(
+                "group_join: rank_limit only applies to the selector form"
+            )
         if selector is not None:
             if aggs:
                 raise ValueError("group_join: pass aggs OR selector, not both")
@@ -877,6 +891,7 @@ class Query:
             pairs = left2._ranked_join(
                 other, lk, rk, rank_out=rank_col, order=order,
                 expansion=expansion, suffix=suffix, strategy=strategy,
+                rank_limit=rank_limit,
             )
             sel = selector(pairs)
             if lid_col not in sel.schema.names:
@@ -916,10 +931,19 @@ class Query:
         expansion: float = 4.0,
         suffix: str = "_r",
         strategy: str = "auto",
+        rank_limit: Optional[int] = None,
     ) -> "Query":
         """Inner equi-join that also emits each pair's group-local match
-        rank (full GroupJoin's enumerable group)."""
+        rank (full GroupJoin's enumerable group).  ``rank_limit=k``
+        bounds each group to its first k matches before expansion —
+        see :meth:`group_join`."""
         _check_strategy(strategy)
+        if rank_limit is not None and (
+            isinstance(rank_limit, bool)
+            or not isinstance(rank_limit, int)
+            or rank_limit < 1
+        ):
+            raise ValueError(f"rank_limit must be a positive int, got {rank_limit!r}")
         self._require_cols(left_keys, "in group_join left keys")
         other._require_cols(right_keys, "in group_join right keys")
         ks = _order_keys(order) if order is not None else None
@@ -938,7 +962,7 @@ class Query:
             self._join_partition_info(left_keys, strategy),
             left_keys=left_keys, right_keys=right_keys, join_kind="ranked",
             rank_out=rank_out, order=ks, expansion=expansion, suffix=suffix,
-            strategy=strategy,
+            strategy=strategy, rank_limit=rank_limit,
         )
         return Query(self.ctx, node)
 

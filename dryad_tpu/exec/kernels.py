@@ -601,6 +601,11 @@ def _k_join_ranked(ctx: StageContext, p) -> None:
     out, ovf = J.hash_join_ranked(
         left, right, p["left_keys"], p["right_keys"], out_cap,
         p.get("suffix", "_r"), p["rank_out"], operands,
+        rank_limit=p.get("rank_limit"), boost=ctx.boost,
+        # At the retry ladder's last rung the window clamp drops away,
+        # so a hash-collision-into-a-hot-run row degrades to the
+        # unclamped expansion instead of failing the job.
+        final_attempt=ctx.boost >= p.get("rank_limit_max_boost", 1 << 30),
     )
     ctx.slots[p["left_slot"]] = out
     ctx.overflow = ctx.overflow | ovf

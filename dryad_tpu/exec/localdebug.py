@@ -350,8 +350,13 @@ class LocalDebugInterpreter:
         li, ri, ranks = [], [], []
         outer = kind == "left"
         defaults = node.params.get("right_defaults") or {}
+        # ranked joins with rank_limit=k enumerate only the first k
+        # matches per group — same contract as the device path
+        limit = node.params.get("rank_limit") if kind == "ranked" else None
         for i, k in enumerate(ltup):
             matches = index.get(k, ())
+            if limit is not None:
+                matches = matches[:limit]
             for r, j in enumerate(matches):
                 li.append(i)
                 ri.append(j)

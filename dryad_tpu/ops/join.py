@@ -13,7 +13,7 @@ is reported for executor retry, like the shuffle's padded buckets.
 
 from __future__ import annotations
 
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -135,6 +135,15 @@ def _exact_pair_match(
     return exact
 
 
+def _exact_per_left(li: jax.Array, exact: jax.Array, n: int) -> jax.Array:
+    """Per-left-row count of exact pairs (scatter-add over pair slots)."""
+    return (
+        jnp.zeros((n,), jnp.int32)
+        .at[li]
+        .add(exact.astype(jnp.int32), mode="drop")
+    )
+
+
 def hash_join_outer(
     left: ColumnBatch,
     right: ColumnBatch,
@@ -155,11 +164,7 @@ def hash_join_outer(
     exact = _exact_pair_match(left, rs, left_keys, right_keys, li, ri, pair_valid)
 
     # Per-left-row exact-match count -> unmatched mask for the tail.
-    matched = (
-        jnp.zeros((left.capacity,), jnp.int32)
-        .at[li]
-        .add(exact.astype(jnp.int32), mode="drop")
-    )
+    matched = _exact_per_left(li, exact, left.capacity)
     unmatched = left.valid & (matched == 0)
 
     rk = set(right_keys)
@@ -191,8 +196,7 @@ def group_join_counts(
     rs, _lhash, start, counts = _probe_ranges(left, right, left_keys, right_keys)
     li, ri, pair_valid, overflow, _ = _expand_pairs(start, counts, out_capacity)
     exact = _exact_pair_match(left, rs, left_keys, right_keys, li, ri, pair_valid)
-    n = left.capacity
-    cnt = jnp.zeros((n,), jnp.int32).at[li].add(exact.astype(jnp.int32), mode="drop")
+    cnt = _exact_per_left(li, exact, left.capacity)
     return cnt, overflow
 
 
@@ -205,6 +209,9 @@ def hash_join_ranked(
     suffix: str = "_r",
     rank_name: str = "gj_rank",
     order_operands: Sequence[jax.Array] = (),
+    rank_limit: Optional[int] = None,
+    boost: int = 1,
+    final_attempt: bool = False,
 ) -> Tuple[ColumnBatch, jax.Array]:
     """Inner equi-join that also emits each pair's group-local rank —
     the position of the matching right row within its left row's match
@@ -218,12 +225,32 @@ def hash_join_ranked(
     right batch, e.g. from ``plan.keys.ordering_operands``), ranks
     follow that value order within each group — deterministic across
     partitionings.  Without, ranks follow the right side's engine order.
+
+    ``rank_limit=k`` bounds the enumerable group to its first k
+    matches (pairs with rank >= k are dropped BEFORE expansion, so a
+    hot key's pair count stops growing quadratically): each left row
+    expands only its first ``k * boost`` hash-candidates.  Candidates
+    in that window that fail the exact-key check are collisions; when
+    a clamped row yields fewer than k exact matches, the overflow flag
+    requests a retry (the caller re-runs at doubled ``boost``, widening
+    the window until the collisions are covered).  Rows whose full
+    candidate range fits inside the window never retry.
+
+    ``final_attempt=True`` (the caller's LAST boost level) drops the
+    window clamp entirely: a pathological row — its key hash-colliding
+    into a huge run it can never cover geometrically — degrades to the
+    unclamped expansion (exactly the no-rank_limit cost) instead of
+    failing a query that would succeed without ``rank_limit``.  The
+    rank < k output contract is unconditional either way.
     """
     if len(order_operands):
         right = sort_batch_by_operands(right, order_operands)
     # _probe_ranges' hash sort is stable (sort_carry, is_stable=True),
     # so the operand order survives within each equal-hash run.
     rs, lhash, start, counts = _probe_ranges(left, right, left_keys, right_keys)
+    full_counts = counts
+    if rank_limit is not None and not final_attempt:
+        counts = jnp.minimum(counts, jnp.int32(rank_limit * boost))
     li, ri, pair_valid, overflow, offsets = _expand_pairs(
         start, counts, out_capacity
     )
@@ -240,6 +267,23 @@ def hash_join_ranked(
         seg > 0, cs[jnp.clip(seg - 1, 0, out_capacity - 1)], 0
     )
     rank = jnp.where(exact, cs - 1 - before, 0).astype(jnp.int32)
+
+    if rank_limit is not None:
+        if not final_attempt:
+            # A clamped row (candidates beyond the window exist) that
+            # found fewer than rank_limit exact matches may be missing
+            # matches hiding behind collisions — retry with a wider
+            # window.
+            exact_cnt = _exact_per_left(li, exact, full_counts.shape[0])
+            short = (
+                left.valid
+                & (full_counts > counts)
+                & (exact_cnt < jnp.int32(rank_limit))
+            )
+            overflow = overflow | jnp.any(short)
+        # The contract is EXACTLY the rank < k subset, independent of
+        # the boost-widened window.
+        exact = exact & (rank < jnp.int32(rank_limit))
 
     data: Dict[str, jax.Array] = {}
     for name, col in left.data.items():

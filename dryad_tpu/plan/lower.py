@@ -839,6 +839,8 @@ class _Builder:
                         operands_fn=operands_fn,
                         expansion=node.params.get("expansion", 1.0),
                         suffix=node.params.get("suffix", "_r"),
+                        rank_limit=node.params.get("rank_limit"),
+                        rank_limit_max_boost=2 ** self.config.max_shuffle_retries,
                         **strat_params,
                     ),
                 )

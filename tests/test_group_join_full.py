@@ -293,3 +293,67 @@ def test_selector_string_keys(ctx, dbg):
             by_w[w], right["score"][mask].max(), rtol=1e-5
         )
     assert sorted(got["tag"].tolist()) == [0, 1, 2, 3]
+
+
+def test_rank_limit_bounds_hot_key_expansion(ctx, dbg):
+    """rank_limit=k caps each group's enumerable matches BEFORE pair
+    expansion, so a hot key (80% of both sides on 3 values — the shape
+    whose unbounded m^2 pair count exceeds every capacity boost) runs
+    top-k-per-key at ~k x left-rows memory.  Differential against the
+    oracle applying the same first-k contract."""
+    rng = np.random.default_rng(724)
+    n = 4000
+    hot = rng.integers(0, 3, n)
+    cold = rng.integers(0, 5000, n)
+    k = np.where(rng.random(n) < 0.8, hot, cold).astype(np.int32)
+    left = {"k": k, "lv": np.arange(n, dtype=np.int32)}
+    right = {
+        "k": k[rng.permutation(n)],
+        "score": rng.integers(0, 100000, n).astype(np.int32),
+    }
+
+    def q(c):
+        return (
+            c.from_arrays(left)
+            .group_join(
+                c.from_arrays(right), "k",
+                order=[("score", "desc")],
+                rank_limit=2,
+                selector=lambda p: p.group_by(
+                    "gj_lid", {"top2": ("sum", "score"),
+                               "m": ("count", None)}
+                ),
+                defaults={"top2": 0, "m": 0},
+            )
+            .collect()
+        )
+
+    got = q(ctx)
+    check(got, q(dbg))
+    # the hot keys really did have quadratic match counts available...
+    import collections
+
+    rmap = collections.defaultdict(list)
+    for kk, s in zip(right["k"].tolist(), right["score"].tolist()):
+        rmap[kk].append(s)
+    assert max(len(v) for v in rmap.values()) > 500
+    # ...yet each left row saw exactly min(2, matches) of them, the
+    # top-2 by score
+    by_lv = dict(zip(got["lv"].tolist(), zip(got["m"].tolist(),
+                                             got["top2"].tolist())))
+    for kk, lv in zip(left["k"].tolist(), left["lv"].tolist()):
+        us = sorted(rmap.get(kk, []), reverse=True)
+        m, s = by_lv[lv]
+        assert m == min(2, len(us))
+        assert s == sum(us[:2])
+
+
+def test_rank_limit_requires_selector(ctx):
+    q = ctx.from_arrays({"k": np.arange(4, dtype=np.int32)})
+    r = ctx.from_arrays({"k": np.arange(4, dtype=np.int32),
+                         "v": np.arange(4, dtype=np.int32)})
+    with pytest.raises(ValueError, match="rank_limit"):
+        q.group_join(r, "k", rank_limit=3)
+    with pytest.raises(ValueError, match="rank_limit"):
+        q.group_join(r, "k", rank_limit=0,
+                     selector=lambda p: p.group_by("gj_lid", {"n": ("count", None)}))
