@@ -126,6 +126,22 @@ _STEPS = {
         ).select(lambda c: {"k": c["k"], "g": c["g"],
                             "v": c["v"] + c["t3"] + c["c3"]})
     ),
+    "gj_topk": (  # same top-3 idiom via rank_limit: pair expansion is
+        # bounded at 3 x left rows BEFORE materialization, so the
+        # DEFAULT expansion budget suffices even for the n^2/9
+        # self-join (and for hot-key skew) — the round-4 bounded
+        # GroupJoin contract
+        lambda q: q.project(["k", "g", "v"]).group_join(
+            q.project(["k", "v"]), "k",
+            order=[("v", False)],
+            rank_limit=3,
+            selector=lambda p: p.group_by(
+                "gj_lid", {"t3": ("sum", "v_r"), "c3": ("count", None)}
+            ),
+            defaults={"t3": 0.0, "c3": 0},
+        ).select(lambda c: {"k": c["k"], "g": c["g"],
+                            "v": c["v"] + c["t3"] + c["c3"]})
+    ),
 }
 
 # steps needing columns (w, d, s) that schema-rebuilding steps drop
@@ -148,13 +164,13 @@ def _build_pipeline(rng, depth):
         name = names[int(rng.integers(0, len(names)))]
         if name in _WIDE_STEPS and not wide_ok:
             continue
-        if name in ("group_by", "gj_selector") or name in _TERMINAL:
+        if name in ("group_by", "gj_selector", "gj_topk") or name in _TERMINAL:
             if n_groups >= _MAX_GROUPS:
                 continue
             n_groups += 1
         # select/group/project steps rebuild the schema without w/d
         if name in ("group_by", "select_double", "select_shift",
-                    "order_take", "gj_selector", "left_join"):
+                    "order_take", "gj_selector", "gj_topk", "left_join"):
             wide_ok = False
         steps.append(name)
         if name in _TERMINAL:
