@@ -82,12 +82,11 @@ def _fetch_with_miss(batch, deferred):
     counters riding the same ``device_get``, resolve the deferred tail
     (raises on a nonzero counter), and return ``(valid, host_cols)``."""
     miss = deferred.miss_arrays()
-    fetched = batch.fetch_host(extra=miss)
-    if miss:
-        valid, host_cols, miss_vals = fetched
-    else:
-        valid, host_cols = fetched
-        miss_vals = []
+    try:
+        valid, host_cols, miss_vals = batch.fetch_host(extra=miss)
+    except Exception as e:  # tunnel/transfer failure: close out the job
+        deferred.abort(f"output transfer failed: {e!r}")
+        raise
     deferred.finish(miss_vals)
     return valid, host_cols
 

@@ -20,6 +20,7 @@ to_store/submit/collect.
 
 from __future__ import annotations
 
+import operator
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
@@ -938,12 +939,19 @@ class Query:
         bounds each group to its first k matches before expansion —
         see :meth:`group_join`."""
         _check_strategy(strategy)
-        if rank_limit is not None and (
-            isinstance(rank_limit, bool)
-            or not isinstance(rank_limit, int)
-            or rank_limit < 1
-        ):
-            raise ValueError(f"rank_limit must be a positive int, got {rank_limit!r}")
+        if rank_limit is not None:
+            try:  # accept any integral type (np.int32 etc.), reject bool
+                if isinstance(rank_limit, (bool, np.bool_)):
+                    raise TypeError
+                rank_limit = operator.index(rank_limit)
+            except TypeError:
+                raise ValueError(
+                    f"rank_limit must be a positive int, got {rank_limit!r}"
+                ) from None
+            if rank_limit < 1:
+                raise ValueError(
+                    f"rank_limit must be a positive int, got {rank_limit!r}"
+                )
         self._require_cols(left_keys, "in group_join left keys")
         other._require_cols(right_keys, "in group_join right keys")
         ks = _order_keys(order) if order is not None else None

@@ -201,23 +201,21 @@ class ColumnBatch:
         return ColumnBatch(data, jnp.asarray(valid))
 
     def fetch_host(self, extra: Sequence[jax.Array] = ()):
-        """(valid, columns[, extras]) on the host, via ONE
+        """(valid, columns, extras) on the host, via ONE
         ``jax.device_get`` so PJRT overlaps all the device->host copies
         (copy_to_host_async then a single block).  A per-column
         ``np.asarray`` loop pays one synchronous transfer round-trip
         per column, which dominates egress through a high-latency link
         (BASELINE.md round-4: ~70 ms/round-trip through the tunnel x
         4-5 columns per rep).  ``extra`` arrays (e.g. deferred
-        dict-miss counters) ride the same transfer; when given, a third
-        list is returned."""
+        dict-miss counters) ride the same transfer; ``extras`` is empty
+        when none were passed."""
         assert "#valid" not in self.data, "'#valid' is a reserved name"
         host, extras = jax.device_get(
             ({"#valid": self.valid, **self.data}, list(extra))
         )
         valid = host.pop("#valid")
-        if extra:
-            return valid, host, extras
-        return valid, host
+        return valid, host, extras
 
     def to_numpy(
         self,
@@ -228,7 +226,7 @@ class ColumnBatch:
         """Decode valid rows back to host logical columns.  ``_host``:
         already-fetched ``(valid, columns)`` from :meth:`fetch_host`
         (callers that batched the transfer with extra arrays)."""
-        valid, host = _host if _host is not None else self.fetch_host()
+        valid, host = _host if _host is not None else self.fetch_host()[:2]
         out: Dict[str, np.ndarray] = {}
         for f in schema.fields:
             if f.ctype == ColumnType.STRING:

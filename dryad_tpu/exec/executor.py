@@ -74,6 +74,15 @@ class DeferredFinish:
     def miss_arrays(self):
         return [m for _, m in self._pending]
 
+    def abort(self, reason: str) -> None:
+        """Terminal path for a failed output transfer: drop the gated
+        checkpoint writes (never persist unproven results) and emit
+        ``job_failed`` so the event log distinguishes a transfer
+        failure from a job that simply hung (ADVICE r4)."""
+        self._ckpts = []
+        self._pending = []
+        self._executor.events.emit("job_failed", reason=reason)
+
     def finish(self, host_vals=None) -> None:
         if host_vals is None:
             host_vals = (
@@ -88,6 +97,9 @@ class DeferredFinish:
         for (name, _), m in zip(self._pending, host_vals):
             if int(m):
                 self._ckpts = []  # poisoned results: never persist
+                self._executor.events.emit(
+                    "job_failed", reason=f"dict miss in {name}"
+                )
                 self._executor._raise_miss(name, int(m))
         for stage, fp, outs in self._ckpts:
             self._executor._write_checkpoint(stage, fp, outs)
@@ -750,7 +762,7 @@ class GraphExecutor:
                 for n, v in b.data.items()
             }
         else:
-            valid, host_cols = b.fetch_host()  # overlapped d2h copies
+            valid, host_cols, _ = b.fetch_host()  # overlapped d2h copies
         schema = p["schema"]
         phys = schema.device_names()
         expected = {n: _phys_np_dtype(n, schema) for n in phys}

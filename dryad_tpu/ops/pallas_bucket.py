@@ -113,9 +113,16 @@ def _row_block(a_pad: int, n_vals: int, total_planes: int) -> Optional[int]:
     r = min(8192, (r // 128) * 128)
     # Experiment hatch: force the row block (rounded to 128, clamped to
     # the VMEM-derived value) — for on-chip R sweeps (sweep_bucket.py).
+    # Read at trace time: a changed value only affects shapes not yet in
+    # the stage compile cache (sweep_bucket uses a fresh jit per case).
     forced = os.environ.get("DRYAD_TPU_BUCKET_R")
     if forced:
-        r = min(r, max(128, (int(forced) // 128) * 128))
+        try:
+            forced_r = int(forced)
+        except ValueError:
+            forced_r = 0  # non-numeric: ignore the hatch
+        if forced_r > 0:
+            r = min(r, max(128, (forced_r // 128) * 128))
     return r
 
 

@@ -262,3 +262,48 @@ def test_sliding_window_wider_than_partition(ctx, dbg):
         tuple(range(i, i + 12)) for i in range(13)
     ]
     check(q(ctx), q(dbg))
+
+
+def test_rank_limit_accepts_numpy_integers(ctx, dbg):
+    """ADVICE r4: np.int32(2) is a valid positive rank_limit."""
+    left = {"k": np.array([1, 1, 2], dtype=np.int32)}
+    right = {"k": np.array([1, 1, 1, 2], dtype=np.int32),
+             "v": np.arange(4, dtype=np.int32)}
+    sel = lambda p: p.where(lambda c: c["gj_rank"] < 2).group_by(
+        "gj_lid", {"s": ("sum", "v")})
+
+    def q(c):
+        return (
+            c.from_arrays(left)
+            .group_join(c.from_arrays(right), ["k"], ["k"],
+                        selector=sel, order=["v"],
+                        rank_limit=np.int32(2))
+            .collect()
+        )
+
+    check(q(ctx), q(dbg))
+    for bad in (np.int32(0), True, np.True_):
+        c2 = DryadContext(num_partitions_=8)
+        with pytest.raises(ValueError):
+            c2.from_arrays(left).group_join(
+                c2.from_arrays(right), ["k"], ["k"], selector=sel,
+                rank_limit=bad)
+
+
+def test_deferred_abort_emits_job_failed(ctx, monkeypatch):
+    """ADVICE r4: a failed output transfer must close out the job in the
+    event log (job_failed) instead of leaving it dangling."""
+    from dryad_tpu.columnar.batch import ColumnBatch
+
+    q = ctx.from_arrays({"x": np.arange(16, dtype=np.int32)}).select(
+        lambda cols: {"x": cols["x"] + 1}
+    )
+
+    def boom(self, extra=()):
+        raise RuntimeError("tunnel died")
+
+    monkeypatch.setattr(ColumnBatch, "fetch_host", boom)
+    with pytest.raises(RuntimeError, match="tunnel died"):
+        q.collect()
+    kinds = [e["kind"] for e in ctx.executor.events.events()]
+    assert "job_failed" in kinds
