@@ -37,6 +37,10 @@ import numpy as np
 
 T_START = time.monotonic()
 BUDGET = float(os.environ.get("DRYAD_BENCH_BUDGET", "480"))
+# Set once the backend is probed; stamped into every metric record so
+# a supervised multi-attempt artifact is honest about WHERE each
+# number ran (round-4 weakness: platform ambiguity in mixed runs).
+_PLATFORM: str = "unprobed"
 
 SUMMARY: dict = {
     "metric": "group_reduce_rows_per_sec",
@@ -91,6 +95,7 @@ def rep_record(name: str, rows: int, times, extra: dict = {}) -> dict:
         "spread": round(spread, 2),
         "contended": spread > 5.0,
         "rows": rows,
+        "platform": _PLATFORM,
     }
     rec.update(extra)
     return rec
@@ -422,6 +427,96 @@ def terasort_device_metric(n: int):
     )
 
 
+def ooc_sort_metric(n: int, chunk_rows: int = 1 << 21):
+    """Out-of-core TeraSort at >= 16x the single-batch device capacity:
+    chunked ingest -> range-bucket spill -> per-bucket device sort
+    (exec.outofcore external distribution sort).  HBM held to one
+    chunk/bucket at a time; the reference's streaming channel stack
+    handles the same scale via bounded buffers
+    (``channelbuffernativereader.cpp``)."""
+    from dryad_tpu import DryadConfig, DryadContext
+
+    rng = np.random.default_rng(3)
+    nchunks = max(1, n // chunk_rows)
+    chunks = [
+        {"key": rng.integers(-(2 ** 31), 2 ** 31 - 1, chunk_rows).astype(
+            np.int32)}
+        for _ in range(nchunks)
+    ]
+    total = nchunks * chunk_rows
+    bucket_rows = max(chunk_rows, 1 << 20)
+    cfg = DryadConfig(
+        stream_bucket_rows=bucket_rows * 2,
+        stream_buckets=max(8, 2 * total // bucket_rows),
+    )
+    ctx = DryadContext(config=cfg)
+
+    def run():
+        q = ctx.from_stream(
+            iter([{k: v for k, v in c.items()} for c in chunks])
+        ).order_by(["key"])
+        out = q.collect()
+        assert len(out["key"]) == total
+        assert (np.diff(out["key"]) >= 0).all()
+
+    t0 = time.perf_counter()
+    run()
+    t = time.perf_counter() - t0
+    return rep_record(
+        "oocsort_rows_per_sec", total, [t],
+        {"chunks": nchunks, "chunk_rows": chunk_rows,
+         "bounded_hbm_rows": max(chunk_rows, 2 * bucket_rows),
+         "capacity_multiple": nchunks},
+    )
+
+
+def ooc_wordcount_metric(n_words: int, vocab: int = 1 << 14):
+    """Out-of-core WordCount: a corpus file streamed in byte chunks
+    through the native tokenizer, per-chunk partial group_by, running
+    device combine (exec.outofcore partial path)."""
+    import tempfile
+
+    from dryad_tpu import DryadConfig, DryadContext
+
+    rng = np.random.default_rng(4)
+    words = np.array([f"w{i:05d}" for i in range(vocab)])
+    parts = []
+    left = n_words
+    while left > 0:
+        take = min(left, 1 << 20)
+        parts.append(" ".join(rng.choice(words, take).tolist()))
+        left -= take
+    corpus = " ".join(parts)
+    with tempfile.NamedTemporaryFile(
+        "w", suffix=".txt", delete=False
+    ) as fh:
+        fh.write(corpus)
+        path = fh.name
+    nbytes = len(corpus)
+    del corpus, parts
+    ctx = DryadContext(config=DryadConfig())
+
+    def run():
+        out = (
+            ctx.text_stream(path, chunk_bytes=1 << 24)
+            .group_by("word", {"c": ("count", None)})
+            .collect()
+        )
+        assert int(np.asarray(out["c"]).sum()) == n_words
+
+    try:
+        t0 = time.perf_counter()
+        run()
+        t = time.perf_counter() - t0
+    finally:
+        os.unlink(path)
+    return rep_record(
+        "oocwordcount_rows_per_sec", n_words, [t],
+        {"corpus_bytes": nbytes, "vocab": vocab,
+         "chunk_bytes": 1 << 24},
+    )
+
+
 # Analytic single-chip ceilings (BASELINE.md "round-4 pass-count
 # analysis", v5e): the factorized one-hot kernel's per-PASS ceiling is
 # ~7.5e9 rows/s (contraction rate; NOT the old 4.8e10, which assumed
@@ -539,16 +634,26 @@ def run_tests_tpu() -> dict:
 
 # -- main ------------------------------------------------------------------
 
-def main() -> None:
+def child_main() -> None:
     import traceback
 
+    SUMMARY["_child_summary"] = True
     baseline = None
-    try:
-        baseline = host_baseline_rows_per_sec()
-        log(f"host baseline: {baseline:.3e} rows/s")
-    except Exception as e:  # noqa: BLE001
-        traceback.print_exc(file=sys.stderr)
-        emit({"metric": "host_baseline_rows_per_sec", "error": str(e)})
+    env_base = os.environ.get("DRYAD_BENCH_BASELINE")
+    if env_base:
+        baseline = float(env_base)
+        log(f"host baseline (from supervisor): {baseline:.3e} rows/s")
+        emit({"metric": "host_baseline_rows_per_sec", "value": baseline,
+              "unit": "rows/s", "reused": True})
+    else:
+        try:
+            baseline = host_baseline_rows_per_sec()
+            log(f"host baseline: {baseline:.3e} rows/s")
+            emit({"metric": "host_baseline_rows_per_sec",
+                  "value": baseline, "unit": "rows/s"})
+        except Exception as e:  # noqa: BLE001
+            traceback.print_exc(file=sys.stderr)
+            emit({"metric": "host_baseline_rows_per_sec", "error": str(e)})
 
     try:
         import subprocess
@@ -566,6 +671,8 @@ def main() -> None:
     try:
         platform = init_backend()
         SUMMARY["platform"] = platform
+        global _PLATFORM
+        _PLATFORM = platform
     except Exception as e:  # noqa: BLE001
         traceback.print_exc(file=sys.stderr)
         SUMMARY["error"] = f"{type(e).__name__}: {e}"
@@ -573,6 +680,12 @@ def main() -> None:
         return
 
     accel = platform != "cpu"
+    done_key = (
+        "DRYAD_BENCH_DONE_TPU" if accel else "DRYAD_BENCH_DONE_CPU"
+    )
+    done = set(json.loads(os.environ.get(done_key, "[]")))
+    if done:
+        log(f"supervisor resume: skipping {sorted(done)}")
 
     # A hung XLA compile through a degraded tunnel is not interruptible
     # from Python, so budget checks between metrics cannot bound the
@@ -639,6 +752,15 @@ def main() -> None:
         ("terasort_rows_per_sec",
          lambda: terasort_metric(1 << 21 if accel else 1 << 16),
          80 if accel else 15, False),
+        # out-of-core: >=16x single-batch capacity in bounded HBM
+        ("oocsort_rows_per_sec",
+         lambda: ooc_sort_metric(
+             1 << 26 if accel else 1 << 21,
+             chunk_rows=1 << 22 if accel else 1 << 17),
+         240 if accel else 60, False),
+        ("oocwordcount_rows_per_sec",
+         lambda: ooc_wordcount_metric(1 << 24 if accel else 1 << 19),
+         200 if accel else 45, False),
     ]
     if platform in ("tpu", "axon"):
         # The Pallas kernel only truly runs on TPU; elsewhere the number
@@ -651,9 +773,11 @@ def main() -> None:
         ))
 
     for name, fn, est, is_core in plan:
+        if name in done:
+            continue
         if remaining() < est:
             log(f"skipping {name}: {remaining():.0f}s left < {est}s estimate")
-            emit({"metric": name, "skipped": True,
+            emit({"metric": name, "skipped": True, "platform": platform,
                   "reason": f"budget: {remaining():.0f}s left, need ~{est}s"})
             continue
         try:
@@ -680,7 +804,8 @@ def main() -> None:
                 f"(spread {rec['spread']}x{', CONTENDED' if rec['contended'] else ''})")
         except Exception as e:  # noqa: BLE001
             traceback.print_exc(file=sys.stderr)
-            emit({"metric": name, "error": f"{type(e).__name__}: {e}"})
+            emit({"metric": name, "error": f"{type(e).__name__}: {e}",
+                  "platform": platform})
 
     if platform in ("tpu", "axon") and remaining() > 90:
         # chip-gated test suite, recorded in the SAME artifact
@@ -694,6 +819,153 @@ def main() -> None:
 
     print(json.dumps(SUMMARY), flush=True)
     sys.exit(0)
+
+
+def supervise() -> None:
+    """Tunnel-flap-resilient driver: run the bench as child processes,
+    resume per metric across attempts, and re-probe for the chip after
+    every child death (round-4 weakness #1: a tunnel dying mid-bench
+    condemned the whole artifact to CPU).
+
+    A child whose backend probe fails falls back to CPU and lands CPU
+    numbers; a later attempt that reaches the chip re-runs the chip
+    set.  Per-platform done-sets keep each metric at most one success
+    per platform; a metric that errors twice on a platform is dropped.
+    The merged SUMMARY prefers chip values and records where every
+    number ran."""
+    import subprocess
+    import threading
+
+    done: dict = {"cpu": set(), "tpu": set()}
+    errs: dict = {}
+    merged_cpu: dict = {}
+    merged_tpu: dict = {}
+    platforms: list = []
+    baseline_val = None
+    attempt = 0
+    progress = True
+    while remaining() > 60 and attempt < 8:
+        attempt += 1
+        if not progress and attempt > 2:
+            # nothing new landed last attempt and nothing is left to
+            # retry: pause so a tunnel flap has time to resolve, but
+            # only if budget allows a meaningful wait
+            if remaining() < 240:
+                break
+            log("supervisor: no progress; waiting 120s for the tunnel")
+            time.sleep(120.0)
+        env = dict(os.environ)
+        env["DRYAD_BENCH_CHILD"] = "1"
+        env["DRYAD_BENCH_DONE_CPU"] = json.dumps(sorted(done["cpu"]))
+        env["DRYAD_BENCH_DONE_TPU"] = json.dumps(sorted(done["tpu"]))
+        env["DRYAD_BENCH_BUDGET"] = str(max(60.0, remaining() - 30.0))
+        # keep probe retries bounded per child so a down tunnel yields
+        # a CPU artifact early; the supervisor owns the long wait
+        env.setdefault("DRYAD_BENCH_PROBE_WINDOW", "150")
+        if baseline_val is not None:
+            env["DRYAD_BENCH_BASELINE"] = str(baseline_val)
+        log(f"supervisor attempt {attempt} "
+            f"(done cpu={len(done['cpu'])} tpu={len(done['tpu'])}, "
+            f"{remaining():.0f}s left)")
+        p = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)],
+            stdout=subprocess.PIPE, text=True, env=env, bufsize=1,
+        )
+        hard_kill = threading.Timer(
+            max(120.0, remaining() + 90.0), p.kill
+        )
+        hard_kill.start()
+        child_summary = None
+        new_this_attempt = 0
+        try:
+            for line in p.stdout:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    print(line, flush=True)
+                    continue
+                if rec.get("_child_summary"):
+                    child_summary = rec
+                    continue
+                name = rec.get("metric")
+                print(json.dumps(rec), flush=True)  # incremental relay
+                if not name:
+                    continue
+                if name == "host_baseline_rows_per_sec":
+                    if "value" in rec:
+                        baseline_val = rec["value"]
+                    continue
+                # unknown platform (init failure, pre-probe record) is
+                # NOT a chip result — classifying it as tpu would end
+                # supervision early and mislabel the artifact
+                rp = rec.get("platform")
+                plat = "tpu" if rp not in (None, "cpu", "unprobed") else "cpu"
+                if "value" in rec:
+                    if name not in done[plat]:
+                        new_this_attempt += 1
+                    done[plat].add(name)
+                elif "error" in rec:
+                    errs[(plat, name)] = errs.get((plat, name), 0) + 1
+                    if errs[(plat, name)] >= 2:
+                        done[plat].add(name)  # give up on it there
+                elif rec.get("skipped"):
+                    done[plat].add(name)  # budget skip: no retry value
+        finally:
+            p.wait()
+            hard_kill.cancel()
+        if child_summary is not None:
+            cplat = child_summary.get("platform")
+            platforms.append(cplat)
+            tgt = (
+                merged_tpu
+                if cplat not in (None, "cpu", "unprobed")
+                else merged_cpu
+            )
+            if new_this_attempt > 0 or not tgt:
+                incoming = {
+                    k: v for k, v in child_summary.items()
+                    if not k.startswith("_")
+                }
+                if tgt.get("value") and not incoming.get("value"):
+                    # a resumed child that re-ran nothing must not
+                    # clobber the landed core metric with its default
+                    for k in ("value", "vs_baseline", "contended",
+                              "reps_s", "roofline_fraction"):
+                        incoming.pop(k, None)
+                if "error" in tgt and "error" not in incoming and \
+                        incoming.get("value"):
+                    tgt.pop("error")  # a later clean run supersedes it
+                tgt.update(incoming)
+        progress = new_this_attempt > 0
+        if merged_tpu and child_summary is not None and p.returncode == 0 \
+                and not child_summary.get("watchdog_exit") \
+                and child_summary.get("platform") not in (
+                    None, "cpu", "unprobed") \
+                and "error" not in child_summary:
+            break  # a chip attempt ran to natural completion
+
+    final = dict(merged_cpu)
+    final.update(merged_tpu)  # chip values win
+    if merged_tpu:
+        final["platform"] = merged_tpu.get("platform", "tpu")
+        final.pop("tunnel_down", None)
+    final["platforms"] = platforms
+    final["attempts"] = attempt
+    if "metric" not in final:
+        final.update(SUMMARY)
+        final["error"] = "no child produced a summary"
+    print(json.dumps(final), flush=True)
+    sys.exit(0)
+
+
+def main() -> None:
+    if os.environ.get("DRYAD_BENCH_CHILD"):
+        child_main()
+    else:
+        supervise()
 
 
 if __name__ == "__main__":

@@ -50,6 +50,7 @@ from dryad_tpu.cluster.scheduler import LocalScheduler
 from dryad_tpu.cluster.service import ProcessService, ServiceClient
 from dryad_tpu.columnar.io import parse_partition_bytes
 from dryad_tpu.columnar.schema import StringDictionary
+from dryad_tpu.exec import partial as _partial
 from dryad_tpu.exec.events import EventLog
 from dryad_tpu.exec.jobpackage import pack_query
 from dryad_tpu.exec.stats import StageStatistics
@@ -819,28 +820,14 @@ class LocalJobSubmission:
             )
         return table
 
-    # mergeable builtin aggregates for the partial-vertex rewrite.
-    # "first" merges correctly because _assemble concatenates partition
-    # results in part-id order (= engine order), so the first partial
-    # occurrence of a key IS the engine-order first.
-    _MERGEABLE_AGGS = frozenset(
-        {"sum", "count", "min", "max", "mean", "any", "all", "first"}
-    )
+    # mergeable builtin aggregates for the partial-vertex rewrite
+    # (shared with the streaming executor; "first" merges correctly
+    # because _assemble concatenates partition results in part-id order
+    # = engine order, so the first partial occurrence of a key IS the
+    # engine-order first).
+    _MERGEABLE_AGGS = _partial.MERGEABLE_AGGS
 
-    @staticmethod
-    def _partial_plan(agg_list):
-        """Decompose builtin aggs into per-vertex partial specs plus
-        the driver merge plan (out_name, op, partial_col_names)."""
-        partial, plan = {}, []
-        for op, col, out in agg_list:
-            if op == "mean":
-                partial[f"{out}__ps"] = ("sum", col)
-                partial[f"{out}__pc"] = ("count", None)
-                plan.append((out, "mean", (f"{out}__ps", f"{out}__pc")))
-            else:
-                partial[f"{out}__p"] = (op, col)
-                plan.append((out, op, (f"{out}__p",)))
-        return partial, plan
+    _partial_plan = staticmethod(_partial.partial_plan)
 
     def _rewrite_partial_group(self, query):
         """Split a terminal builtin-agg group_by / scalar aggregate into

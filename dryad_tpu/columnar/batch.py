@@ -29,6 +29,40 @@ from dryad_tpu.columnar.schema import (
 )
 
 
+def encode_physical(
+    field, a: np.ndarray, dictionary: Optional[StringDictionary]
+) -> Dict[str, np.ndarray]:
+    """One logical host column -> its physical device/store columns
+    (STRING: Hash64 words + memcomparable prefix ranks; INT64/FLOAT64:
+    order-preserving split words).  Shared by device ingest and the
+    streaming store writer, so ``.dpf`` parts written out-of-core read
+    back through the same ``store`` binding path."""
+    if field.ctype == ColumnType.STRING:
+        if dictionary is None:
+            raise ValueError(f"STRING column {field.name} needs a dictionary")
+        from dryad_tpu.columnar.schema import string_prefix_rank
+
+        strs = [str(s) for s in a]
+        hashes = dictionary.add_all(strs)
+        lo, hi = split64(hashes)
+        sarr = np.array(strs, object)
+        return {
+            f"{field.name}#h0": lo,
+            f"{field.name}#h1": hi,
+            f"{field.name}#r0": string_prefix_rank(sarr),
+            f"{field.name}#r1": string_prefix_rank(sarr, offset=4),
+        }
+    if field.ctype == ColumnType.INT64:
+        lo, hi = split64(a.astype(np.int64))
+        return {f"{field.name}#h0": lo, f"{field.name}#h1": hi}
+    if field.ctype == ColumnType.FLOAT64:
+        from dryad_tpu.columnar.schema import f64_to_ordered_i64
+
+        lo, hi = split64(f64_to_ordered_i64(a))
+        return {f"{field.name}#h0": lo, f"{field.name}#h1": hi}
+    return {field.name: a.astype(field.ctype.numpy_dtype)}
+
+
 @jax.tree_util.register_pytree_node_class
 class ColumnBatch:
     """Fixed-capacity columnar batch with a validity mask.
@@ -166,33 +200,9 @@ class ColumnBatch:
 
         data: Dict[str, jnp.ndarray] = {}
         for f in schema.fields:
-            a = np.asarray(arrays[f.name])
-            if f.ctype == ColumnType.STRING:
-                if dictionary is None:
-                    raise ValueError(f"STRING column {f.name} needs a dictionary")
-                from dryad_tpu.columnar.schema import string_prefix_rank
-
-                strs = [str(s) for s in a]
-                hashes = dictionary.add_all(strs)
-                lo, hi = split64(hashes)
-                sarr = np.array(strs, object)
-                phys = {
-                    f"{f.name}#h0": lo,
-                    f"{f.name}#h1": hi,
-                    f"{f.name}#r0": string_prefix_rank(sarr),
-                    f"{f.name}#r1": string_prefix_rank(sarr, offset=4),
-                }
-            elif f.ctype == ColumnType.INT64:
-                lo, hi = split64(a.astype(np.int64))
-                phys = {f"{f.name}#h0": lo, f"{f.name}#h1": hi}
-            elif f.ctype == ColumnType.FLOAT64:
-                from dryad_tpu.columnar.schema import f64_to_ordered_i64
-
-                lo, hi = split64(f64_to_ordered_i64(a))
-                phys = {f"{f.name}#h0": lo, f"{f.name}#h1": hi}
-            else:
-                phys = {f.name: a.astype(f.ctype.numpy_dtype)}
-            for pname, pvals in phys.items():
+            for pname, pvals in encode_physical(
+                f, np.asarray(arrays[f.name]), dictionary
+            ).items():
                 padded = np.zeros((cap,), pvals.dtype)
                 padded[:n] = pvals
                 data[pname] = jnp.asarray(padded)
@@ -227,28 +237,39 @@ class ColumnBatch:
         already-fetched ``(valid, columns)`` from :meth:`fetch_host`
         (callers that batched the transfer with extra arrays)."""
         valid, host = _host if _host is not None else self.fetch_host()[:2]
-        out: Dict[str, np.ndarray] = {}
-        for f in schema.fields:
-            if f.ctype == ColumnType.STRING:
-                lo = host[f"{f.name}#h0"][valid]
-                hi = host[f"{f.name}#h1"][valid]
-                hashes = join64(lo, hi)
-                if dictionary is None:
-                    out[f.name] = hashes  # fall back to raw hashes
-                else:
-                    out[f.name] = np.array(
-                        dictionary.lookup_all(hashes), dtype=object
-                    )
-            elif f.ctype == ColumnType.INT64:
-                lo = host[f"{f.name}#h0"][valid]
-                hi = host[f"{f.name}#h1"][valid]
-                out[f.name] = join64(lo, hi, signed=True)
-            elif f.ctype == ColumnType.FLOAT64:
-                from dryad_tpu.columnar.schema import ordered_i64_to_f64
+        return decode_physical_table(schema, valid, host, dictionary)
 
-                lo = host[f"{f.name}#h0"][valid]
-                hi = host[f"{f.name}#h1"][valid]
-                out[f.name] = ordered_i64_to_f64(join64(lo, hi, signed=True))
+
+def decode_physical_table(
+    schema: Schema,
+    valid,
+    host: Dict[str, np.ndarray],
+    dictionary: Optional[StringDictionary] = None,
+) -> Dict[str, np.ndarray]:
+    """Physical host columns -> logical table (``valid`` is a bool mask
+    or a full slice).  The inverse of :func:`encode_physical`."""
+    out: Dict[str, np.ndarray] = {}
+    for f in schema.fields:
+        if f.ctype == ColumnType.STRING:
+            lo = host[f"{f.name}#h0"][valid]
+            hi = host[f"{f.name}#h1"][valid]
+            hashes = join64(lo, hi)
+            if dictionary is None:
+                out[f.name] = hashes  # fall back to raw hashes
             else:
-                out[f.name] = np.asarray(host[f.name])[valid]
-        return out
+                out[f.name] = np.array(
+                    dictionary.lookup_all(hashes), dtype=object
+                )
+        elif f.ctype == ColumnType.INT64:
+            lo = host[f"{f.name}#h0"][valid]
+            hi = host[f"{f.name}#h1"][valid]
+            out[f.name] = join64(lo, hi, signed=True)
+        elif f.ctype == ColumnType.FLOAT64:
+            from dryad_tpu.columnar.schema import ordered_i64_to_f64
+
+            lo = host[f"{f.name}#h0"][valid]
+            hi = host[f"{f.name}#h1"][valid]
+            out[f.name] = ordered_i64_to_f64(join64(lo, hi, signed=True))
+        else:
+            out[f.name] = np.asarray(host[f.name])[valid]
+    return out

@@ -90,18 +90,19 @@ def read_partition_file(path: str) -> Dict[str, np.ndarray]:
         return parse_partition_bytes(fh.read())
 
 
-def write_store(
+def write_store_meta(
     path: str,
-    partitions: List[Dict[str, np.ndarray]],
+    n_partitions: int,
     schema: Schema,
     dictionary: Optional[StringDictionary] = None,
     compression: Optional[str] = None,
-    threads: int = 4,
 ) -> None:
+    """Store manifest + dictionary files — the single writer of the
+    store metadata format (shared with the streaming store writer)."""
     os.makedirs(path, exist_ok=True)
     manifest = {
         "version": 1,
-        "partitions": len(partitions),
+        "partitions": n_partitions,
         "compression": compression or "none",
         "schema": [[f.name, f.ctype.value] for f in schema.fields],
     }
@@ -110,6 +111,32 @@ def write_store(
     if dictionary is not None:
         with open(os.path.join(path, DICTFILE), "w") as fh:
             json.dump({format(h, "016x"): s for h, s in dictionary.items()}, fh)
+
+
+def load_store_meta(path: str):
+    """(manifest, schema, hash->string map) — the single reader of the
+    store metadata format."""
+    with open(os.path.join(path, MANIFEST)) as fh:
+        manifest = json.load(fh)
+    schema = Schema([(n, ColumnType(t)) for n, t in manifest["schema"]])
+    dict_map: Dict[int, str] = {}
+    dpath = os.path.join(path, DICTFILE)
+    if os.path.exists(dpath):
+        with open(dpath) as fh:
+            for h, s in json.load(fh).items():
+                dict_map[int(h, 16)] = s
+    return manifest, schema, dict_map
+
+
+def write_store(
+    path: str,
+    partitions: List[Dict[str, np.ndarray]],
+    schema: Schema,
+    dictionary: Optional[StringDictionary] = None,
+    compression: Optional[str] = None,
+    threads: int = 4,
+) -> None:
+    write_store_meta(path, len(partitions), schema, dictionary, compression)
     # Native writer compresses columns on a thread pool when available
     # (falls back to write_partition_file); partitions additionally
     # write concurrently — the async channel-writer analog
@@ -139,15 +166,9 @@ def write_store(
 def read_store(
     path: str,
 ) -> Tuple[Schema, List[Dict[str, np.ndarray]], StringDictionary]:
-    with open(os.path.join(path, MANIFEST)) as fh:
-        manifest = json.load(fh)
-    schema = Schema([(n, ColumnType(t)) for n, t in manifest["schema"]])
+    manifest, schema, dict_map = load_store_meta(path)
     dictionary = StringDictionary()
-    dpath = os.path.join(path, DICTFILE)
-    if os.path.exists(dpath):
-        with open(dpath) as fh:
-            for h, s in json.load(fh).items():
-                dictionary._map[int(h, 16)] = s
+    dictionary._map.update(dict_map)
     # Background-prefetched ordered reads via the native channel reader
     # (Python fallback inside PrefetchChannel when the lib is absent).
     from dryad_tpu.runtime.bindings import PrefetchChannel

@@ -1,0 +1,102 @@
+"""Partial-aggregation decomposition shared by the vertex-task and
+streaming executors.
+
+The reference decomposes GroupBy aggregations into
+Seed/Accumulate/RecursiveAccumulate/FinalReduce so partial combines can
+run close to the data and merge up an aggregation tree
+(``LinqToDryad/DryadLinqDecomposition.cs:34``;
+``GraphManager/stagemanager/DrDynamicAggregateManager.h:117-168``).
+Here the same decomposition serves two consumers: per-vertex partials
+in ``cluster.localjob`` and per-chunk partials in ``exec.outofcore``.
+"""
+
+from __future__ import annotations
+
+# Builtin aggregates whose partials merge associatively.  "first"
+# merges correctly only when partial rows concatenate in engine order
+# (the callers enforce their own ordering constraints).
+MERGEABLE_AGGS = frozenset(
+    {"sum", "count", "min", "max", "mean", "any", "all", "first"}
+)
+
+
+def partial_plan(agg_list):
+    """Decompose builtin aggs into partial specs plus the merge plan.
+
+    Returns ``(partial, plan)`` where ``partial`` is an agg spec dict
+    for the chunk/vertex-side group_by and ``plan`` rows are
+    ``(out_name, op, partial_col_names)`` for the final merge.
+    """
+    partial, plan = {}, []
+    for op, col, out in agg_list:
+        if op == "mean":
+            partial[f"{out}__ps"] = ("sum", col)
+            partial[f"{out}__pc"] = ("count", None)
+            plan.append((out, "mean", (f"{out}__ps", f"{out}__pc")))
+        else:
+            partial[f"{out}__p"] = (op, col)
+            plan.append((out, op, (f"{out}__p",)))
+    return partial, plan
+
+
+def merge_agg_spec(plan):
+    """Agg spec that merges partial columns into partial columns of the
+    same names — closed under composition, so intermediate compaction
+    rounds can apply it repeatedly before the final round."""
+    spec = {}
+    for _out, op, pcols in plan:
+        if op == "mean":
+            spec[pcols[0]] = ("sum", pcols[0])
+            spec[pcols[1]] = ("sum", pcols[1])
+        elif op in ("sum", "count"):
+            spec[pcols[0]] = ("sum", pcols[0])
+        elif op in ("min", "max", "any", "all", "first"):
+            spec[pcols[0]] = (op, pcols[0])
+        else:  # pragma: no cover - guarded by MERGEABLE_AGGS
+            raise AssertionError(f"unmergeable agg {op}")
+    return spec
+
+
+_PHYS_SUFFIXES = ("#h0", "#h1", "#r0", "#r1")
+
+
+def copy_physical(cols, src: str, dst: str, out) -> None:
+    """Copy a logical column between physical column dicts, whatever
+    its physical width (plain, split-word, or string 4-column)."""
+    if src in cols:
+        out[dst] = cols[src]
+        return
+    found = False
+    for suf in _PHYS_SUFFIXES:
+        if f"{src}{suf}" in cols:
+            out[f"{dst}{suf}"] = cols[f"{src}{suf}"]
+            found = True
+    if not found:
+        raise KeyError(src)
+
+
+def finalize_fn(plan):
+    """Row-wise finalizer mapping merged partial columns to the user's
+    output columns (mean = sum/count; everything else renames).  Runs
+    traced over PHYSICAL columns, so renames carry split-word/string
+    physical columns through."""
+
+    def fn(cols):
+        out = {}
+        for name, op, pcols in plan:
+            if op == "mean":
+                import jax.numpy as jnp
+
+                if pcols[0] not in cols:
+                    raise KeyError(
+                        f"streaming mean over a split-word column "
+                        f"({pcols[0]}) is not supported"
+                    )
+                c = cols[pcols[1]]
+                denom = jnp.maximum(c, 1).astype("float32")
+                out[name] = cols[pcols[0]].astype("float32") / denom
+            else:
+                copy_physical(cols, pcols[0], name, out)
+        return out
+
+    return fn

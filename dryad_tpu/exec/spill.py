@@ -1,0 +1,133 @@
+"""Bucketed spill files for out-of-core execution.
+
+The TPU-native analog of the reference's persisted file channels
+between stages (``DryadVertex/VertexHost/system/channel/
+channelinterface.h:212`` RChannelReader over ``DCT_File`` channels):
+a stage that cannot hold its working set in HBM streams bucketed
+``.dpf`` pieces to local disk and re-reads one bucket at a time.
+Strings spill as their 64-bit dictionary hashes (8 bytes/row, the
+``Hash64.cs`` precedent) and decode back through the context
+dictionary on read.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import shutil
+import tempfile
+from typing import Dict, Iterator, List, Optional
+
+import numpy as np
+
+from dryad_tpu.columnar.io import read_partition_file, write_partition_file
+
+_STR_MARK = "#spillstr_"  # physical prefix for hash-encoded string cols
+
+
+class SpillDir:
+    """Append-only bucketed spill directory.
+
+    ``append(bucket, table)`` writes one ``.dpf`` piece;
+    ``read_bucket(bucket)`` concatenates the bucket's pieces back into
+    one host table.  Object/str columns are hash-encoded via the
+    context dictionary (which must already contain the values — true
+    for any table that passed through ingest).
+    """
+
+    def __init__(
+        self, dictionary=None, root: Optional[str] = None, own: bool = True
+    ):
+        # own=True also for caller-provided roots: every streaming-
+        # executor root is a fresh mkdtemp (possibly under the
+        # configured stream_spill_dir), so cleanup() must remove it.
+        self._own = own
+        self.root = root or tempfile.mkdtemp(prefix="dryad_spill_")
+        os.makedirs(self.root, exist_ok=True)
+        self.dictionary = dictionary
+        self._pieces: Dict[int, List[str]] = {}
+        self._rows: Dict[int, int] = {}
+        self.bytes_written = 0
+
+    def _encode(self, table: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        out = {}
+        for name, a in table.items():
+            a = np.asarray(a)
+            if a.dtype == object or a.dtype.kind in ("U", "S"):
+                if self.dictionary is None:
+                    raise ValueError(
+                        f"string column {name!r} needs a dictionary to spill"
+                    )
+                uniq, inv = np.unique(a.astype(object), return_inverse=True)
+                hs = np.asarray(
+                    [self.dictionary.add(str(s)) for s in uniq], np.uint64
+                )
+                out[_STR_MARK + name] = hs[inv]
+            else:
+                out[name] = a
+        return out
+
+    def _decode(self, cols: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        out = {}
+        for name, a in cols.items():
+            if name.startswith(_STR_MARK):
+                hs = a.astype(np.uint64)
+                uniq, inv = np.unique(hs, return_inverse=True)
+                vals = np.asarray(
+                    [self.dictionary._map[int(h)] for h in uniq], object
+                )
+                out[name[len(_STR_MARK):]] = vals[inv]
+            else:
+                out[name] = a
+        return out
+
+    def append(self, bucket: int, table: Dict[str, np.ndarray]) -> int:
+        """Spill one piece; returns the piece's row count."""
+        enc = self._encode(table)
+        n = len(next(iter(enc.values()))) if enc else 0
+        if n == 0:
+            return 0
+        bdir = os.path.join(self.root, f"bucket_{bucket:05d}")
+        os.makedirs(bdir, exist_ok=True)
+        pieces = self._pieces.setdefault(bucket, [])
+        path = os.path.join(bdir, f"piece_{len(pieces):05d}.dpf")
+        write_partition_file(path, enc)
+        pieces.append(path)
+        self._rows[bucket] = self._rows.get(bucket, 0) + n
+        self.bytes_written += os.path.getsize(path)
+        return n
+
+    def buckets(self) -> List[int]:
+        return sorted(self._pieces)
+
+    def bucket_rows(self, bucket: int) -> int:
+        return self._rows.get(bucket, 0)
+
+    def read_bucket(self, bucket: int) -> Dict[str, np.ndarray]:
+        pieces = [read_partition_file(p) for p in self._pieces.get(bucket, [])]
+        if not pieces:
+            return {}
+        cols = {
+            n: np.concatenate([p[n] for p in pieces]) for n in pieces[0]
+        }
+        return self._decode(cols)
+
+    def read_bucket_pieces(
+        self, bucket: int
+    ) -> Iterator[Dict[str, np.ndarray]]:
+        """Per-piece iterator (for re-bucketing an oversized bucket
+        without holding it whole)."""
+        for p in self._pieces.get(bucket, []):
+            yield self._decode(read_partition_file(p))
+
+    def drop_bucket(self, bucket: int) -> None:
+        for p in self._pieces.pop(bucket, []):
+            with contextlib.suppress(OSError):
+                os.remove(p)
+        self._rows.pop(bucket, None)
+
+    def cleanup(self) -> None:
+        if self._own:
+            shutil.rmtree(self.root, ignore_errors=True)
+        self._pieces.clear()
+        self._rows.clear()

@@ -54,6 +54,10 @@ class JobInfo:
     # the report redraw the graph post-hoc, the way the reference
     # JobBrowser rebuilds it from GM logs (JOM/jobinfo.cs:62)
     topology: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    # out-of-core streaming progress (exec.outofcore stream_* events):
+    # {chunks, chunk_rows, spills, spill_rows, buckets, splits,
+    #  combines} — zero when the job never streamed
+    stream: Dict[str, int] = dataclasses.field(default_factory=dict)
 
     @property
     def ok(self) -> bool:
@@ -99,6 +103,7 @@ def _fold_job(events: List[Dict[str, Any]]) -> JobInfo:
     iters = 0
     state_boost = 0
     topology: List[Dict[str, Any]] = []
+    stream_stats: Dict[str, int] = {}
     t0 = t1 = None
 
     def stage(ev) -> StageInfo:
@@ -146,10 +151,29 @@ def _fold_job(events: List[Dict[str, Any]]) -> JobInfo:
             iters = max(iters, ev.get("iter", 0))
         elif kind == "do_while_state_boost":
             state_boost = max(state_boost, ev.get("boost", 0))
+        elif kind.startswith("stream_"):
+            if kind == "stream_chunk":
+                stream_stats["chunks"] = stream_stats.get("chunks", 0) + 1
+                stream_stats["chunk_rows"] = (
+                    stream_stats.get("chunk_rows", 0) + ev.get("rows", 0)
+                )
+            elif kind == "stream_spill":
+                stream_stats["spills"] = stream_stats.get("spills", 0) + 1
+                stream_stats["spill_rows"] = (
+                    stream_stats.get("spill_rows", 0) + ev.get("rows", 0)
+                )
+            elif kind == "stream_bucket":
+                stream_stats["buckets"] = stream_stats.get("buckets", 0) + 1
+            elif kind == "stream_bucket_split":
+                stream_stats["splits"] = stream_stats.get("splits", 0) + 1
+            elif kind == "stream_combine":
+                stream_stats["combines"] = (
+                    stream_stats.get("combines", 0) + 1
+                )
     wall = (t1 - t0) if (t0 is not None and t1 is not None) else 0.0
     return JobInfo(
         stages, declared, started, completed, failed, iters, state_boost,
-        wall, topology,
+        wall, topology, stream_stats,
     )
 
 
@@ -243,6 +267,18 @@ def render(job: JobInfo) -> str:
         lines.append(
             f"{s.id:>4} {s.name[:40]:<40} {s.versions:>4} {s.failures:>4} "
             f"{s.overflows:>4} {s.stragglers:>4} {s.seconds:>8.3f}  {state}"
+        )
+    if job.stream:
+        st = job.stream
+        lines.append(
+            "streaming: "
+            f"chunks={st.get('chunks', 0)} "
+            f"({st.get('chunk_rows', 0)} rows)  "
+            f"spills={st.get('spills', 0)} "
+            f"({st.get('spill_rows', 0)} rows)  "
+            f"buckets={st.get('buckets', 0)}  "
+            f"splits={st.get('splits', 0)}  "
+            f"combines={st.get('combines', 0)}"
         )
     lines.append("-- diagnosis --")
     lines.extend("  " + d for d in diagnose(job))

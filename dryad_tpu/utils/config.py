@@ -146,6 +146,19 @@ class DryadConfig:
     tail_rows_per_partition: int = _env_int(
         "DRYAD_TPU_TAIL_ROWS_PER_PARTITION", 512
     )
+    # Out-of-core streaming (exec.outofcore; reference streaming channel
+    # stack channelinterface.h:212): max rows a phase-2 bucket may hold
+    # before it re-splits from observed volume, the partial-accumulator
+    # compaction threshold, and the phase-1 spill fan-out.
+    stream_bucket_rows: int = _env_int("DRYAD_TPU_STREAM_BUCKET_ROWS", 1 << 21)
+    stream_combine_rows: int = _env_int(
+        "DRYAD_TPU_STREAM_COMBINE_ROWS", 1 << 20
+    )
+    stream_buckets: int = _env_int("DRYAD_TPU_STREAM_BUCKETS", 32)
+    # Spill directory for streaming buckets (None: a fresh tempdir).
+    stream_spill_dir: Optional[str] = os.environ.get(
+        "DRYAD_TPU_STREAM_SPILL_DIR"
+    ) or None
 
     def __post_init__(self) -> None:
         self.validate()
@@ -182,3 +195,9 @@ class DryadConfig:
             raise ValueError("tail_fanout_rows must be >= 0")
         if self.tail_rows_per_partition < 1:
             raise ValueError("tail_rows_per_partition must be >= 1")
+        if self.stream_bucket_rows < 1:
+            raise ValueError("stream_bucket_rows must be >= 1")
+        if self.stream_combine_rows < 1:
+            raise ValueError("stream_combine_rows must be >= 1")
+        if self.stream_buckets < 2:
+            raise ValueError("stream_buckets must be >= 2")

@@ -1,0 +1,292 @@
+"""Out-of-core streaming executor (exec.outofcore).
+
+Covers the reference's streaming-channel semantics
+(``channelinterface.h:212`` RChannelReader: bounded buffers over
+unbounded data) rebuilt as the chunk/bucket morsel driver: partial
+aggregation, external distribution sort with observed-volume bucket
+re-splits (``DrDynamicRangeDistributor.cpp:54-110`` semantics), Grace
+joins, and the streamed store writer.
+"""
+
+import collections
+import os
+
+import numpy as np
+import pytest
+
+from dryad_tpu import DryadConfig, DryadContext
+
+
+def make_ctx(**kw):
+    cfg = DryadConfig(
+        stream_bucket_rows=kw.pop("bucket_rows", 4000),
+        stream_combine_rows=kw.pop("combine_rows", 2000),
+        stream_buckets=kw.pop("buckets", 8),
+    )
+    return DryadContext(num_partitions_=8, config=cfg)
+
+
+@pytest.fixture
+def ctx(mesh8):
+    return make_ctx()
+
+
+def _events(c, kind):
+    return [e for e in c.executor.events.events() if e["kind"] == kind]
+
+
+def test_stream_group_by_partials(ctx):
+    rng = np.random.default_rng(0)
+    chunks = [
+        {"k": rng.integers(0, 40, 1500).astype(np.int32),
+         "v": rng.random(1500).astype(np.float32)}
+        for _ in range(6)
+    ]
+    out = (
+        ctx.from_stream(iter([{k: v.copy() for k, v in c.items()}
+                              for c in chunks]))
+        .group_by("k", {"s": ("sum", "v"), "c": ("count", None),
+                        "mx": ("max", "v"), "mu": ("mean", "v")})
+        .collect()
+    )
+    allk = np.concatenate([c["k"] for c in chunks])
+    allv = np.concatenate([c["v"] for c in chunks])
+    got = {int(k): (s, c, mx, mu) for k, s, c, mx, mu in
+           zip(out["k"], out["s"], out["c"], out["mx"], out["mu"])}
+    assert set(got) == set(np.unique(allk).tolist())
+    for k in got:
+        m = allk == k
+        s, c, mx, mu = got[k]
+        assert np.isclose(s, allv[m].sum(), rtol=1e-4)
+        assert int(c) == int(m.sum())
+        assert np.isclose(mx, allv[m].max(), rtol=1e-6)
+        assert np.isclose(mu, allv[m].mean(), rtol=1e-4)
+    # compaction must have kicked in (6 x ~40 partial rows < threshold,
+    # so force a tighter one to check the event in a second run)
+    assert _events(ctx, "stream_chunk")
+
+
+def test_stream_combine_compaction_event():
+    c = make_ctx(combine_rows=50)
+    rng = np.random.default_rng(1)
+    chunks = [{"k": rng.integers(0, 40, 500).astype(np.int32),
+               "v": np.ones(500, np.float32)} for _ in range(4)]
+    out = (
+        c.from_stream(iter(chunks)).group_by("k", {"s": ("sum", "v")})
+        .collect()
+    )
+    assert len(out["k"]) == 40
+    assert _events(c, "stream_combine"), "compaction should have run"
+
+
+def test_stream_external_sort_and_resplit_events():
+    # first chunk covers only a narrow range -> estimated splitters are
+    # bad -> later chunks overload one bucket -> observed-volume resplit
+    c = make_ctx(bucket_rows=3000, buckets=4)
+    rng = np.random.default_rng(2)
+    first = {"x": rng.integers(0, 10, 2000).astype(np.int32)}
+    rest = [{"x": rng.integers(0, 1_000_000, 4000).astype(np.int32)}
+            for _ in range(3)]
+    out = c.from_stream(iter([first] + rest)).order_by(["x"]).collect()
+    exp = np.sort(np.concatenate([first["x"]] + [r["x"] for r in rest]))
+    assert np.array_equal(out["x"], exp)
+    assert _events(c, "stream_bucket_split"), (
+        "skewed splitters must trigger an observed-volume re-split"
+    )
+
+
+def test_stream_sort_desc_and_secondary_key(ctx):
+    rng = np.random.default_rng(3)
+    chunks = [
+        {"a": rng.integers(0, 5, 1200).astype(np.int32),
+         "b": rng.integers(0, 1000, 1200).astype(np.int32)}
+        for _ in range(3)
+    ]
+    out = (
+        ctx.from_stream(iter(chunks))
+        .order_by([("a", True), "b"])
+        .collect()
+    )
+    rows = list(zip(out["a"].tolist(), out["b"].tolist()))
+    exp = sorted(
+        zip(np.concatenate([c["a"] for c in chunks]).tolist(),
+            np.concatenate([c["b"] for c in chunks]).tolist()),
+        key=lambda t: (-t[0], t[1]),
+    )
+    assert rows == exp
+
+
+def test_stream_sort_equal_keys_fat_bucket():
+    # a single value larger than any bucket with NO secondary key:
+    # emitted unsorted-internally (any order is a sorted order)
+    c = make_ctx(bucket_rows=1000, buckets=4)
+    chunks = [{"x": np.full(1500, 7, np.int32)} for _ in range(3)]
+    out = c.from_stream(iter(chunks)).order_by(["x"]).collect()
+    assert len(out["x"]) == 4500 and (out["x"] == 7).all()
+    ev = _events(c, "stream_bucket_split")
+    assert any(e.get("mode") == "equal_keys" for e in ev)
+
+
+def test_stream_string_sort(ctx):
+    rng = np.random.default_rng(4)
+    vocab = np.array([f"w{i:04d}" for i in range(300)])
+    chunks = [{"w": rng.choice(vocab, 1000)} for _ in range(3)]
+    out = ctx.from_stream(iter(chunks)).order_by(["w"]).collect()
+    exp = sorted(np.concatenate([c["w"] for c in chunks]).tolist())
+    assert [str(s) for s in out["w"]] == exp
+
+
+def test_stream_grace_join_hot_key_rehash():
+    # one hot key overloads its hash bucket on both sides -> rehash
+    # split keeps every bucket bounded; join result stays exact
+    c = make_ctx(bucket_rows=2500, buckets=4)
+    rng = np.random.default_rng(5)
+    L = [{"k": np.where(rng.random(2000) < 0.5, 7,
+                        rng.integers(0, 100, 2000)).astype(np.int32),
+          "a": rng.integers(0, 3, 2000).astype(np.int32)}
+         for _ in range(2)]
+    R = [{"k": rng.integers(0, 100, 500).astype(np.int32),
+          "b": rng.integers(0, 3, 500).astype(np.int32)}
+         for _ in range(2)]
+    out = (
+        c.from_stream(iter(L))
+        .join(c.from_stream(iter(R)), ["k"], ["k"])
+        .collect()
+    )
+    lk = np.concatenate([d["k"] for d in L])
+    la = np.concatenate([d["a"] for d in L])
+    rk = np.concatenate([d["k"] for d in R])
+    rb = np.concatenate([d["b"] for d in R])
+    ridx = collections.defaultdict(list)
+    for kk, bb in zip(rk.tolist(), rb.tolist()):
+        ridx[kk].append(bb)
+    exp = sorted((kk, aa, bb) for kk, aa in zip(lk.tolist(), la.tolist())
+                 for bb in ridx.get(kk, []))
+    got = sorted(zip(out["k"].tolist(), out["a"].tolist(),
+                     out["b"].tolist()))
+    assert got == exp
+
+
+def test_stream_left_join_small_right(ctx):
+    rng = np.random.default_rng(6)
+    chunks = [{"k": rng.integers(0, 20, 800).astype(np.int32)}
+              for _ in range(3)]
+    right = {"k": np.arange(10, dtype=np.int32),
+             "w": np.arange(10, dtype=np.int32) * 3}
+    out = (
+        ctx.from_stream(iter(chunks))
+        .left_join(ctx.from_arrays(right), ["k"], ["k"],
+                   right_defaults={"w": -1})
+        .collect()
+    )
+    allk = np.concatenate([c["k"] for c in chunks])
+    exp = sorted((int(k), int(k) * 3 if k < 10 else -1) for k in allk)
+    got = sorted(zip(out["k"].tolist(), out["w"].tolist()))
+    assert got == exp
+
+
+def test_stream_scalar_aggregate_and_take(ctx):
+    rng = np.random.default_rng(7)
+    chunks = [{"x": rng.integers(0, 1000, 900).astype(np.int32)}
+              for _ in range(4)]
+    xs = np.concatenate([c["x"] for c in chunks])
+    agg = (
+        ctx.from_stream(iter([{"x": c["x"].copy()} for c in chunks]))
+        .aggregate_as_query({"s": ("sum", "x"), "mn": ("min", "x"),
+                             "mu": ("mean", "x")})
+        .collect()
+    )
+    assert int(agg["s"][0]) == int(xs.sum())
+    assert int(agg["mn"][0]) == int(xs.min())
+    assert np.isclose(float(agg["mu"][0]), xs.mean(), rtol=1e-4)
+    t = ctx.from_stream(iter(chunks)).take(1234).collect()
+    assert np.array_equal(t["x"], xs[:1234])
+
+
+def test_stream_distinct_high_cardinality_spills():
+    c = make_ctx(bucket_rows=1500, combine_rows=800, buckets=4)
+    rng = np.random.default_rng(8)
+    chunks = [{"x": rng.integers(0, 100_000, 1200).astype(np.int32)}
+              for _ in range(5)]
+    out = c.from_stream(iter(chunks)).distinct().collect()
+    exp = set(np.concatenate([ch["x"] for ch in chunks]).tolist())
+    assert set(out["x"].tolist()) == exp and len(out["x"]) == len(exp)
+    assert _events(c, "stream_distinct_spill")
+
+
+def test_stream_wordcount_text_and_store(ctx, tmp_path):
+    rng = np.random.default_rng(9)
+    vocab = [f"word{i}" for i in range(50)]
+    words = rng.choice(vocab, 20000)
+    path = tmp_path / "corpus.txt"
+    path.write_text(" ".join(words.tolist()))
+    out = (
+        ctx.text_stream(str(path), chunk_bytes=2048)
+        .group_by("word", {"c": ("count", None)})
+        .collect()
+    )
+    cnt = collections.Counter(words.tolist())
+    got = {str(w): int(c) for w, c in zip(out["word"], out["c"])}
+    assert got == dict(cnt)
+    # streamed store write + chunked re-read
+    c2 = make_ctx()
+    chunks = [{"k": rng.integers(0, 60, 1000).astype(np.int32)}
+              for _ in range(4)]
+    store = str(tmp_path / "st")
+    c2.to_store(c2.from_stream(iter(chunks)).order_by(["k"]), store)
+    assert os.path.exists(os.path.join(store, "manifest.json"))
+    c3 = make_ctx()
+    back = c3.store_stream(store).aggregate_as_query(
+        {"c": ("count", None), "s": ("sum", "k")}
+    ).collect()
+    allk = np.concatenate([ch["k"] for ch in chunks])
+    assert int(back["c"][0]) == len(allk)
+    assert int(back["s"][0]) == int(allk.sum())
+    # the plain engine can open the streamed store too
+    c4 = make_ctx()
+    full = c4.from_store(store).collect()
+    assert np.array_equal(np.sort(full["k"]), np.sort(allk))
+
+
+def test_stream_concat_and_select_many(ctx):
+    rng = np.random.default_rng(10)
+    a = [{"x": rng.integers(0, 50, 600).astype(np.int32)} for _ in range(2)]
+    b = [{"x": rng.integers(50, 99, 600).astype(np.int32)} for _ in range(2)]
+    out = (
+        ctx.from_stream(iter(a))
+        .concat(ctx.from_stream(iter(b)))
+        .aggregate_as_query({"c": ("count", None)})
+        .collect()
+    )
+    assert int(out["c"][0]) == 2400
+
+
+def test_stream_errors(ctx):
+    from dryad_tpu.exec.outofcore import StreamNotSupported
+
+    chunks = [{"x": np.arange(10, dtype=np.int32)}]
+    q = ctx.from_stream(iter(chunks))
+    with pytest.raises(StreamNotSupported):
+        q.with_rank().collect()
+    with pytest.raises(ValueError):
+        ctx.from_stream(iter([]))
+    # explicit schema allows an empty stream
+    from dryad_tpu import ColumnType, Schema
+
+    q2 = ctx.from_stream(iter([]), Schema([("x", ColumnType.INT32)]))
+    out = q2.group_by("x", {"c": ("count", None)}).collect()
+    assert len(out["x"]) == 0
+
+
+def test_stream_tee_raises_not_drops(ctx):
+    """Two branches over one chunk stream share the consumption state:
+    the second consumer must get the explicit error, never a silent
+    half of the data (code-review r5)."""
+    s = ctx.from_stream(iter([
+        {"x": np.arange(8, dtype=np.int32)},
+        {"x": np.arange(8, 16, dtype=np.int32)},
+    ]))
+    a = s.where(lambda c: c["x"] % 2 == 0)
+    b = s.where(lambda c: c["x"] % 2 == 1)
+    with pytest.raises(RuntimeError, match="consumed"):
+        a.concat(b).collect()
