@@ -673,6 +673,27 @@ def child_main() -> None:
         SUMMARY["platform"] = platform
         global _PLATFORM
         _PLATFORM = platform
+        # Persistent XLA compilation cache: supervisor re-attempts (and
+        # any fresh process) reuse compiled programs instead of paying
+        # the 15-60s/program tunnel compile again.  Plan callables are
+        # value-equal (plan/keys.py), so keys match across processes.
+        cache_dir = os.environ.get(
+            "DRYAD_BENCH_JAX_CACHE", "/tmp/dryad_jax_cache"
+        )
+        if cache_dir:
+            try:
+                import jax
+
+                jax.config.update("jax_compilation_cache_dir", cache_dir)
+                jax.config.update(
+                    "jax_persistent_cache_min_entry_size_bytes", -1
+                )
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 0.0
+                )
+                log(f"persistent compile cache at {cache_dir}")
+            except Exception as ce:  # noqa: BLE001
+                log(f"compile cache unavailable: {ce}")
     except Exception as e:  # noqa: BLE001
         traceback.print_exc(file=sys.stderr)
         SUMMARY["error"] = f"{type(e).__name__}: {e}"

@@ -359,10 +359,21 @@ class DryadContext:
         """Chunked tokenizing text ingest for corpora larger than
         memory (streaming ``from_text``; reference HDFS block readers,
         ``channelbufferhdfs.cpp``).  Chunks split at whitespace
-        boundaries so no token straddles two chunks."""
+        boundaries so no token straddles two chunks.  Chunks are
+        emitted as PHYSICAL token columns straight off the native
+        tokenizer (hash + prefix-rank words), so the streaming hot
+        path never materializes per-token Python strings."""
         if isinstance(paths, str):
             paths = [paths]
         schema = Schema([(column, ColumnType.STRING)])
+
+        def phys(buf):
+            h0, h1, r0, r1 = self._tokenize_buf(buf)
+            return {
+                f"{column}#h0": h0, f"{column}#h1": h1,
+                f"{column}#r0": r0, f"{column}#r1": r1,
+                "#vocab": {column: _word_vocab(h0, h1)},
+            }
 
         def gen():
             for p in paths:
@@ -372,7 +383,7 @@ class DryadContext:
                         buf = fh.read(chunk_bytes)
                         if not buf:
                             if carry.strip():
-                                yield {column: self._decode_tokens(carry)}
+                                yield phys(carry)
                             break
                         buf = carry + buf
                         # cut at the last whitespace so tokens stay whole
@@ -383,22 +394,9 @@ class DryadContext:
                             continue
                         chunk, carry = buf[:cut], buf[cut:]
                         if chunk.strip():
-                            yield {column: self._decode_tokens(chunk)}
+                            yield phys(chunk)
 
         return self.from_stream(gen(), schema)
-
-    def _decode_tokens(self, buf: bytes) -> np.ndarray:
-        """Tokenize a byte chunk and return the words as an object
-        array (vocabulary-sized decode via the dictionary)."""
-        h0, h1, _r0, _r1 = self._tokenize_buf(buf)
-        hashes = (h1.astype(np.uint64) << np.uint64(32)) | h0.astype(
-            np.uint64
-        )
-        uniq, inv = np.unique(hashes, return_inverse=True)
-        vals = np.array(
-            [self.dictionary._map[int(h)] for h in uniq], object
-        )
-        return vals[inv]
 
     def store_stream(self, path: str, parts_per_chunk: int = 1) -> Query:
         """Open a store as a chunk stream, one (or N) partition files
@@ -537,8 +535,11 @@ class DryadContext:
                 partition_capacity=cap, dictionary=self.dictionary,
             )
         if kind == "host_physical":
-            (phys,) = rest
-            return D.from_physical_table(phys, self.mesh)
+            phys, *opt = rest
+            cap = opt[0] if opt else None
+            return D.from_physical_table(
+                phys, self.mesh, partition_capacity=cap
+            )
         if kind == "store":
             parts, schema = rest
             P = num_partitions(self.mesh)
@@ -627,15 +628,21 @@ class DryadContext:
         return results[(sid, oidx)]
 
     def run_to_host(self, query: Query) -> Dict[str, np.ndarray]:
+        from dryad_tpu.exec.outofcore import StreamExecutor, has_stream_input
+
+        if has_stream_input(self, query.node):
+            if self.local_debug:
+                raise RuntimeError(
+                    "from_stream inputs are not supported in local_debug "
+                    "mode (the NumPy interpreter holds whole tables); "
+                    "materialize the chunks and use from_arrays"
+                )
+            return StreamExecutor(self).run_to_host(query.node)
         if self.local_debug:
             from dryad_tpu.exec.localdebug import LocalDebugInterpreter
 
             interp = LocalDebugInterpreter(self)
             return interp.run_to_logical(query.node)
-        from dryad_tpu.exec.outofcore import StreamExecutor, has_stream_input
-
-        if has_stream_input(self, query.node):
-            return StreamExecutor(self).run_to_host(query.node)
         # The dict-miss counters ride the SAME device_get as the job
         # outputs (one tunnel round-trip instead of two, BASELINE.md
         # round-4); the deferred check still raises before any result

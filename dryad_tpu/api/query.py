@@ -1215,6 +1215,28 @@ class Query:
         Submit+enumerate path, ``DryadLinqQuery.cs:608``)."""
         return self.ctx.run_to_host(self)
 
+    def collect_stream(self):
+        """Execute an out-of-core (``from_stream``) plan and yield
+        host tables one bounded piece at a time — the result-side
+        counterpart of chunked ingest, for outputs larger than host
+        memory (reference: enumerating a query streams the output
+        table, ``DryadLinqQuery.cs:608-647``).  Plans without a stream
+        input yield their whole result once."""
+        from dryad_tpu.exec.outofcore import (
+            StreamExecutor,
+            has_stream_input,
+        )
+
+        if not has_stream_input(self.ctx, self.node):
+            yield self.collect()
+            return
+        if self.ctx.local_debug:
+            raise RuntimeError(
+                "from_stream inputs are not supported in local_debug mode"
+            )
+        _schema, tables = StreamExecutor(self.ctx).run_stream(self.node)
+        yield from tables
+
     def __iter__(self):
         """Enumerating a query triggers execution and yields row dicts
         (reference TableEnumerator, ``DryadLinqQuery.cs:608-647``:

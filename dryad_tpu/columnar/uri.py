@@ -178,7 +178,7 @@ def _read_store_via(fetch: Callable[[str], bytes], threads: int) -> ReadResult:
         parts = list(
             ex.map(
                 lambda i: CIO.parse_partition_bytes(
-                    fetch(f"part-{i:05d}.dpf")
+                    fetch(f"part-{i:05d}.dpf"), copy=False
                 ),
                 range(n),
             )

@@ -290,3 +290,40 @@ def test_stream_tee_raises_not_drops(ctx):
     b = s.where(lambda c: c["x"] % 2 == 1)
     with pytest.raises(RuntimeError, match="consumed"):
         a.concat(b).collect()
+
+
+def test_stream_second_collect_raises(ctx):
+    """Consumed state lives on the SOURCE: a second collect over the
+    same from_stream query raises instead of silently computing on a
+    drained iterator (code-review r5)."""
+    q = ctx.from_stream(iter([
+        {"x": np.arange(20, dtype=np.int32)},
+        {"x": np.arange(20, 40, dtype=np.int32)},
+    ]))
+    out = q.take(5).collect()
+    assert len(out["x"]) == 5
+    with pytest.raises(RuntimeError, match="consumed"):
+        q.collect()
+
+
+def test_collect_stream_yields_bounded_pieces(ctx):
+    rng = np.random.default_rng(11)
+    chunks = [{"x": rng.integers(0, 10**6, 2000).astype(np.int32)}
+              for _ in range(4)]
+    q = ctx.from_stream(iter(chunks)).order_by(["x"])
+    pieces = list(q.collect_stream())
+    assert len(pieces) > 1  # buckets stream out, not one blob
+    got = np.concatenate([p["x"] for p in pieces])
+    assert np.array_equal(got, np.sort(np.concatenate([c["x"] for c in chunks])))
+    # non-stream plans still work (single piece)
+    ctx2 = DryadContext(num_partitions_=8)
+    (piece,) = list(ctx2.from_arrays({"x": np.arange(5, dtype=np.int32)})
+                    .collect_stream())
+    assert np.array_equal(piece["x"], np.arange(5))
+
+
+def test_stream_local_debug_clear_error():
+    c = DryadContext(local_debug=True)
+    q = c.from_stream(iter([{"x": np.arange(4, dtype=np.int32)}]))
+    with pytest.raises(RuntimeError, match="local_debug"):
+        q.collect()
