@@ -593,8 +593,10 @@ class DryadContext:
             arrays, cap = rest
             fp = content_fingerprint({str(k): np.asarray(v) for k, v in arrays.items()}) + f":{cap}"
         elif kind == "host_physical":
-            (phys,) = rest
-            fp = content_fingerprint(phys)
+            phys, *opt = rest
+            fp = content_fingerprint(phys) + (
+                f":{opt[0]}" if opt else ""
+            )
         elif kind == "store":
             parts, schema = rest
             merged = {

@@ -36,7 +36,7 @@ from dryad_tpu.exec.kernels import NON_OVERFLOW_OPS, build_stage_fn
 from dryad_tpu.exec.stats import StageStatistics
 from dryad_tpu.parallel.mesh import mesh_axes, num_partitions
 from dryad_tpu.parallel.stage import compile_stage
-from dryad_tpu.plan.lower import Stage, StageGraph
+from dryad_tpu.plan.lower import Stage, StageGraph, StageOp
 from dryad_tpu.utils.config import DryadConfig
 from dryad_tpu.utils.logging import get_logger
 
@@ -186,12 +186,24 @@ class GraphExecutor:
             parts.append((op.kind, tuple(items)))
         return (tuple(parts), tuple(stage.out_slots))
 
-    def _get_compiled(self, stage: Stage, boost: int, shape_key: Tuple):
-        key = (self._stage_key(stage), boost, shape_key)
+    def _get_compiled(
+        self, stage: Stage, boost: int, shape_key: Tuple,
+        fan: Optional[int] = None,
+    ):
+        """``fan``: observed-volume width override — exchanges/resizes
+        lowered at full width (nparts=None) concentrate onto ``fan``
+        partitions instead.  Fans quantize to powers of two, so the
+        compile cache forms a small width palette reused across jobs
+        (the re-dispatch-without-recompile requirement of
+        ``DrDynamicRangeDistributor.cpp:54-110`` adaptation)."""
+        run_stage = stage
+        if fan:
+            run_stage = self._fan_adapted_stage(stage, fan)
+        key = (self._stage_key(run_stage), boost, shape_key)
         hit = self._compiled.get(key)
         if hit is None:
             fn = build_stage_fn(
-                stage, self.P, self.config.shuffle_slack, boost,
+                run_stage, self.P, self.config.shuffle_slack, boost,
                 mesh_axes(self.mesh),
                 tuple(self.mesh.shape[a] for a in mesh_axes(self.mesh)),
             )
@@ -244,6 +256,15 @@ class GraphExecutor:
             "job_start", stages=len(graph.stages), topology=topology
         )
         results: Dict[Tuple[int, int], ColumnBatch] = {}
+        # do_while subqueries re-enter execute(); the adaptation state
+        # is per-graph (stage ids restart per lowering), so save and
+        # restore the outer job's view around the nested run
+        adapt_state = (
+            getattr(self, "_observed_rows", None),
+            getattr(self, "_count_wanted", None),
+            getattr(self, "_adapt_safe", None),
+        )
+        self._prepare_width_adapt(graph)
         # do_while re-enters execute() through subquery_runner; only the
         # top-level call may own the profiler session.
         profile = (
@@ -267,6 +288,9 @@ class GraphExecutor:
             del self._pending_ckpt[mark_ckpt:]
             raise
         finally:
+            if adapt_state[0] is not None:
+                (self._observed_rows, self._count_wanted,
+                 self._adapt_safe) = adapt_state
             if not isinstance(profile, contextlib.nullcontext):
                 self._profiling = False
         if defer_miss:
@@ -290,6 +314,180 @@ class GraphExecutor:
         del self._pending_ckpt[mark_ckpt:]
         self.events.emit("job_complete")
         return results
+
+    # -- observed-volume stage-width adaptation -----------------------------
+    #
+    # The reference resizes a consumer stage from MEASURED upstream
+    # volume and rewires the graph (DrDynamicRangeDistributor.cpp:54-110
+    # copies = sampledSize/samplingRate/dataPerVertex;
+    # DrPipelineSplitManager.h:23).  Here: completed stages report their
+    # observed output row counts (riding readbacks that happen anyway),
+    # and a consumer whose exchanges were lowered at full width because
+    # the STATIC estimator had no bound re-dispatches at a reduced
+    # power-of-two width when the observed volume is tail-sized.
+    # Producers are untouched; correctness is internal to the adapted
+    # stage — a join side whose exchange was ELIDED on partition claims
+    # gets a matching reduced-width exchange inserted (the runtime
+    # graph-rewiring of the reference's distributors).
+
+    # op kinds proven width-insensitive (everything else blocks
+    # adaptation: zip/sliding_window/rank/take-style ops depend on row
+    # placement or engine order across the full mesh width)
+    _ADAPT_OK_OPS = frozenset({
+        "select", "where", "project", "exchange_hash", "exchange_range",
+        "resize", "group_reduce", "group_reduce_dense", "local_sort",
+        "join", "scalar_agg", "string_code",
+    })
+
+    def _prepare_width_adapt(self, graph: StageGraph) -> None:
+        self._observed_rows: Dict[Tuple[int, int], int] = {}
+        self._count_wanted: set = set()
+        # (producer sid, out idx) -> True iff EVERY consumer re-routes
+        # that input through a leading exchange.  An ADAPTED stage's
+        # output no longer satisfies the full-width hash claim its plan
+        # node advertises, so a consumer that elided its exchange on
+        # that claim would silently mis-join — such producers must not
+        # adapt (the static twin of lower.py's `reduced` guard).
+        self._adapt_safe: Dict[Tuple[int, int], bool] = {}
+        single_axis = len(mesh_axes(self.mesh)) == 1
+        limit = getattr(self.config, "tail_fanout_rows", 0)
+        for st in graph.stages:
+            for j, (ref, idx) in enumerate(st.input_refs):
+                if ref == "plan_input":
+                    continue
+                key = (ref, idx)
+                ok = self._slot_reroutes(st, j)
+                self._adapt_safe[key] = (
+                    self._adapt_safe.get(key, True) and ok
+                )
+            if single_axis and limit and self._adaptable(st):
+                for ref, _idx in st.input_refs:
+                    if ref != "plan_input":
+                        self._count_wanted.add(ref)
+
+    @staticmethod
+    def _slot_reroutes(stage: Stage, slot: int) -> bool:
+        """True when the first op touching ``slot`` is an exchange —
+        rows re-route by key, so upstream placement is irrelevant."""
+        for op in stage.ops:
+            touched = [
+                op.params.get(k)
+                for k in ("slot", "left_slot", "right_slot")
+                if k in op.params
+            ]
+            if slot in touched:
+                return op.kind in ("exchange_hash", "exchange_range")
+        return False  # pass-through or unknown: be strict
+
+    def _adaptable(self, stage: Stage) -> bool:
+        return all(
+            op.kind in self._ADAPT_OK_OPS for op in stage.ops
+        ) and any(
+            op.kind in ("exchange_hash", "exchange_range")
+            and not op.params.get("nparts")
+            for op in stage.ops
+        )
+
+    def _fan_adapted_stage(self, stage: Stage, fan: int) -> Stage:
+        """Stage copy at reduced width: full-width exchanges/resizes
+        concentrate onto ``fan`` partitions, and a join slot whose
+        exchange was elided on static partition claims gets a matching
+        reduced-width exchange inserted so both sides stay
+        co-partitioned."""
+        ops: List[StageOp] = []
+        exchanged = set()
+        for op in stage.ops:
+            if op.kind == "join":
+                for side, keys_p in (
+                    ("left_slot", "left_keys"), ("right_slot", "right_keys")
+                ):
+                    sl = op.params[side]
+                    if sl not in exchanged and keys_p in op.params:
+                        ops.append(StageOp("exchange_hash", {
+                            "slot": sl,
+                            "keys": list(op.params[keys_p]),
+                            "nparts": fan,
+                        }))
+                        ops.append(StageOp("resize", {
+                            "slot": sl, "factor": 1.0, "nparts": fan,
+                        }))
+                        exchanged.add(sl)
+            if op.kind in ("exchange_hash", "exchange_range", "resize"):
+                exchanged.add(op.params.get("slot"))
+                if not op.params.get("nparts"):
+                    ops.append(StageOp(op.kind, {**op.params, "nparts": fan}))
+                    continue
+            ops.append(op)
+        return Stage(
+            stage.id, stage.name, list(stage.input_refs), ops=ops,
+            out_slots=list(stage.out_slots), growth=stage.growth,
+        )
+
+    _SHRINKING_OPS = frozenset(
+        {"group_reduce", "group_reduce_dense", "distinct", "scalar_agg",
+         "topk"}
+    )
+
+    def _drain_for_adapt(self, stage: Stage, window) -> bool:
+        """Worth syncing the window early: this stage could adapt its
+        width, every input's count is pending in the window (or already
+        known), and at least one producer is aggregation-shaped (the
+        shapes that shrink data by orders of magnitude — draining for a
+        map stage would pay the sync the window exists to avoid)."""
+        limit = getattr(self.config, "tail_fanout_rows", 0)
+        if not limit or len(mesh_axes(self.mesh)) != 1:
+            return False
+        if not self._adaptable(stage):
+            return False
+        in_window = {w["stage"].id: w for w in window}
+        shrinker = False
+        for ref, idx in stage.input_refs:
+            if ref == "plan_input":
+                return False
+            if (ref, idx) in self._observed_rows:
+                continue  # already counted (earlier drain)
+            w = in_window.get(ref)
+            if w is None or not w.get("counts"):
+                return False
+            if any(
+                op.kind in self._SHRINKING_OPS
+                for op in w["stage"].ops
+            ):
+                shrinker = True
+        return shrinker
+
+    def _record_observed(self, stage: Stage, host_counts) -> None:
+        for idx, c in enumerate(host_counts):
+            self._observed_rows[(stage.id, idx)] = int(c)
+
+    def _adapt_fan_for(self, stage: Stage) -> Optional[int]:
+        """Reduced width for this stage from its inputs' OBSERVED rows;
+        None = run as lowered (full width or static reduction)."""
+        limit = getattr(self.config, "tail_fanout_rows", 0)
+        if not limit or len(mesh_axes(self.mesh)) != 1:
+            return None
+        if not self._adaptable(stage):
+            return None
+        # every consumer of THIS stage must re-route its output
+        if not all(
+            self._adapt_safe.get((stage.id, i), True)
+            for i in range(len(stage.out_slots))
+        ):
+            return None
+        total = 0
+        for ref, idx in stage.input_refs:
+            if ref == "plan_input":
+                return None  # static bindings: lowering already decided
+            c = self._observed_rows.get((ref, idx))
+            if c is None:
+                return None
+            total += c
+        if total > limit:
+            return None
+        per = max(1, getattr(self.config, "tail_rows_per_partition", 512))
+        fan = max(1, -(-total // per))
+        fan = 1 << (fan - 1).bit_length()  # pow2 palette for cache reuse
+        return fan if fan < self.P else None
 
     def _raise_miss(self, name: str, m: int) -> None:
         self.events.emit("dict_miss", stage_name=name, rows=m)
@@ -341,6 +539,14 @@ class GraphExecutor:
                 stage_fps[stage.id] = None  # host fn is opaque
                 self._run_apply_host(stage, bindings, results)
                 continue
+            if window and self._drain_for_adapt(stage, window):
+                # adaptation opportunity: an aggregation-shaped producer
+                # of this stage sits undrained in the window, so its
+                # observed count is one batched readback away — pay the
+                # sync now to dispatch this stage at observed width
+                # (DrDynamicRangeDistributor.cpp:54-110 semantics)
+                self._drain_window(window, graph, bindings, results,
+                                   binding_fps or {}, stage_fps)
             self._run_stage(
                 stage, graph, bindings, results, binding_fps or {}, stage_fps,
                 window=window if depth > 1 else None,
@@ -372,8 +578,16 @@ class GraphExecutor:
             else flags[0] if len(flags) == 1
             else jnp.any(jnp.stack(flags))
         )
-        if not bool(combined):
+        # observed row counts ride the SAME batched readback
+        counted = [w for w in window if w.get("counts")]
+        combined_v, counts_v = jax.device_get(
+            (combined, [w["counts"] for w in counted])
+        )
+        count_of = {id(w): cv for w, cv in zip(counted, counts_v)}
+        if not bool(combined_v):
             for w in window:
+                if id(w) in count_of:
+                    self._record_observed(w["stage"], count_of[id(w)])
                 self._finalize_entry(w, results)
             window.clear()
             return
@@ -381,8 +595,16 @@ class GraphExecutor:
             i for i, w in enumerate(window)
             if w["flag"] is not None and bool(w["flag"])
         )
+        # entries at/after the pivot hold garbage: record counts only
+        # for the clean prefix and purge any stale count the redo's
+        # overflow-free stages won't overwrite
         for w in window[:bad]:
+            if id(w) in count_of:
+                self._record_observed(w["stage"], count_of[id(w)])
             self._finalize_entry(w, results)
+        for w in window[bad:]:
+            for i in range(len(w["stage"].out_slots)):
+                self._observed_rows.pop((w["stage"].id, i), None)
         redo = window[bad:]
         window.clear()
         first = redo[0]
@@ -417,7 +639,8 @@ class GraphExecutor:
         )
         if _stage_has_miss_guard(stage):
             self._pending_miss.append((stage.name, w["miss"]))
-        self._save_checkpoint(stage, w["fp"], w["outs"])
+        if not w.get("fan"):  # adapted layouts never persist (see sync)
+            self._save_checkpoint(stage, w["fp"], w["outs"])
 
     def _save_checkpoint(self, stage, fp, outs) -> None:
         """Shared checkpoint save (sync + deferred paths).  Stages with
@@ -520,6 +743,23 @@ class GraphExecutor:
         can_overflow = any(
             op.kind not in NON_OVERFLOW_OPS for op in stage.ops
         )
+        adapt_fan = self._adapt_fan_for(stage)
+        if adapt_fan:
+            self.events.emit(
+                "stage_width_adapt", stage=stage.id, name=stage.name,
+                nparts=adapt_fan, of=self.P,
+                observed_rows=sum(
+                    self._observed_rows.get((r, i), 0)
+                    for r, i in stage.input_refs
+                ),
+            )
+        # counts ride readbacks that happen anyway: the sync overflow
+        # flag, or the window's batched drain (where even overflow-free
+        # stages' counts are free); only the async non-window path
+        # never pays a readback for them
+        want_count = stage.id in self._count_wanted and (
+            can_overflow or bool(window)
+        )
         boost = boost0
         failures = 0
         version = 0
@@ -531,13 +771,26 @@ class GraphExecutor:
             t0 = time.time()
             try:
                 faults.registry.maybe_fail(stage.name)
-                fn = self._get_compiled(stage, boost, shape_key)
+                # escalated boosts drop the reduced width first: the
+                # concentration itself may be what overflowed
+                fn = self._get_compiled(
+                    stage, boost, shape_key,
+                    fan=adapt_fan if boost < 4 else None,
+                )
                 # Per-stage step marker: stages show up as named steps in
                 # the XLA profiler timeline (SURVEY 5.1).
                 with jax.profiler.StepTraceAnnotation(
                     stage.name, step_num=version
                 ):
                     outs, (overflow, dict_miss) = fn(inputs, ())
+                    counts_dev = None
+                    if want_count:
+                        import jax.numpy as jnp
+
+                        counts_dev = [
+                            jnp.sum(outs[i].valid)
+                            for i in range(len(stage.out_slots))
+                        ]
                     if window is not None and (can_overflow or window):
                         # Speculative dispatch: publish the optimistic
                         # results so downstream stages can dispatch too,
@@ -553,6 +806,8 @@ class GraphExecutor:
                             stage=stage, version=version, boost=boost,
                             fp=fp, flag=overflow if can_overflow else None,
                             miss=dict_miss, outs=outs, t0=t0,
+                            counts=counts_dev,
+                            fan=adapt_fan if boost < 4 else None,
                         ))
                         self.events.emit(
                             "stage_dispatched", stage=stage.id,
@@ -565,7 +820,15 @@ class GraphExecutor:
                     # and JAX async dispatch overlaps this stage's
                     # device time with independent stages (the GM
                     # message-pump concurrency, DrMessagePump.h:116).
-                    overflow = bool(overflow) if can_overflow else False
+                    if can_overflow and counts_dev is not None:
+                        # ONE readback for flag + observed counts
+                        overflow, host_counts = jax.device_get(
+                            (overflow, counts_dev)
+                        )
+                        overflow = bool(overflow)
+                        self._record_observed(stage, host_counts)
+                    else:
+                        overflow = bool(overflow) if can_overflow else False
             except faults.InjectedStageFailure as e:
                 failures += 1
                 self.events.emit(
@@ -628,7 +891,11 @@ class GraphExecutor:
                 self._pending_miss.append((stage.name, dict_miss))
             for i, out_idx in enumerate(range(len(stage.out_slots))):
                 results[(stage.id, out_idx)] = outs[i]
-            self._save_checkpoint(stage, fp, outs)
+            # a fan-adapted run's outputs sit in a reduced-width layout
+            # the fingerprint doesn't describe — never persist them
+            # under the full-width identity
+            if not (adapt_fan and boost < 4):
+                self._save_checkpoint(stage, fp, outs)
             return
 
     def _run_do_while(

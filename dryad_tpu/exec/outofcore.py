@@ -78,6 +78,16 @@ class _IngestScope:
         self.vocab: Dict[str, np.ndarray] = {}
         self.stats: Dict[str, Tuple[int, int]] = {}
 
+    def _fit_cap(self, n: int, P: int) -> int:
+        if self.cap is None or n > self.cap * P:
+            self.cap = max(1, math.ceil(n / P / 8) * 8)
+        return self.cap
+
+    def _widen_vocab(self, col: str, v: np.ndarray) -> np.ndarray:
+        prev = self.vocab.get(col)
+        self.vocab[col] = v if prev is None else np.union1d(prev, v)
+        return self.vocab[col]
+
     def ingest(self, table: Dict[str, np.ndarray], schema: Schema):
         ctx = self.ctx
         from dryad_tpu.parallel.mesh import num_partitions
@@ -86,20 +96,13 @@ class _IngestScope:
         if is_physical_chunk(table, schema):
             return self._ingest_physical(table, schema, P)
         n = len(next(iter(table.values()))) if table else 0
-        if self.cap is None or n > self.cap * P:
-            self.cap = max(1, math.ceil(n / P / 8) * 8)
+        self._fit_cap(n, P)
         q = ctx.from_arrays(table, schema=schema, partition_capacity=self.cap)
         node = q.node
         # widen auto-dense metadata to the stream scope
         sv = node.params.get("str_vocab") or {}
         for col, vocab in sv.items():
-            prev = self.vocab.get(col)
-            merged = (
-                vocab if prev is None
-                else np.union1d(prev, vocab)
-            )
-            self.vocab[col] = merged
-            sv[col] = merged
+            sv[col] = self._widen_vocab(col, vocab)
         cs = node.params.get("col_stats") or {}
         for col, (mn, mx) in cs.items():
             if col in self.stats:
@@ -121,11 +124,9 @@ class _IngestScope:
         ctx = self.ctx
         vocab = table.pop("#vocab", None) or {}
         for col, v in vocab.items():
-            prev = self.vocab.get(col)
-            self.vocab[col] = v if prev is None else np.union1d(prev, v)
+            self._widen_vocab(col, v)
         n = len(next(iter(table.values()))) if table else 0
-        if self.cap is None or n > self.cap * P:
-            self.cap = max(1, math.ceil(n / P / 8) * 8)
+        self._fit_cap(n, P)
         node = Node(
             "input", [], schema, PartitionInfo.roundrobin(),
             source="host_physical",

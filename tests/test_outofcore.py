@@ -327,3 +327,18 @@ def test_stream_local_debug_clear_error():
     q = c.from_stream(iter([{"x": np.arange(4, dtype=np.int32)}]))
     with pytest.raises(RuntimeError, match="local_debug"):
         q.collect()
+
+
+def test_stream_physical_with_checkpoints(tmp_path):
+    """Checkpointed streaming-text run: the host_physical 3-tuple
+    binding must fingerprint cleanly (code-review r5)."""
+    from dryad_tpu import DryadConfig, DryadContext
+
+    cfg = DryadConfig(checkpoint_dir=str(tmp_path / "ckpt"))
+    ctx = DryadContext(num_partitions_=8, config=cfg)
+    p = tmp_path / "c.txt"
+    p.write_text("a b a c a b " * 500)
+    out = (ctx.text_stream(str(p), chunk_bytes=512)
+           .group_by("word", {"c": ("count", None)}).collect())
+    got = {str(w): int(c) for w, c in zip(out["word"], out["c"])}
+    assert got == {"a": 1500, "b": 1000, "c": 500}
