@@ -439,6 +439,11 @@ class GraphExecutor:
             return False
         if not self._adaptable(stage):
             return False
+        if not all(
+            self._adapt_safe.get((stage.id, i), True)
+            for i in range(len(stage.out_slots))
+        ):
+            return False  # a consumer pinned this stage to full width
         in_window = {w["stage"].id: w for w in window}
         shrinker = False
         for ref, idx in stage.input_refs:
@@ -482,11 +487,12 @@ class GraphExecutor:
             if c is None:
                 return None
             total += c
-        if total > limit:
+        from dryad_tpu.plan.lower import tail_width
+
+        w = tail_width(total, self.config, self.P)
+        if w is None:
             return None
-        per = max(1, getattr(self.config, "tail_rows_per_partition", 512))
-        fan = max(1, -(-total // per))
-        fan = 1 << (fan - 1).bit_length()  # pow2 palette for cache reuse
+        fan = 1 << (w - 1).bit_length()  # pow2 palette for cache reuse
         return fan if fan < self.P else None
 
     def _raise_miss(self, name: str, m: int) -> None:

@@ -32,13 +32,24 @@ except ImportError:  # pragma: no cover - cloudpickle present in-tree
 PACKAGE_VERSION = 1
 
 
-def pack_query(query, path: str) -> Dict[str, Any]:
+def pack_query(
+    query, path: str, binding_overrides: Optional[Dict[int, tuple]] = None
+) -> Dict[str, Any]:
     """Serialize a lazy Query (plan + reachable input bindings +
-    dictionary + config) to ``path``.  Returns the manifest summary."""
+    dictionary + config) to ``path``.  Returns the manifest summary.
+
+    ``binding_overrides``: node id -> replacement binding shipped in
+    place of the context's (the driver-routed ``host_routed`` layouts
+    of co-partitioned vertex submissions) — the live context's
+    bindings stay untouched."""
     ctx = query.ctx
     nodes = walk([query.node])
     bindings: Dict[int, tuple] = {}
+    overrides = binding_overrides or {}
     for n in nodes:
+        if n.id in overrides:
+            bindings[n.id] = overrides[n.id]
+            continue
         if n.id in ctx._bindings:
             kind = ctx._bindings[n.id][0]
             if kind == "device":
@@ -107,8 +118,19 @@ def slice_binding(binding: tuple, part: int, nparts: int) -> tuple:
              for k, v in arrays.items()},
             None,
         )
+    if kind == "host_routed":
+        # driver-routed layout: rows pre-ordered by key bucket, part p
+        # owns [offsets[p], offsets[p+1]) — the co-partitioned input
+        # channels of a routed join/sort vertex submission
+        arrays, offsets = rest
+        lo, hi = int(offsets[part]), int(offsets[part + 1])
+        return (
+            "host",
+            {k: np.asarray(v)[lo:hi] for k, v in arrays.items()},
+            None,
+        )
     if kind == "host_physical":
-        (phys,) = rest
+        phys, *opt = rest
         return (
             "host_physical",
             {k: np.array_split(np.asarray(v), nparts)[part]

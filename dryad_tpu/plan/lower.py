@@ -64,6 +64,24 @@ class StageGraph:
     inputs: Dict[int, Node]
 
 
+def tail_width(rows, config, P) -> Optional[int]:
+    """ceil(rows / tail_rows_per_partition) when ``rows`` is at or
+    below the tail threshold; None = full width.  A result at or above
+    the mesh width ``P`` (when known) is no reduction at all, and
+    returning it would needlessly mark the node reduced (forcing joins
+    to re-exchange a correctly co-partitioned side).  ONE sizing policy
+    for both the static estimator and the runtime observed-volume
+    adapter (``exec.executor``)."""
+    limit = getattr(config, "tail_fanout_rows", 4096)
+    if not limit or rows is None or rows > limit:
+        return None
+    per = max(1, getattr(config, "tail_rows_per_partition", 512))
+    nparts = max(1, -(-rows // per))
+    if P is not None and nparts >= P:
+        return None
+    return nparts
+
+
 class _Builder:
     def __init__(self, config, dictionary=None, P: Optional[int] = None) -> None:
         self.config = config
@@ -137,23 +155,11 @@ class _Builder:
         return None
 
     def _tail_nparts(self, src: Node) -> Optional[int]:
-        """ceil(bounded rows / tail_rows_per_partition) when the source
-        is statically tiny — the masked-partition fan-out for the
-        consumer exchange; None = full width.  A result at or above the
-        mesh width ``self.P`` (when known) is no reduction at all, and
-        returning it would needlessly mark the node reduced (forcing
-        joins to re-exchange a correctly co-partitioned side)."""
-        limit = getattr(self.config, "tail_fanout_rows", 4096)
-        if not limit:
-            return None
-        est = self.est.get(src.id)
-        if est is None or est > limit:
-            return None
-        per = max(1, getattr(self.config, "tail_rows_per_partition", 512))
-        nparts = max(1, -(-est // per))
-        if self.P is not None and nparts >= self.P:
-            return None
-        return nparts
+        """Masked-partition fan-out for the consumer exchange when the
+        source is statically tiny; None = full width (see
+        :func:`tail_width` — shared with the runtime observed-volume
+        adapter)."""
+        return tail_width(self.est.get(src.id), self.config, self.P)
 
     # -- stage bookkeeping -------------------------------------------------
     def _new_stage(self, name: str, input_refs: List[Tuple[Any, int]]) -> Stage:

@@ -130,11 +130,8 @@ def test_exchange_plan_rejected(submission):
     are gang-SPMD jobs; partitioned submission must refuse them rather
     than compute wrong per-partition results."""
     ctx = DryadContext(num_partitions_=1)
-    q = ctx.from_arrays({"k": np.arange(8, dtype=np.int32)}).order_by(
-        [("k", False)]
-    )
-    with pytest.raises(ValueError, match="exchange-free"):
-        submission.submit_partitioned(q)
+    # (an order_by over a host input now ROUTES instead of rejecting —
+    # see test_routed_order_by_as_vertex_tasks)
     # a Decomposable group_by has no driver-mergeable partial form
     import jax.numpy as jnp
 
@@ -150,7 +147,7 @@ def test_exchange_plan_rejected(submission):
         {"k": np.arange(8, dtype=np.int32),
          "v": np.ones(8, np.float32)}
     ).group_by("k", decomposable=dec)
-    with pytest.raises(ValueError, match="exchange-free"):
+    with pytest.raises(ValueError, match="use submit"):
         submission.submit_partitioned(q2)
 
 
@@ -335,3 +332,119 @@ def test_partitioned_decomposable_partials(submission):
         )
     kinds = [e["kind"] for e in submission.events.events()]
     assert "vertex_partials_merged" in kinds
+
+
+def _join_queries():
+    rng = np.random.default_rng(5)
+    L = {"k": rng.integers(0, 200, 5000).astype(np.int32),
+         "a": rng.integers(0, 9, 5000).astype(np.int32)}
+    R = {"k": rng.integers(0, 200, 1500).astype(np.int32),
+         "b": rng.integers(0, 9, 1500).astype(np.int32)}
+    ctx = DryadContext(num_partitions_=1)
+    q = ctx.from_arrays(L).join(ctx.from_arrays(R), ["k"], ["k"])
+    import collections
+    ridx = collections.defaultdict(list)
+    for kk, bb in zip(R["k"].tolist(), R["b"].tolist()):
+        ridx[kk].append(bb)
+    exp = sorted((kk, aa, bb) for kk, aa in zip(L["k"].tolist(),
+                                                L["a"].tolist())
+                 for bb in ridx.get(kk, []))
+    return q, exp
+
+
+def test_routed_join_as_vertex_tasks(submission):
+    """A shuffle-bearing JOIN runs as independent vertex tasks: the
+    driver co-partitions both host inputs by key hash (the reference
+    speculates every vertex kind — DrStageManager.h:156,
+    DrVertex.cpp:444 — not just maps)."""
+    q, exp = _join_queries()
+    out = submission.submit_partitioned(q, nparts=4)
+    got = sorted(zip(out["k"].tolist(), out["a"].tolist(),
+                     out["b"].tolist()))
+    assert got == exp
+    evs = [e for e in submission.events.events()
+           if e["kind"] == "vertex_routed"]
+    assert evs and evs[-1]["plan_kind"] == "join"
+
+
+def test_routed_join_straggler_duplicated(submission):
+    """Speculation covers the routed join: a stalled worker's join
+    vertex gets duplicated and the fast worker wins."""
+    q, exp = _join_queries()
+    submission.submit_partitioned(q, nparts=6)  # warm caches
+
+    # join vertices run ~1s each on this host, so the stall must
+    # dominate task time for the bypass to be provable
+    stall = 20.0
+    submission.inject_delay(worker=0, seconds=stall, count=1)
+    t0 = time.monotonic()
+    out = submission.submit_partitioned(q, nparts=6)
+    dt = time.monotonic() - t0
+    got = sorted(zip(out["k"].tolist(), out["a"].tolist(),
+                     out["b"].tolist()))
+    assert got == exp
+    assert dt < stall - 2.0, f"join job took {dt:.1f}s"
+    kinds = [e["kind"] for e in submission.events.events()]
+    assert "vertex_duplicate" in kinds and "vertex_duplicate_win" in kinds
+
+
+def test_routed_order_by_as_vertex_tasks(submission):
+    """order_by runs as route-at-driver + sort-at-vertex tasks:
+    driver-sampled splitters range-partition the input
+    (DryadLinqSampler.cs:38-42 at the driver), parts concatenate in
+    sort order."""
+    rng = np.random.default_rng(6)
+    T = {"x": rng.integers(0, 10 ** 6, 6000).astype(np.int32),
+         "y": rng.integers(0, 50, 6000).astype(np.int32)}
+    ctx = DryadContext(num_partitions_=1)
+    q = ctx.from_arrays(T).order_by([("x", True), "y"])
+    out = submission.submit_partitioned(q, nparts=4)
+    exp = sorted(zip(T["x"].tolist(), T["y"].tolist()),
+                 key=lambda t: (-t[0], t[1]))
+    assert list(zip(out["x"].tolist(), out["y"].tolist())) == exp
+    evs = [e for e in submission.events.events()
+           if e["kind"] == "vertex_routed"]
+    assert evs[-1]["plan_kind"] == "order_by"
+
+
+def test_routed_join_with_terminal_partial_group(submission):
+    """Routing composes with the terminal partial-group rewrite: join
+    vertices emit per-partition partials, the driver merges."""
+    q, exp = _join_queries()
+    import collections
+    q2 = q.group_by("k", {"c": ("count", None)})
+    out = submission.submit_partitioned(q2, nparts=4)
+    expc = collections.Counter(kk for kk, _a, _b in exp)
+    got = {int(k): int(c) for k, c in zip(out["k"], out["c"])}
+    assert got == dict(expc)
+
+
+def test_unroutable_plan_still_rejected(submission):
+    """select may rewrite join keys, so it blocks routing: the clear
+    error stays."""
+    rng = np.random.default_rng(7)
+    ctx = DryadContext(num_partitions_=1)
+    L = ctx.from_arrays({"k": rng.integers(0, 9, 100).astype(np.int32)})
+    R = ctx.from_arrays({"k": rng.integers(0, 9, 50).astype(np.int32),
+                         "b": np.arange(50, dtype=np.int32)})
+    q = L.select(_twice).join(R, ["k"], ["k"])
+    with pytest.raises(ValueError, match="use submit"):
+        submission.submit_partitioned(q, nparts=4)
+
+
+def _twice(cols):
+    return {"k": cols["k"] * 2}
+
+
+def test_self_join_different_keys_not_routed(submission):
+    """A self-join on different key columns cannot ship two routings
+    for one input node — it must fall back with the clear error, not
+    silently drop matches (code-review r5)."""
+    ctx = DryadContext(num_partitions_=1)
+    t = ctx.from_arrays({
+        "src": np.array([1, 2, 3, 1], np.int32),
+        "dst": np.array([2, 3, 1, 3], np.int32),
+    })
+    q = t.join(t, ["src"], ["dst"], suffix="_r")
+    with pytest.raises(ValueError, match="use submit"):
+        submission.submit_partitioned(q, nparts=4)
