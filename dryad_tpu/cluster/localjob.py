@@ -651,6 +651,19 @@ class LocalJobSubmission:
             if op.kind not in self._PARTITIONED_OPS
         ]
         if bad_all:
+            # routed slices order by key hash, not engine order — a
+            # terminal partial merge containing "first" would return a
+            # hash-assignment-dependent value (the r4 guard's exact
+            # failure mode), so such plans keep the gang path
+            if merge is not None and any(
+                op == "first" for _o, op, _p in merge[2]
+            ):
+                raise ValueError(
+                    "partitioned submission cannot route a plan whose "
+                    "terminal aggregate uses 'first' (routing reorders "
+                    "rows, making 'first' nparts-dependent) — use "
+                    "submit()"
+                )
             # shuffle-bearing plan: qualify anyway when the driver can
             # make its exchanges partition-local by ROUTING the host
             # inputs (co-partitioned join sides; range-routed sort) —

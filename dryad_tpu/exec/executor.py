@@ -365,6 +365,14 @@ class GraphExecutor:
                     if ref != "plan_input":
                         self._count_wanted.add(ref)
 
+    def _consumers_allow_adapt(self, stage: Stage) -> bool:
+        """Every consumer of this stage's outputs re-routes them
+        through a leading exchange (missing key = no consumers)."""
+        return all(
+            self._adapt_safe.get((stage.id, i), True)
+            for i in range(len(stage.out_slots))
+        )
+
     @staticmethod
     def _slot_reroutes(stage: Stage, slot: int) -> bool:
         """True when the first op touching ``slot`` is an exchange —
@@ -439,10 +447,7 @@ class GraphExecutor:
             return False
         if not self._adaptable(stage):
             return False
-        if not all(
-            self._adapt_safe.get((stage.id, i), True)
-            for i in range(len(stage.out_slots))
-        ):
+        if not self._consumers_allow_adapt(stage):
             return False  # a consumer pinned this stage to full width
         in_window = {w["stage"].id: w for w in window}
         shrinker = False
@@ -473,11 +478,7 @@ class GraphExecutor:
             return None
         if not self._adaptable(stage):
             return None
-        # every consumer of THIS stage must re-route its output
-        if not all(
-            self._adapt_safe.get((stage.id, i), True)
-            for i in range(len(stage.out_slots))
-        ):
+        if not self._consumers_allow_adapt(stage):
             return None
         total = 0
         for ref, idx in stage.input_refs:

@@ -448,3 +448,17 @@ def test_self_join_different_keys_not_routed(submission):
     q = t.join(t, ["src"], ["dst"], suffix="_r")
     with pytest.raises(ValueError, match="use submit"):
         submission.submit_partitioned(q, nparts=4)
+
+
+def test_routed_plan_with_first_agg_rejected(submission):
+    """Routing reorders rows by key hash; a terminal 'first' aggregate
+    would become nparts-dependent — refuse loudly (code-review r5)."""
+    rng = np.random.default_rng(8)
+    ctx = DryadContext(num_partitions_=1)
+    L = ctx.from_arrays({"k": rng.integers(0, 9, 200).astype(np.int32),
+                         "g": rng.integers(0, 3, 200).astype(np.int32),
+                         "v": rng.random(200).astype(np.float32)})
+    R = ctx.from_arrays({"k": np.arange(9, dtype=np.int32)})
+    q = L.join(R, ["k"], ["k"]).group_by("g", {"f": ("first", "v")})
+    with pytest.raises(ValueError, match="first"):
+        submission.submit_partitioned(q, nparts=4)

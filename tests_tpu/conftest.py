@@ -71,6 +71,26 @@ def pytest_collection_modifyitems(config, items):
         return  # LAZY: never pay the probe unless tests_tpu was collected
     platform = _probe_backend()
     if platform in ("tpu", "axon"):
+        # share the bench's persistent compile cache: through the
+        # tunnel each program costs 15-60s to compile, and the bench
+        # children have usually compiled these shapes already
+        try:
+            import jax
+
+            jax.config.update(
+                "jax_compilation_cache_dir",
+                os.environ.get(
+                    "DRYAD_BENCH_JAX_CACHE", "/tmp/dryad_jax_cache"
+                ),
+            )
+            jax.config.update(
+                "jax_persistent_cache_min_entry_size_bytes", -1
+            )
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.0
+            )
+        except Exception:  # noqa: BLE001
+            pass
         return
     skip = pytest.mark.skip(
         reason=f"no TPU backend reachable (probe: {platform})"
