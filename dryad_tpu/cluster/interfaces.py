@@ -114,7 +114,10 @@ class ClusterProcess:
 
 
 class Scheduler:
-    """The IScheduler contract (``Interfaces.cs:467-545``)."""
+    """The IScheduler contract (``Interfaces.cs:467-545``), extended
+    with the machine-level failure accounting of the reference GM
+    (computers producing repeated failures are blacklisted so retries
+    land elsewhere; re-admitted on probation after a cooldown)."""
 
     def schedule(self, process: ClusterProcess) -> None:
         raise NotImplementedError
@@ -130,3 +133,12 @@ class Scheduler:
 
     def computers(self) -> List[Computer]:
         raise NotImplementedError
+
+    # -- failure accounting / quarantine (optional; default no-op) -----------
+    def record_failure(self, computer: str) -> None:
+        """Attribute one failure to ``computer`` (implementations keep
+        a sliding window and quarantine past a threshold)."""
+
+    def quarantined(self) -> List[str]:
+        """Names of computers currently receiving no new dispatches."""
+        return []

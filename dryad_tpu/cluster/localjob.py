@@ -52,6 +52,13 @@ from dryad_tpu.columnar.io import parse_partition_bytes
 from dryad_tpu.columnar.schema import StringDictionary
 from dryad_tpu.exec import partial as _partial
 from dryad_tpu.exec.events import EventLog
+from dryad_tpu.exec.failure import (
+    Attempt,
+    FailureKind,
+    JobFailedError,
+    RetryPolicy,
+    classify,
+)
 from dryad_tpu.exec.jobpackage import pack_query
 from dryad_tpu.exec.stats import StageStatistics
 from dryad_tpu.utils.logging import get_logger
@@ -230,12 +237,14 @@ class LocalJobSubmission:
         self.advertise = advertise_host or "127.0.0.1"
         self.service = ProcessService(self.root, host=bind_host)
         self.launcher = launcher or SubprocessLauncher()
+        self.events = EventLog(os.path.join(self.root, "events.jsonl"))
         # Computers register on ANNOUNCE (elastic membership), not at
         # construction — a late worker's slot must not accept tasks
-        # that would stall until it exists.
-        self.scheduler = LocalScheduler([])
+        # that would stall until it exists.  The scheduler shares the
+        # submission's event log so quarantine transitions land in the
+        # same stream jobview folds.
+        self.scheduler = LocalScheduler([], events=self.events)
         self._client = ServiceClient("127.0.0.1", self.service.port)
-        self.events = EventLog(os.path.join(self.root, "events.jsonl"))
         self._cp = ControlPlane(self.job_id, -1, mailbox=self.service.mailbox)
         self._status_ver: Dict[int, int] = {}
         # per-plan-signature duration models: the outlier fit assumes
@@ -734,7 +743,13 @@ class LocalJobSubmission:
         tasks: Dict[int, Dict] = {}
         for part in range(nparts):
             p = make_proc(part, 0)
-            tasks[part] = {"procs": [p], "dup": False}
+            tasks[part] = {
+                "procs": [p], "dup": False,
+                # failure-domain bookkeeping: Attempt history, proc ids
+                # already folded into it, and the backoff gate for the
+                # next re-execution (None = no retry pending)
+                "attempts": [], "seen": set(), "retry_at": None,
+            }
             self.scheduler.schedule(p)
 
         pending = set(range(nparts))
@@ -742,7 +757,10 @@ class LocalJobSubmission:
         # sequential waves; every wave gets the per-command budget.
         waves = -(-nparts // max(self.n, 1))
         deadline = time.monotonic() + self.timeout * waves + 30.0
-        max_attempts = 3  # versioned re-execution budget (DrVertexRecord)
+        # versioned re-execution budget (DrVertexRecord) + exponential
+        # backoff with seeded jitter between transient re-executions
+        policy = RetryPolicy(max_attempts=3)
+        max_attempts = policy.max_attempts
         try:
             while pending:
                 self._reap_dead_workers()
@@ -779,26 +797,84 @@ class LocalJobSubmission:
                         p.state in (PS.FAILED, PS.CANCELED)
                         for p in t["procs"]
                     ):
-                        # Independent re-executable vertex: a failed
-                        # attempt re-runs (on a surviving worker) up to
-                        # the version budget (DrVertex.cpp:531
-                        # InstantiateVersion; failure budget DrGraph.h:42).
-                        if len(t["procs"]) < max_attempts:
-                            self.events.emit(
-                                "vertex_retry", part=part,
-                                attempt=len(t["procs"]) + 1,
-                            )
-                            np_ = make_proc(part, len(t["procs"]))
-                            t["procs"].append(np_)
-                            self.scheduler.schedule(np_)
+                        # Independent re-executable vertex: a TRANSIENT
+                        # failure re-runs (on a surviving worker, after
+                        # a seeded backoff) up to the version budget
+                        # (DrVertex.cpp:531 InstantiateVersion; failure
+                        # budget DrGraph.h:42).  A DETERMINISTIC repeat
+                        # — same exception class+message on a different
+                        # computer — fails fast with the history.
+                        if t["retry_at"] is not None:
+                            if time.monotonic() >= t["retry_at"]:
+                                t["retry_at"] = None
+                                np_ = make_proc(part, len(t["procs"]))
+                                t["procs"].append(np_)
+                                self.scheduler.schedule(np_)
                             continue
-                        errs = "; ".join(
-                            str(p.error) for p in t["procs"] if p.error
+                        for p in t["procs"]:
+                            if (
+                                p.state is PS.FAILED
+                                and p.error is not None
+                                and p.id not in t["seen"]
+                            ):
+                                t["seen"].add(p.id)
+                                kind = classify(
+                                    p.error, t["attempts"],
+                                    computer=p.computer,
+                                )
+                                t["attempts"].append(Attempt(
+                                    number=len(t["attempts"]) + 1,
+                                    error_type=type(p.error).__name__,
+                                    error=str(p.error),
+                                    kind=kind.value,
+                                    computer=p.computer,
+                                ))
+                        attempts = t["attempts"]
+                        deterministic = bool(attempts) and (
+                            attempts[-1].kind
+                            == FailureKind.DETERMINISTIC.value
                         )
-                        self.events.emit("vertex_job_failed", part=part)
-                        raise RuntimeError(
-                            f"vertex task {part} failed on all "
-                            f"{len(t['procs'])} attempts: {errs}"
+                        if deterministic or len(t["procs"]) >= max_attempts:
+                            self.events.emit(
+                                "vertex_job_failed", part=part,
+                                failure_kind=(
+                                    attempts[-1].kind if attempts
+                                    else FailureKind.TRANSIENT.value
+                                ),
+                            )
+                            why = (
+                                "failed deterministically (identical "
+                                "error on different computers; retrying "
+                                "cannot help)"
+                                if deterministic
+                                and len(t["procs"]) < max_attempts
+                                else f"failed on all {len(t['procs'])} "
+                                "attempts"
+                            )
+                            errs = "; ".join(
+                                str(p.error) for p in t["procs"] if p.error
+                            )
+                            raise JobFailedError(
+                                f"vertex task {part} {why}: {errs}",
+                                stage=f"part{part}", attempts=attempts,
+                            )
+                        backoff = policy.backoff(
+                            f"part{part}", len(attempts) or 1
+                        )
+                        if attempts:
+                            attempts[-1].backoff = backoff
+                        t["retry_at"] = time.monotonic() + backoff
+                        last = attempts[-1] if attempts else None
+                        self.events.emit(
+                            "vertex_retry", part=part,
+                            attempt=len(t["procs"]) + 1,
+                            backoff=round(backoff, 4),
+                            computer=last.computer if last else None,
+                            error=last.error if last else None,
+                            failure_kind=(
+                                last.kind if last
+                                else FailureKind.TRANSIENT.value
+                            ),
                         )
                     # Speculation: a RUNNING attempt past the outlier
                     # threshold gets one duplicate (CheckForDuplicates).

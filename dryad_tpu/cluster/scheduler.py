@@ -1,4 +1,5 @@
-"""LocalScheduler — affinity queues with delay-based locality relaxation.
+"""LocalScheduler — affinity queues with delay-based locality relaxation
+and machine-level failure quarantine.
 
 The analog of the reference scheduler (``LocalScheduler/LocalScheduler.cs``):
 processes queue at their preferred computer first, relax to the rack
@@ -8,6 +9,18 @@ queue after ``rack_delay`` seconds and to the cluster-wide queue after
 (``:149-160``).  Computer membership is elastic
 (``WaitForReasonableNumberOfComputers``, ``LocalScheduler.cs:88``).
 
+**Quarantine** (the Dryad machine-blacklist analog): every process
+failure is attributed to the computer it ran on in a sliding window;
+past ``quarantine_threshold`` failures the computer is quarantined —
+no new dispatches, and queued SOFT affinities relax away from it
+immediately.  A HARD affinity naming a quarantined computer still
+dispatches there: hard constraints never relax, and refusing them
+would deadlock gang commands that are pinned per-worker by design.
+After ``quarantine_cooldown`` the computer re-admits on **probation**:
+the first failure while on probation re-quarantines immediately; a
+success clears probation.  ``clock`` is injectable so the whole
+lifecycle is fake-time testable (no real sleeps).
+
 Worker slots are threads; a "process" is host-side work (stage
 materialization, ingest/egress, control) — see ``interfaces`` docstring.
 """
@@ -16,7 +29,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Set
 
 from dryad_tpu.cluster.interfaces import (
     Affinity,
@@ -25,15 +38,16 @@ from dryad_tpu.cluster.interfaces import (
     ProcessState,
     Scheduler,
 )
+from dryad_tpu.exec.stats import FailureWindow
 from dryad_tpu.utils.logging import get_logger
 
 log = get_logger("dryad_tpu.cluster")
 
 
 class _Entry:
-    def __init__(self, process: ClusterProcess):
+    def __init__(self, process: ClusterProcess, now: float):
         self.process = process
-        self.enqueued = time.monotonic()
+        self.enqueued = now
 
 
 class LocalScheduler(Scheduler):
@@ -43,14 +57,27 @@ class LocalScheduler(Scheduler):
         rack_delay: float = 1.0,
         cluster_delay: float = 2.0,
         poll_interval: float = 0.02,
+        quarantine_threshold: int = 3,
+        quarantine_window: float = 60.0,
+        quarantine_cooldown: float = 30.0,
+        clock=None,
+        events=None,
     ):
         self.rack_delay = rack_delay
         self.cluster_delay = cluster_delay
         self.poll_interval = poll_interval
+        self.quarantine_threshold = quarantine_threshold
+        self.quarantine_window = quarantine_window
+        self.quarantine_cooldown = quarantine_cooldown
+        self._clock = clock or time.monotonic
+        self._events = events  # optional EventLog
         self._lock = threading.Condition()
         self._computers: Dict[str, Computer] = {}
         self._busy: Dict[str, int] = {}  # computer -> running count
         self._queue: List[_Entry] = []  # single list; eligibility by age
+        self._failures: Dict[str, FailureWindow] = {}
+        self._quarantine: Dict[str, float] = {}  # name -> cooldown end
+        self._probation: Set[str] = set()
         self._stop = False
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, name="dryad-scheduler", daemon=True
@@ -60,6 +87,10 @@ class LocalScheduler(Scheduler):
             self._busy[c.name] = 0
         self._dispatcher.start()
 
+    def _emit(self, kind: str, **fields) -> None:
+        if self._events is not None:
+            self._events.emit(kind, **fields)
+
     # -- membership (elastic, Interfaces.cs:336-343) -------------------------
     def add_computer(self, computer: Computer) -> None:
         with self._lock:
@@ -68,8 +99,107 @@ class LocalScheduler(Scheduler):
             self._lock.notify_all()
 
     def remove_computer(self, name: str) -> None:
+        stranded: List[_Entry] = []
         with self._lock:
             self._computers.pop(name, None)
+            # a re-added computer of the same name is a fresh worker:
+            # its predecessor's failure history must not follow it
+            self._failures.pop(name, None)
+            self._quarantine.pop(name, None)
+            self._probation.discard(name)
+            # Fail fast queued processes whose HARD affinity named the
+            # removed computer and can no longer be satisfied by any
+            # remaining member — _eligible would never match a missing
+            # computer, leaving them queued until an external timeout.
+            for e in list(self._queue):
+                hard = [a for a in e.process.affinities if a.hard]
+                if not hard or not any(a.locality == name for a in hard):
+                    continue
+                if not any(
+                    self._hard_matches(a, c)
+                    for a in hard
+                    for c in self._computers.values()
+                ):
+                    self._queue.remove(e)
+                    stranded.append(e)
+        for e in stranded:
+            p = e.process
+            p.error = RuntimeError(
+                f"computer {name!r} removed from the cluster; process "
+                f"{p.name!r} holds a hard affinity "
+                f"{[a.locality for a in p.affinities if a.hard]} no "
+                f"remaining computer satisfies"
+            )
+            log.warning("%s", p.error)
+            self._emit(
+                "process_stranded", process=p.name, computer=name,
+            )
+            p._transition(ProcessState.FAILED)
+
+    def _hard_matches(self, a: Affinity, comp: Computer) -> bool:
+        """One hard affinity vs one computer (same rule as _eligible)."""
+        return a.locality == comp.name or (
+            a.locality not in self._computers and a.locality == comp.rack
+        )
+
+    # -- failure accounting / quarantine (machine blacklist analog) ----------
+    def record_failure(self, computer: str) -> None:
+        """Attribute one failure to ``computer``; quarantine past the
+        sliding-window threshold (probation failures re-quarantine at
+        once)."""
+        with self._lock:
+            self._note_failure_locked(computer)
+
+    def _note_failure_locked(self, name: str) -> None:
+        now = self._clock()
+        count = self._failures.setdefault(
+            name, FailureWindow(self.quarantine_window)
+        ).record(now)
+        if name in self._probation:
+            # a probation failure proves the cooldown solved nothing
+            self._probation.discard(name)
+            self._quarantine[name] = now + self.quarantine_cooldown
+            log.warning("computer %s re-quarantined on probation", name)
+            self._emit(
+                "computer_quarantined", computer=name, failures=count,
+                cooldown=self.quarantine_cooldown, probation=True,
+            )
+            return
+        if name not in self._quarantine and count >= self.quarantine_threshold:
+            self._quarantine[name] = now + self.quarantine_cooldown
+            log.warning(
+                "computer %s quarantined after %d failures in %.0fs",
+                name, count, self.quarantine_window,
+            )
+            self._emit(
+                "computer_quarantined", computer=name, failures=count,
+                cooldown=self.quarantine_cooldown, probation=False,
+            )
+
+    def _note_success_locked(self, name: str) -> None:
+        if name in self._probation:
+            self._probation.discard(name)
+            self._failures.pop(name, None)
+            log.info("computer %s readmitted after probation", name)
+            self._emit("computer_readmitted", computer=name)
+
+    def _quarantined_now_locked(self) -> Set[str]:
+        """Names currently quarantined; expired cooldowns move the
+        computer to probation as a side effect."""
+        now = self._clock()
+        out: Set[str] = set()
+        for name, until in list(self._quarantine.items()):
+            if now < until:
+                out.add(name)
+            else:
+                del self._quarantine[name]
+                self._probation.add(name)
+                self._emit("computer_probation", computer=name)
+        return out
+
+    def quarantined(self) -> List[str]:
+        with self._lock:
+            return sorted(self._quarantined_now_locked())
 
     def computers(self) -> List[Computer]:
         with self._lock:
@@ -90,7 +220,7 @@ class LocalScheduler(Scheduler):
     def schedule(self, process: ClusterProcess) -> None:
         with self._lock:
             process._transition(ProcessState.QUEUED)
-            self._queue.append(_Entry(process))
+            self._queue.append(_Entry(process, self._clock()))
             self._lock.notify_all()
 
     def cancel(self, process: ClusterProcess) -> None:
@@ -113,7 +243,7 @@ class LocalScheduler(Scheduler):
         c = self._computers.get(locality)
         return c.rack if c is not None else locality
 
-    def _eligible(self, entry: _Entry, comp: Computer) -> bool:
+    def _eligible(self, entry: _Entry, comp: Computer, quar: Set[str]) -> bool:
         affs = entry.process.affinities
         if not affs:
             return True
@@ -121,15 +251,15 @@ class LocalScheduler(Scheduler):
         if hard:
             # a hard computer constraint pins exactly that computer; a
             # hard rack constraint allows any computer in the rack
-            return any(
-                a.locality == comp.name
-                or (
-                    a.locality not in self._computers
-                    and a.locality == comp.rack
-                )
-                for a in hard
-            )
-        age = time.monotonic() - entry.enqueued
+            return any(self._hard_matches(a, comp) for a in hard)
+        # quarantined preferred localities drop out of the preference
+        # set entirely: the entry relaxes away from them IMMEDIATELY
+        # (waiting out rack/cluster delays for a blacklisted machine
+        # would just stall the retry the quarantine exists to re-place)
+        affs = [a for a in affs if a.locality not in quar]
+        if not affs:
+            return True
+        age = self._clock() - entry.enqueued
         # the preferred locality itself is served immediately: an exact
         # computer match, or a rack-level affinity naming this rack —
         # delays only gate *relaxation* away from the preference
@@ -145,6 +275,18 @@ class LocalScheduler(Scheduler):
             return True
         return age >= self.cluster_delay
 
+    def _dispatchable(self, entry: _Entry, comp: Computer, quar: Set[str]) -> bool:
+        """Quarantine gate ahead of affinity eligibility: a quarantined
+        computer receives no new dispatches — except for processes whose
+        HARD affinity pins them to it (hard constraints never relax;
+        refusing would deadlock per-worker gang commands)."""
+        if comp.name in quar and not any(
+            a.hard and self._hard_matches(a, comp)
+            for a in entry.process.affinities
+        ):
+            return False
+        return self._eligible(entry, comp, quar)
+
     def _pick(self) -> Optional[tuple]:
         """Find (entry, computer) to run; prefer older entries and their
         stronger (higher-weight) affinities."""
@@ -155,6 +297,7 @@ class LocalScheduler(Scheduler):
         ]
         if not idle:
             return None
+        quar = self._quarantined_now_locked()
         for entry in self._queue:  # FIFO
             affs = sorted(
                 entry.process.affinities, key=lambda a: -a.weight
@@ -162,14 +305,18 @@ class LocalScheduler(Scheduler):
             # strongest preference first: exact computer, then rack
             for a in affs:
                 for c in idle:
-                    if c.name == a.locality and self._eligible(entry, c):
+                    if c.name == a.locality and self._dispatchable(
+                        entry, c, quar
+                    ):
                         return entry, c
             for a in affs:
                 for c in idle:
-                    if c.rack == a.locality and self._eligible(entry, c):
+                    if c.rack == a.locality and self._dispatchable(
+                        entry, c, quar
+                    ):
                         return entry, c
             for c in idle:
-                if self._eligible(entry, c):
+                if self._dispatchable(entry, c, quar):
                     return entry, c
         return None
 
@@ -201,11 +348,19 @@ class LocalScheduler(Scheduler):
         except BaseException as e:  # noqa: BLE001 — report, don't die
             process.error = e
             log.warning("process %s failed on %s: %s", process.name, comp.name, e)
+            self._emit(
+                "process_failed", process=process.name,
+                computer=comp.name, error=str(e),
+            )
+            with self._lock:
+                self._note_failure_locked(comp.name)
             process._transition(ProcessState.FAILED)
         else:
             if process.cancelled:
                 process._transition(ProcessState.CANCELED)
             else:
+                with self._lock:
+                    self._note_success_locked(comp.name)
                 process._transition(ProcessState.COMPLETED)
         finally:
             with self._lock:

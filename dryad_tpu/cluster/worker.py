@@ -192,10 +192,15 @@ def main(argv=None) -> int:
     force_cpu_backend(args.devices_per_proc)
     import jax
 
-    try:
-        jax.config.update("jax_cpu_collectives_implementation", "gloo")
-    except Exception:
-        pass  # older jaxlib: single CPU collective impl
+    if args.nproc > 1:
+        # gloo needs the distributed client; a single-member gang never
+        # initializes one (init_distributed no-ops at nproc<=1), and
+        # some jaxlibs refuse gloo without it — so only select it when
+        # cross-process collectives will actually exist.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass  # older jaxlib: single CPU collective impl
 
     from dryad_tpu.parallel.multihost import ControlPlane, init_distributed
 

@@ -27,18 +27,25 @@ import hashlib
 import json
 import os
 import re
+import zlib
 from typing import Dict, Optional, Tuple
 
 import numpy as np
 
 from dryad_tpu.columnar.batch import ColumnBatch
 from dryad_tpu.columnar.io import read_partition_file, write_partition_file
+from dryad_tpu.exec import faults
+from dryad_tpu.exec.failure import CheckpointCorruptionError
 from dryad_tpu.plan.lower import Stage
 from dryad_tpu.utils.logging import get_logger
 
 log = get_logger("dryad_tpu.exec.checkpoint")
 
 _VALID = "__valid__"
+
+
+def _col_crc(a: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(a).tobytes()) & 0xFFFFFFFF
 
 
 def content_fingerprint(arrays: Dict[str, np.ndarray]) -> str:
@@ -87,8 +94,9 @@ def stage_fingerprint(
 class CheckpointStore:
     """Directory of per-stage materialized outputs, content-addressed."""
 
-    def __init__(self, root: str):
+    def __init__(self, root: str, events=None):
         self.root = root
+        self.events = events  # optional EventLog for integrity reports
         # Checkpoints touched (saved or loaded) by THIS run: exempt from
         # gc, so a retention lease shorter than the job's wall time can't
         # delete earlier stages of the running job out from under a
@@ -106,11 +114,17 @@ class CheckpointStore:
         d = self._dir(stage, fp)
         tmp = d + ".tmp"
         os.makedirs(tmp, exist_ok=True)
-        meta = {"outputs": len(outputs), "stage": stage.name}
+        meta = {"outputs": len(outputs), "stage": stage.name, "crc": {}}
         for i, b in enumerate(outputs):
             cols = {n: np.asarray(v) for n, v in b.data.items()}
             cols[_VALID] = np.asarray(b.valid)
             write_partition_file(os.path.join(tmp, f"out{i}.dpf"), cols)
+            # per-column CRC32 recorded at save, verified at load: a
+            # silently bit-rotted payload must fail loudly into the
+            # recompute path, never return corrupt data
+            meta["crc"][f"out{i}"] = {
+                n: _col_crc(a) for n, a in cols.items()
+            }
         with open(os.path.join(tmp, "meta.json"), "w") as fh:
             json.dump(meta, fh)
         # atomic publish: a partially-written checkpoint is never visible
@@ -120,6 +134,9 @@ class CheckpointStore:
             shutil.rmtree(d)
         os.replace(tmp, d)
         self._active.add(d)
+        # chaos hook: an installed FaultPlan may flip payload bytes in
+        # the published checkpoint (simulated bit rot)
+        faults.registry.maybe_corrupt_checkpoint(d)
         return d
 
     def gc(self, retain_seconds: float) -> int:
@@ -163,13 +180,45 @@ class CheckpointStore:
 
             sh = partition_sharding(mesh)
             outs = []
+            crcs = meta.get("crc", {})
             for i in range(meta["outputs"]):
                 cols = read_partition_file(os.path.join(d, f"out{i}.dpf"))
+                self._verify_crc(d, f"out{i}", cols, crcs.get(f"out{i}"))
                 valid = cols.pop(_VALID)
                 data = {n: jax.device_put(v, sh) for n, v in cols.items()}
                 outs.append(ColumnBatch(data, jax.device_put(valid, sh)))
             self._active.add(d)
             return tuple(outs)
+        except CheckpointCorruptionError as e:
+            # integrity failure is TRANSIENT: fall through to recompute,
+            # never serve corrupt data — but say so distinctly (bit rot
+            # is a different diagnosis than a torn write)
+            log.warning("checkpoint integrity failure: %s; recomputing", e)
+            if self.events is not None:
+                self.events.emit(
+                    "checkpoint_corrupt", stage=stage.id, name=stage.name,
+                    path=d, error=str(e),
+                )
+            return None
         except Exception as e:  # noqa: BLE001 — treat as cache miss
             log.warning("checkpoint %s unreadable (%s); recomputing", d, e)
             return None
+
+    @staticmethod
+    def _verify_crc(
+        d: str, out_name: str, cols: Dict[str, np.ndarray], expect
+    ) -> None:
+        """Compare read columns against the CRCs recorded at save.
+        Pre-CRC checkpoints (no ``crc`` in meta) load unverified."""
+        if not expect:
+            return
+        for n, a in cols.items():
+            want = expect.get(n)
+            if want is None:
+                continue
+            got = _col_crc(a)
+            if got != int(want):
+                raise CheckpointCorruptionError(
+                    f"column {n!r} of {d}/{out_name}.dpf: crc32 {got} != "
+                    f"recorded {int(want)}"
+                )

@@ -40,6 +40,13 @@ class EventLog:
         with self._lock:
             return list(self._mem)
 
+    def filter(self, *kinds: str) -> List[Dict[str, Any]]:
+        """Snapshot of events whose ``kind`` is one of ``kinds`` —
+        the recovery/chaos suites assert on specific transitions
+        (quarantine, retry, corruption) without refolding the stream."""
+        with self._lock:
+            return [e for e in self._mem if e["kind"] in kinds]
+
     def close(self) -> None:
         with self._lock:
             if self._fh:

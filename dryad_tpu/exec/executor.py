@@ -32,6 +32,14 @@ from dryad_tpu.columnar.batch import ColumnBatch
 from dryad_tpu.exec import faults
 from dryad_tpu.exec.checkpoint import CheckpointStore, stage_fingerprint
 from dryad_tpu.exec.events import EventLog
+from dryad_tpu.exec.failure import (
+    Attempt,
+    FailureKind,
+    JobFailedError,
+    RetryPolicy,
+    StageFailedError,
+    classify,
+)
 from dryad_tpu.exec.kernels import NON_OVERFLOW_OPS, build_stage_fn
 from dryad_tpu.exec.stats import StageStatistics
 from dryad_tpu.parallel.mesh import mesh_axes, num_partitions
@@ -108,8 +116,8 @@ class DeferredFinish:
         self._executor.events.emit("job_complete")
 
 
-class StageFailedError(RuntimeError):
-    pass
+# StageFailedError/JobFailedError live in exec.failure (imported above
+# and re-exported here for the existing call sites and tests).
 
 
 def _phys_np_dtype(col: str, schema):
@@ -156,10 +164,22 @@ class GraphExecutor:
         # persisted only after their counters drain clean
         self._pending_ckpt: List[Tuple[Any, Any, Any]] = []
         self.checkpoints = (
-            CheckpointStore(self.config.checkpoint_dir)
+            CheckpointStore(self.config.checkpoint_dir, events=self.events)
             if self.config.checkpoint_dir
             else None
         )
+        # Failure-domain retry policy (exec.failure): transient stage
+        # failures back off exponentially with seeded jitter under the
+        # per-stage budget; deterministic repeats fail fast.
+        self.retry_policy = RetryPolicy(
+            max_attempts=self.config.max_stage_failures,
+            backoff_base=self.config.retry_backoff_base,
+            backoff_max=self.config.retry_backoff_max,
+            jitter=self.config.retry_jitter,
+            seed=self.config.retry_seed,
+        )
+        # injectable sleep: backoff-timing tests record instead of wait
+        self._sleep: Callable[[float], None] = time.sleep
 
     # -- compilation cache -------------------------------------------------
     @staticmethod
@@ -770,6 +790,7 @@ class GraphExecutor:
         boost = boost0
         failures = 0
         version = 0
+        attempts: List[Attempt] = []  # failed-attempt history (post-mortem)
         while True:
             version += 1
             self.events.emit(
@@ -778,6 +799,13 @@ class GraphExecutor:
             t0 = time.time()
             try:
                 faults.registry.maybe_fail(stage.name)
+                inj_delay = faults.registry.maybe_delay(stage.name)
+                if inj_delay:
+                    self.events.emit(
+                        "stage_delay_injected", stage=stage.id,
+                        name=stage.name, seconds=inj_delay,
+                    )
+                    self._sleep(inj_delay)
                 # escalated boosts drop the reduced width first: the
                 # concentration itself may be what overflowed
                 fn = self._get_compiled(
@@ -836,19 +864,46 @@ class GraphExecutor:
                         self._record_observed(stage, host_counts)
                     else:
                         overflow = bool(overflow) if can_overflow else False
-            except faults.InjectedStageFailure as e:
+            except faults.InjectedFault as e:
                 failures += 1
+                kind = classify(e, attempts)
+                exhausted = self.retry_policy.exhausted(failures)
+                # deterministic repeats fail fast: identical class +
+                # message means elsewhere/later cannot help
+                terminal = exhausted or kind is FailureKind.DETERMINISTIC
+                backoff = (
+                    0.0 if terminal
+                    else self.retry_policy.backoff(stage.name, failures)
+                )
+                attempts.append(Attempt(
+                    number=version, error_type=type(e).__name__,
+                    error=str(e), kind=kind.value, backoff=backoff,
+                ))
                 self.events.emit(
                     "stage_failed", stage=stage.id, name=stage.name,
                     version=version, error=str(e), failures=failures,
+                    failure_kind=kind.value, backoff=round(backoff, 4),
                 )
-                if failures >= self.config.max_stage_failures:
-                    self.events.emit("job_failed", stage=stage.id, name=stage.name)
-                    raise StageFailedError(
-                        f"stage {stage.name!r} exceeded failure budget "
-                        f"({self.config.max_stage_failures}): {e}"
+                if terminal:
+                    self.events.emit(
+                        "job_failed", stage=stage.id, name=stage.name,
+                        failure_kind=kind.value,
+                    )
+                    why = (
+                        "failed deterministically (identical error "
+                        "reproduced; retrying cannot help)"
+                        if kind is FailureKind.DETERMINISTIC
+                        and not exhausted
+                        else "exceeded failure budget "
+                        f"({self.config.max_stage_failures})"
+                    )
+                    raise JobFailedError(
+                        f"stage {stage.name!r} {why}: {e}",
+                        stage=stage.name, attempts=attempts,
                     ) from e
-                continue  # versioned re-execution
+                if backoff:
+                    self._sleep(backoff)
+                continue  # versioned re-execution (with backoff)
 
             dt = time.time() - t0
             st.record(dt)

@@ -54,3 +54,31 @@ class StageStatistics:
     def is_outlier(self, seconds: float) -> bool:
         thr = self.outlier_threshold()
         return thr is not None and seconds > thr
+
+
+class FailureWindow:
+    """Sliding-window failure counter — the machine-level failure
+    accounting behind computer quarantine (the reference blacklists
+    computers whose recent failure count crosses a threshold,
+    ``DrGraph.h:42`` m_maxActiveFailureCount at machine scope).
+
+    Timestamps come from the caller's clock, so schedulers with an
+    injectable clock stay fully fake-time testable."""
+
+    def __init__(self, window_seconds: float):
+        self.window = float(window_seconds)
+        self._times: List[float] = []
+
+    def record(self, now: float) -> int:
+        """Record one failure at ``now``; returns the in-window count."""
+        self._times.append(float(now))
+        return self.count(now)
+
+    def count(self, now: float) -> int:
+        """Failures inside (now - window, now]; prunes expired entries."""
+        cutoff = float(now) - self.window
+        self._times = [t for t in self._times if t > cutoff]
+        return len(self._times)
+
+    def clear(self) -> None:
+        self._times.clear()

@@ -47,6 +47,10 @@ def force_cpu_backend(n_devices: int) -> None:
         # Backend already initialized; callers assert on the resulting
         # device count.
         pass
+    except AttributeError:
+        # Older jax without jax_num_cpu_devices: the XLA_FLAGS device
+        # count set above covers a fresh backend.
+        pass
 
 
 def make_mesh(num_partitions: Optional[int] = None) -> Mesh:

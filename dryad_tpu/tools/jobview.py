@@ -38,6 +38,13 @@ class StageInfo:
     # async dispatch (overflow-free stage): seconds is DISPATCH time;
     # device time overlapped downstream stages
     async_dispatch: bool = False
+    # per-attempt failure records ({version, kind, backoff, error})
+    # folded from stage_failed events — the DrVertexRecord version
+    # history, post-mortem
+    attempt_log: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list
+    )
+    checkpoint_corrupt: int = 0
 
 
 @dataclasses.dataclass
@@ -143,6 +150,14 @@ def _fold_job(events: List[Dict[str, Any]]) -> JobInfo:
             s = stage(ev)
             s.failures += 1
             s.last_error = ev.get("error")
+            s.attempt_log.append({
+                "version": ev.get("version", s.versions),
+                "kind": ev.get("failure_kind", "transient"),
+                "backoff": ev.get("backoff", 0.0),
+                "error": ev.get("error", ""),
+            })
+        elif kind == "checkpoint_corrupt":
+            stage(ev).checkpoint_corrupt += 1
         elif kind == "stage_overflow":
             stage(ev).overflows += 1
         elif kind == "stage_straggler":
@@ -188,10 +203,20 @@ def diagnose(job: JobInfo) -> List[str]:
         if not s.completed and job.failed:
             if s.failures:
                 why = f": {s.last_error}" if s.last_error else ""
+                det = (
+                    s.attempt_log
+                    and s.attempt_log[-1]["kind"] == "deterministic"
+                )
+                cause = (
+                    "deterministic failure (identical error reproduced; "
+                    "retrying elsewhere cannot help)"
+                    if det
+                    else "exceeded the failure budget "
+                    "(config.max_stage_failures)"
+                )
                 out.append(
                     f"stage {s.id} ({s.name}) FAILED after {s.failures} "
-                    f"attempt(s){why} — exceeded the failure budget "
-                    f"(config.max_stage_failures)"
+                    f"attempt(s){why} — {cause}"
                 )
             elif s.overflows:
                 out.append(
@@ -227,6 +252,13 @@ def diagnose(job: JobInfo) -> List[str]:
             out.append(
                 f"stage {s.id} ({s.name}) recovered after {s.failures} "
                 f"failure(s) via versioned re-execution"
+            )
+        if s.checkpoint_corrupt:
+            out.append(
+                f"stage {s.id} ({s.name}) hit {s.checkpoint_corrupt} "
+                f"corrupt checkpoint(s) — CRC mismatch detected at load; "
+                f"recomputed instead of serving corrupt data (check the "
+                f"checkpoint volume for bit rot)"
             )
     n_ckpt = sum(1 for s in job.stages.values() if s.from_checkpoint)
     if n_ckpt:
@@ -280,6 +312,17 @@ def render(job: JobInfo) -> str:
             f"splits={st.get('splits', 0)}  "
             f"combines={st.get('combines', 0)}"
         )
+    if any(s.attempt_log for s in job.stages.values()):
+        lines.append("-- attempt history --")
+        for s in sorted(job.stages.values(), key=lambda s: s.id):
+            for a in s.attempt_log:
+                wait = (
+                    f", backoff {a['backoff']:.3f}s" if a["backoff"] else ""
+                )
+                lines.append(
+                    f"  stage {s.id} ({s.name[:32]}) v{a['version']} "
+                    f"[{a['kind']}{wait}]: {a['error'][:90]}"
+                )
     lines.append("-- diagnosis --")
     lines.extend("  " + d for d in diagnose(job))
     return "\n".join(lines)
@@ -307,6 +350,10 @@ class VertexJobInfo:
     raw_bytes: int = 0
     workers_joined: int = 0
     workers_dead: int = 0
+    # part -> [{attempt, computer, error, backoff, kind}] retry records
+    attempt_log: Dict[int, List[Dict[str, Any]]] = dataclasses.field(
+        default_factory=dict
+    )
 
 
 def build_vertex_jobs(events: List[Dict[str, Any]]) -> List[VertexJobInfo]:
@@ -349,6 +396,13 @@ def build_vertex_jobs(events: List[Dict[str, Any]]) -> List[VertexJobInfo]:
         elif kind == "vertex_retry":
             cur.retries.append(ev["part"])
             cur.attempts[ev["part"]] = ev.get("attempt", 2)
+            cur.attempt_log.setdefault(ev["part"], []).append({
+                "attempt": ev.get("attempt", 2),
+                "computer": ev.get("computer"),
+                "error": ev.get("error") or "",
+                "backoff": ev.get("backoff", 0.0),
+                "kind": ev.get("failure_kind", "transient"),
+            })
         elif kind == "vertex_job_complete":
             cur.completed = True
         elif kind == "vertex_job_failed":
@@ -387,6 +441,92 @@ def render_vertex_job(j: VertexJobInfo) -> str:
             f"assemble: {j.raw_bytes} bytes decoded from {j.wire_bytes} "
             f"on the wire ({ratio:.1f}x compression)"
         )
+    if j.attempt_log:
+        lines.append("attempt history:")
+        for p in sorted(j.attempt_log):
+            for a in j.attempt_log[p]:
+                where = f" (prev on {a['computer']})" if a["computer"] else ""
+                wait = (
+                    f", backoff {a['backoff']:.3f}s" if a["backoff"] else ""
+                )
+                lines.append(
+                    f"  part {p} -> attempt {a['attempt']}{where} "
+                    f"[{a['kind']}{wait}]: {a['error'][:80]}"
+                )
+    return "\n".join(lines)
+
+
+# -- per-computer failure / quarantine summary ------------------------------
+
+@dataclasses.dataclass
+class ComputerHealth:
+    """Fold of one computer's failure accounting from the event stream
+    (the machine-blacklist story the reference GM keeps internally,
+    made post-mortem inspectable)."""
+
+    name: str
+    failures: int = 0
+    quarantines: int = 0
+    probations: int = 0
+    readmissions: int = 0
+    stranded: int = 0
+    last_error: Optional[str] = None
+    state: str = "ok"  # ok | quarantined | probation
+
+
+def build_computer_health(
+    events: List[Dict[str, Any]],
+) -> Dict[str, ComputerHealth]:
+    """Fold scheduler failure/quarantine events into per-computer
+    health records (``state`` is the LAST observed state)."""
+    out: Dict[str, ComputerHealth] = {}
+
+    def h(name: str) -> ComputerHealth:
+        return out.setdefault(name, ComputerHealth(name))
+
+    for ev in events:
+        kind = ev["kind"]
+        if kind == "process_failed":
+            c = h(ev.get("computer", "?"))
+            c.failures += 1
+            c.last_error = ev.get("error")
+        elif kind == "computer_quarantined":
+            c = h(ev["computer"])
+            c.quarantines += 1
+            c.state = "quarantined"
+        elif kind == "computer_probation":
+            c = h(ev["computer"])
+            c.probations += 1
+            c.state = "probation"
+        elif kind == "computer_readmitted":
+            c = h(ev["computer"])
+            c.readmissions += 1
+            c.state = "ok"
+        elif kind == "process_stranded":
+            h(ev.get("computer", "?")).stranded += 1
+    return out
+
+
+def render_computer_health(health: Dict[str, ComputerHealth]) -> str:
+    """Per-computer failure/quarantine table (empty string when the
+    stream carries no failure accounting)."""
+    if not health:
+        return ""
+    lines = [
+        "-- computer health --",
+        f"{'computer':<14} {'fail':>4} {'quar':>4} {'prob':>4} "
+        f"{'readm':>5}  state",
+    ]
+    for c in sorted(health.values(), key=lambda c: c.name):
+        line = (
+            f"{c.name:<14} {c.failures:>4} {c.quarantines:>4} "
+            f"{c.probations:>4} {c.readmissions:>5}  {c.state}"
+        )
+        if c.stranded:
+            line += f"  ({c.stranded} stranded)"
+        if c.last_error:
+            line += f"  last: {c.last_error[:60]}"
+        lines.append(line)
     return "\n".join(lines)
 
 
@@ -574,6 +714,9 @@ def fold_submission(
     if gang:
         parts.append("\n".join(_render_gang_run(r) for r in gang))
     parts.extend(render_vertex_job(vj) for vj in vjobs)
+    health = build_computer_health(events)
+    if health:
+        parts.append(render_computer_health(health))
     ok = all(r["completed"] for r in gang) and all(
         vj.completed for vj in vjobs
     )

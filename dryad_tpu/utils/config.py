@@ -90,6 +90,14 @@ class DryadConfig:
     # Outlier threshold in sigmas for speculative duplication
     # (reference DrStageStatistics.cpp:24-25: 3 sigma).
     outlier_sigmas: float = 3.0
+    # Retry backoff (exec.failure.RetryPolicy): transient stage/vertex
+    # failures wait base * 2^(failures-1) seconds (capped at max) plus
+    # seeded jitter before re-executing — a crashing dependency gets
+    # breathing room instead of an immediate retry storm.
+    retry_backoff_base: float = _env_float("DRYAD_TPU_RETRY_BACKOFF", 0.05)
+    retry_backoff_max: float = 2.0
+    retry_jitter: float = 0.5  # backoff *= 1 + jitter * U(0,1), seeded
+    retry_seed: int = _env_int("DRYAD_TPU_RETRY_SEED", 0)
     # Broadcast-join threshold: with strategy='auto', a right side whose
     # TOTAL row capacity (per-partition capacity x P) is at or below this
     # is replicated via all_gather instead of co-hash-partitioned (the
@@ -183,6 +191,14 @@ class DryadConfig:
             raise ValueError("max_stage_failures must be >= 1")
         if self.outlier_sigmas <= 0:
             raise ValueError("outlier_sigmas must be > 0")
+        if self.retry_backoff_base < 0:
+            raise ValueError("retry_backoff_base must be >= 0")
+        if self.retry_backoff_max < self.retry_backoff_base:
+            raise ValueError(
+                "retry_backoff_max must be >= retry_backoff_base"
+            )
+        if self.retry_jitter < 0:
+            raise ValueError("retry_jitter must be >= 0")
         if self.io_threads < 1:
             raise ValueError("io_threads must be >= 1")
         if self.rows_per_vertex < 1:

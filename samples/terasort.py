@@ -20,8 +20,9 @@ import jax
 # The CPU-mesh demo path: switch platform before the first backend
 # query (env alone can be too late when jax is pre-imported).
 if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    from dryad_tpu.parallel.mesh import force_cpu_backend
+
+    force_cpu_backend(8)
 
 import numpy as np
 

@@ -18,6 +18,18 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def clear_faults():
+    """Every test starts AND ends with an empty fault registry: an
+    injected fault (count-based or chaos FaultPlan) must never leak
+    into an unrelated test."""
+    from dryad_tpu.exec.faults import clear_faults as _clear
+
+    _clear()
+    yield
+    _clear()
+
+
 @pytest.fixture(scope="session")
 def mesh8():
     from dryad_tpu.parallel.mesh import make_mesh

@@ -1,0 +1,280 @@
+"""Seeded chaos differential suite.
+
+Fixed multi-stage pipelines run under an installed ``FaultPlan``
+(probabilistic stage failures + injected stage delays drawn from one
+seeded stream) and must still produce results bit-identical to the
+NumPy oracle, within bounded attempt counts — recovery is not allowed
+to change answers.  Plus the three directed scenarios the tentpole
+calls out: silent checkpoint corruption (CRC-detected, recomputed),
+a worker killed mid-vertex-job (re-execution on survivors), and a
+deterministic always-failing stage (fails fast inside the retry budget
+with the full attempt history attached).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dryad_tpu import DryadConfig, DryadContext
+from dryad_tpu.exec.failure import JobFailedError
+from dryad_tpu.exec.faults import (
+    FaultPlan,
+    install_plan,
+    set_fake_checkpoint_corruption,
+    set_fake_stage_failure,
+)
+from tests.oracle import check
+
+pytestmark = pytest.mark.chaos
+
+SEEDS = [0, 1, 2]
+
+# fast-retry config for chaos runs: the plan injects at most 2 failures
+# per stage, comfortably inside the 4-attempt budget, and the backoff
+# base keeps total injected wait time negligible
+CHAOS_CONFIG = dict(
+    max_stage_failures=4,
+    retry_backoff_base=0.002,
+    retry_backoff_max=0.02,
+)
+
+
+def _plan(seed: int) -> FaultPlan:
+    return FaultPlan(
+        seed=seed,
+        stage_failure_prob=0.25,
+        max_failures_per_stage=2,
+        stage_delay_prob=0.2,
+        stage_delay_seconds=0.005,
+    )
+
+
+def _data(n=800):
+    rng = np.random.default_rng(42)
+    return {
+        "k": rng.integers(0, 13, n).astype(np.int32),
+        "v": rng.standard_normal(n).astype(np.float32),
+    }
+
+
+def _pipeline_groupby_sort(ctx):
+    """group_by (sum, count) -> order_by: two exchanges."""
+    tbl = _data()
+    q = (
+        ctx.from_arrays(tbl)
+        .group_by("k", {"s": ("sum", "v"), "n": ("count", None)})
+        .order_by([("s", True)])
+    )
+    ks = np.unique(tbl["k"])
+    expected = {
+        "k": ks,
+        "s": np.array(
+            [tbl["v"][tbl["k"] == k].sum() for k in ks], np.float32
+        ),
+        "n": np.array([(tbl["k"] == k).sum() for k in ks], np.int64),
+    }
+    return q, expected
+
+
+def _pipeline_join_agg(ctx):
+    """hash join -> group_by: join exchange + aggregation exchange."""
+    left = _data(600)
+    rng = np.random.default_rng(7)
+    right = {
+        "k": np.arange(13, dtype=np.int32),
+        "w": rng.standard_normal(13).astype(np.float32),
+    }
+    q = (
+        ctx.from_arrays(left)
+        .join(ctx.from_arrays(right), "k")
+        .group_by("k", {"m": ("max", "w"), "n": ("count", None)})
+    )
+    ks = np.unique(left["k"])
+    expected = {
+        "k": ks,
+        "m": np.array([right["w"][k] for k in ks], np.float32),
+        "n": np.array([(left["k"] == k).sum() for k in ks], np.int64),
+    }
+    return q, expected
+
+
+def _pos(c):
+    return c["v"] > 0
+
+
+def _pipeline_filter_topk(ctx):
+    """where -> order_by -> take: filter + range exchange + head."""
+    tbl = _data(500)
+    q = (
+        ctx.from_arrays(tbl)
+        .where(_pos)
+        .order_by([("v", True)])
+        .take(20)
+    )
+    mask = tbl["v"] > 0
+    order = np.argsort(-tbl["v"][mask], kind="stable")[:20]
+    expected = {
+        "k": tbl["k"][mask][order],
+        "v": tbl["v"][mask][order],
+    }
+    return q, expected
+
+
+PIPELINES = [
+    _pipeline_groupby_sort,
+    _pipeline_join_agg,
+    _pipeline_filter_topk,
+]
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+@pytest.mark.parametrize(
+    "pipeline", PIPELINES, ids=lambda f: f.__name__.removeprefix("_pipeline_")
+)
+def test_chaos_pipeline_matches_oracle(pipeline, seed, mesh8):
+    ctx = DryadContext(num_partitions_=8, config=DryadConfig(**CHAOS_CONFIG))
+    q, expected = pipeline(ctx)
+    install_plan(_plan(seed))
+    try:
+        out = q.collect()
+    finally:
+        install_plan(None)
+    check(out, expected)
+    # bounded recovery: per-stage failures stay under the plan cap and
+    # the budget; the job completed without a terminal failure
+    kinds = [e["kind"] for e in ctx.events.events()]
+    assert "job_failed" not in kinds
+    assert "job_complete" in kinds
+    per_stage = {}
+    for e in ctx.events.filter("stage_failed"):
+        per_stage[e["name"]] = per_stage.get(e["name"], 0) + 1
+    assert all(n <= 2 for n in per_stage.values()), per_stage
+
+
+def test_chaos_replay_is_deterministic(mesh8):
+    """Same seed -> identical injected-failure schedule (the property
+    that makes a chaos failure reproducible)."""
+
+    def run():
+        ctx = DryadContext(
+            num_partitions_=8, config=DryadConfig(**CHAOS_CONFIG)
+        )
+        q, _ = _pipeline_groupby_sort(ctx)
+        install_plan(_plan(1))
+        try:
+            q.collect()
+        finally:
+            install_plan(None)
+        return [
+            (e["name"], e["version"])
+            for e in ctx.events.filter("stage_failed")
+        ]
+
+    assert run() == run()
+
+
+def test_chaos_checkpoint_corruption_recomputes(mesh8, tmp_path):
+    """Silent bit rot in a persisted checkpoint: the CRC catches it at
+    load, the stage recomputes, and the answer matches the oracle."""
+    cdir = str(tmp_path / "ckpt")
+    cfg = DryadConfig(checkpoint_dir=cdir, **CHAOS_CONFIG)
+
+    ctx1 = DryadContext(num_partitions_=8, config=cfg)
+    q1, expected = _pipeline_groupby_sort(ctx1)
+    set_fake_checkpoint_corruption(1)  # rot the first checkpoint saved
+    out1 = q1.collect()
+    check(out1, expected)  # in-HBM results are unaffected by the rot
+
+    # a restarted driver resumes from the checkpoint store: the rotted
+    # entry must fail its CRC and recompute, not serve garbage
+    ctx2 = DryadContext(num_partitions_=8, config=cfg)
+    q2, _ = _pipeline_groupby_sort(ctx2)
+    out2 = q2.collect()
+    check(out2, expected)
+    kinds = [e["kind"] for e in ctx2.events.events()]
+    assert "checkpoint_corrupt" in kinds, kinds
+    assert "job_complete" in kinds
+
+
+def _even(cols):
+    return cols["k"] % 2 == 0
+
+
+def test_chaos_worker_kill_reexecutes_on_survivor():
+    """A worker killed while stalling on its vertex task: the driver
+    reaps it, re-executes the task on the survivor, and the assembled
+    result still matches the oracle exactly."""
+    from dryad_tpu.cluster.localjob import LocalJobSubmission
+
+    rng = np.random.default_rng(5)
+    tbl = {
+        "k": rng.integers(0, 100, 3000).astype(np.int32),
+        "v": rng.standard_normal(3000).astype(np.float32),
+    }
+    with LocalJobSubmission(num_workers=2, devices_per_worker=1) as sub:
+        ctx = DryadContext(num_partitions_=1)
+        q = ctx.from_arrays(tbl).where(_even).project(["k", "v"])
+        sub.submit_partitioned(q, nparts=4)  # warm both workers
+
+        sub.inject_delay(worker=1, seconds=30.0, count=1)
+
+        def killer():
+            time.sleep(1.0)  # let the stalled task dispatch first
+            sub._handles[1].kill()
+
+        t = threading.Thread(target=killer)
+        t.start()
+        # speculation off: ONLY worker-death re-execution can finish
+        # the stalled partition
+        out = sub.submit_partitioned(q, nparts=4, speculation=False)
+        t.join()
+        mask = tbl["k"] % 2 == 0
+        check(
+            {"k": np.sort(out["k"]), "v": np.sort(out["v"])},
+            {"k": np.sort(tbl["k"][mask]), "v": np.sort(tbl["v"][mask])},
+        )
+        kinds = [e["kind"] for e in sub.events.events()]
+        assert "worker_dead" in kinds
+        assert "vertex_retry" in kinds
+        assert "vertex_job_complete" in kinds
+
+
+def test_chaos_deterministic_stage_fails_fast_with_history(mesh8):
+    """An always-failing stage (stable error) is classified
+    deterministic on its second identical failure and fails the job
+    INSIDE the retry budget, attempt history attached."""
+    ctx = DryadContext(
+        num_partitions_=8, config=DryadConfig(max_stage_failures=5)
+    )
+    set_fake_stage_failure("group_by", -1)  # every attempt, stable msg
+    with pytest.raises(JobFailedError) as ei:
+        ctx.from_arrays(_data(100)).group_by(
+            "k", {"n": ("count", None)}
+        ).collect()
+    e = ei.value
+    assert e.attempts, "no attempt history attached"
+    assert len(e.attempts) == 2 <= 5  # failed fast, not at the budget
+    assert e.attempts[0].kind == "transient"
+    assert e.attempts[-1].kind == "deterministic"
+    assert "attempt history" in str(e)
+    assert "deterministic" in str(e)
+    evs = ctx.events.filter("job_failed")
+    assert evs and evs[-1]["failure_kind"] == "deterministic"
+
+
+def test_chaos_budget_exhaustion_carries_history(mesh8):
+    """Distinct transient failures burn the whole budget; the terminal
+    error still carries every attempt."""
+    ctx = DryadContext(
+        num_partitions_=8,
+        config=DryadConfig(max_stage_failures=3, retry_backoff_base=0.001),
+    )
+    set_fake_stage_failure("group_by", 99)  # varying msg: transient
+    with pytest.raises(JobFailedError, match="failure budget") as ei:
+        ctx.from_arrays(_data(100)).group_by(
+            "k", {"n": ("count", None)}
+        ).collect()
+    assert len(ei.value.attempts) == 3
+    assert all(a.kind == "transient" for a in ei.value.attempts)

@@ -7,14 +7,7 @@ import numpy as np
 import pytest
 
 from dryad_tpu import DryadConfig, DryadContext
-from dryad_tpu.exec.faults import clear_faults, set_fake_stage_failure
-
-
-@pytest.fixture(autouse=True)
-def _clean_faults():
-    clear_faults()
-    yield
-    clear_faults()
+from dryad_tpu.exec.faults import set_fake_stage_failure
 
 
 def _job(ctx):

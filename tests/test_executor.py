@@ -6,15 +6,8 @@ import pytest
 
 from dryad_tpu import DryadConfig, DryadContext
 from dryad_tpu.exec.executor import StageFailedError
-from dryad_tpu.exec.faults import clear_faults, set_fake_stage_failure
+from dryad_tpu.exec.faults import set_fake_stage_failure
 from dryad_tpu.exec.stats import StageStatistics
-
-
-@pytest.fixture(autouse=True)
-def _clean_faults():
-    clear_faults()
-    yield
-    clear_faults()
 
 
 def test_injected_failure_retries_and_succeeds(mesh8):

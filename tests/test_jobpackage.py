@@ -78,9 +78,8 @@ def test_cross_process_run(tmp_path, rng):
     pack_query(q, p)
 
     code = (
-        "import jax;"
-        "jax.config.update('jax_platforms','cpu');"
-        "jax.config.update('jax_num_cpu_devices',8);"
+        "from dryad_tpu.parallel.mesh import force_cpu_backend;"
+        "force_cpu_backend(8);"
         "from dryad_tpu.exec.jobpackage import run_package;"
         f"out = run_package({p!r});"
         "print('TOTAL', int(out['c'].sum()))"

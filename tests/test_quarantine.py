@@ -1,0 +1,338 @@
+"""Scheduler quarantine + retry-backoff tests — all on fake time.
+
+The machine-blacklist analog (reference GM failure accounting): a
+computer crossing the sliding-window failure threshold receives no new
+dispatches until its cooldown elapses, soft affinities relax away from
+it immediately, and re-admission goes through probation.  The clock is
+injected, so no test sleeps for policy time (only sub-second real waits
+for the dispatcher thread to act).
+"""
+
+import threading
+import time
+
+import pytest
+
+from dryad_tpu.cluster.interfaces import (
+    Affinity,
+    ClusterProcess,
+    Computer,
+    ProcessState,
+)
+from dryad_tpu.cluster.scheduler import LocalScheduler
+from dryad_tpu.exec.events import EventLog
+from dryad_tpu.exec.failure import (
+    Attempt,
+    FailureKind,
+    JobFailedError,
+    RetryPolicy,
+    classify,
+)
+from dryad_tpu.exec.stats import FailureWindow
+
+
+class FakeClock:
+    """Injectable monotonic clock (advance() moves policy time)."""
+
+    def __init__(self) -> None:
+        self.t = 1000.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _boom(p):
+    raise RuntimeError("induced failure")
+
+
+def _ok(p):
+    return "ok"
+
+
+def _wait_state(p, states, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if p.state in states:
+            return True
+        time.sleep(0.01)
+    return False
+
+
+@pytest.fixture
+def clock():
+    return FakeClock()
+
+
+@pytest.fixture
+def sched(clock):
+    ev = EventLog(None)
+    s = LocalScheduler(
+        [Computer("m0", "rackA"), Computer("m1", "rackA")],
+        rack_delay=0.05,
+        cluster_delay=0.1,
+        quarantine_threshold=3,
+        quarantine_window=60.0,
+        quarantine_cooldown=30.0,
+        clock=clock,
+        events=ev,
+    )
+    s.test_events = ev
+    yield s
+    s.shutdown()
+
+
+def _fail_n_on(sched, computer, n):
+    """Drive n failures attributed to one computer via hard affinity
+    (hard pins dispatch even under quarantine, so this also drives the
+    probation re-failure)."""
+    for _ in range(n):
+        p = ClusterProcess(_boom, affinities=[Affinity(computer, hard=True)])
+        sched.schedule(p)
+        assert p.wait(5)
+        assert p.state is ProcessState.FAILED
+
+
+class TestQuarantine:
+    def test_threshold_quarantines_no_new_dispatches(self, sched, clock):
+        _fail_n_on(sched, "m0", 3)
+        assert sched.quarantined() == ["m0"]
+        kinds = [e["kind"] for e in sched.test_events.events()]
+        assert "computer_quarantined" in kinds
+        # a soft m0-preferring process must NOT land on m0
+        p = ClusterProcess(_ok, affinities=[Affinity("m0")])
+        sched.schedule(p)
+        assert p.wait(5)
+        assert p.computer == "m1"
+
+    def test_quarantined_sole_computer_blocks_until_cooldown(self, clock):
+        s = LocalScheduler(
+            [Computer("m0")],
+            quarantine_threshold=2,
+            quarantine_cooldown=30.0,
+            clock=clock,
+        )
+        try:
+            _fail_n_on(s, "m0", 2)
+            assert s.quarantined() == ["m0"]
+            p = ClusterProcess(_ok)  # no affinity: quarantine applies
+            s.schedule(p)
+            assert not p.wait(0.3), "dispatched into quarantine"
+            assert p.state is ProcessState.QUEUED
+            clock.advance(31.0)  # cooldown elapses -> probation
+            assert p.wait(5)
+            assert p.state is ProcessState.COMPLETED
+            assert p.computer == "m0"
+        finally:
+            s.shutdown()
+
+    def test_soft_affinity_relaxes_away_immediately(self, sched, clock):
+        """A soft preference for a quarantined computer must not wait
+        out rack/cluster delays before running elsewhere."""
+        _fail_n_on(sched, "m0", 3)
+        t0 = time.monotonic()
+        p = ClusterProcess(_ok, affinities=[Affinity("m0", weight=2.0)])
+        sched.schedule(p)
+        assert p.wait(5)
+        # immediate placement: well under the (real-time) cluster delay
+        # would be flaky to assert tightly; just require it didn't pin
+        assert p.computer == "m1"
+        assert time.monotonic() - t0 < 2.0
+
+    def test_hard_affinity_still_dispatches(self, sched, clock):
+        """Hard constraints never relax: refusing them under quarantine
+        would deadlock per-worker gang commands."""
+        _fail_n_on(sched, "m0", 3)
+        assert sched.quarantined() == ["m0"]
+        p = ClusterProcess(_ok, affinities=[Affinity("m0", hard=True)])
+        sched.schedule(p)
+        assert p.wait(5)
+        assert p.state is ProcessState.COMPLETED
+        assert p.computer == "m0"
+
+    def test_probation_success_readmits(self, sched, clock):
+        _fail_n_on(sched, "m0", 3)
+        assert sched.quarantined() == ["m0"]
+        clock.advance(31.0)
+        assert sched.quarantined() == []  # cooldown elapsed -> probation
+        p = ClusterProcess(_ok, affinities=[Affinity("m0", hard=True)])
+        sched.schedule(p)
+        assert p.wait(5)
+        assert p.state is ProcessState.COMPLETED
+        kinds = [e["kind"] for e in sched.test_events.events()]
+        assert "computer_probation" in kinds
+        assert "computer_readmitted" in kinds
+        # readmission cleared the window: fresh failures need the full
+        # threshold again
+        _fail_n_on(sched, "m0", 2)
+        assert sched.quarantined() == []
+
+    def test_probation_failure_requarantines_immediately(self, sched, clock):
+        _fail_n_on(sched, "m0", 3)
+        clock.advance(31.0)
+        assert sched.quarantined() == []  # probation
+        _fail_n_on(sched, "m0", 1)  # one strike on probation
+        assert sched.quarantined() == ["m0"]
+        quar = sched.test_events.filter("computer_quarantined")
+        assert quar[-1]["probation"] is True
+
+    def test_window_expiry_forgives_old_failures(self, sched, clock):
+        _fail_n_on(sched, "m0", 2)
+        clock.advance(61.0)  # slide past quarantine_window
+        _fail_n_on(sched, "m0", 2)  # 2 in-window < threshold 3
+        assert sched.quarantined() == []
+
+    def test_remove_computer_clears_quarantine_state(self, sched, clock):
+        _fail_n_on(sched, "m0", 3)
+        assert sched.quarantined() == ["m0"]
+        sched.remove_computer("m0")
+        assert sched.quarantined() == []
+        sched.add_computer(Computer("m0", "rackA"))  # fresh worker
+        p = ClusterProcess(_ok, affinities=[Affinity("m0")])
+        sched.schedule(p)
+        assert p.wait(5)
+        assert p.computer == "m0"
+
+
+class TestRemoveComputerFailFast:
+    def test_queued_hard_affinity_fails_fast_on_removal(self, sched):
+        release = threading.Event()
+        blocker = ClusterProcess(
+            lambda p: release.wait(10),
+            affinities=[Affinity("m0", hard=True)],
+        )
+        sched.schedule(blocker)
+        assert _wait_state(blocker, (ProcessState.RUNNING,))
+        stuck = ClusterProcess(_ok, affinities=[Affinity("m0", hard=True)])
+        sched.schedule(stuck)
+        time.sleep(0.05)
+        sched.remove_computer("m0")
+        release.set()
+        assert stuck.wait(5), "stranded process hung instead of failing"
+        assert stuck.state is ProcessState.FAILED
+        assert "hard affinity" in str(stuck.error)
+        assert "m0" in str(stuck.error)
+
+    def test_hard_rack_affinity_survives_member_removal(self, sched):
+        """A hard RACK constraint stays queued while the rack still has
+        members — only truly unsatisfiable work fails fast."""
+        release = threading.Event()
+        for name in ("m0", "m1"):
+            sched.schedule(ClusterProcess(
+                lambda p: release.wait(10),
+                affinities=[Affinity(name, hard=True)],
+            ))
+        time.sleep(0.05)
+        racked = ClusterProcess(_ok, affinities=[Affinity("rackA", hard=True)])
+        sched.schedule(racked)
+        sched.remove_computer("m0")
+        time.sleep(0.1)
+        assert racked.state is ProcessState.QUEUED  # m1 still satisfies
+        release.set()
+        assert racked.wait(5)
+        assert racked.state is ProcessState.COMPLETED
+        assert racked.computer == "m1"
+
+
+class TestRetryPolicy:
+    def test_exponential_growth_and_cap(self):
+        p = RetryPolicy(backoff_base=0.1, backoff_max=0.5, jitter=0.0)
+        assert p.backoff("s", 1) == pytest.approx(0.1)
+        assert p.backoff("s", 2) == pytest.approx(0.2)
+        assert p.backoff("s", 3) == pytest.approx(0.4)
+        assert p.backoff("s", 4) == pytest.approx(0.5)  # capped
+        assert p.backoff("s", 9) == pytest.approx(0.5)
+
+    def test_jitter_is_seeded_and_bounded(self):
+        a = RetryPolicy(seed=7, jitter=0.5)
+        b = RetryPolicy(seed=7, jitter=0.5)
+        c = RetryPolicy(seed=8, jitter=0.5)
+        xs = [a.backoff("stage", k) for k in (1, 2, 3)]
+        assert xs == [b.backoff("stage", k) for k in (1, 2, 3)]  # replay
+        assert xs != [c.backoff("stage", k) for k in (1, 2, 3)]
+        for k, x in enumerate(xs, start=1):
+            raw = min(0.05 * 2 ** (k - 1), 2.0)
+            assert raw <= x <= raw * 1.5
+
+    def test_classify_deterministic_needs_repeat(self):
+        e = RuntimeError("boom")
+        assert classify(e, []) is FailureKind.TRANSIENT
+        hist = [Attempt(1, "RuntimeError", "boom", computer="w0")]
+        # same computer: could be machine-local (disk, memory) -> transient
+        assert classify(e, hist, computer="w0") is FailureKind.TRANSIENT
+        # different computer: the error follows the work -> deterministic
+        assert (
+            classify(e, hist, computer="w1") is FailureKind.DETERMINISTIC
+        )
+        # no computers at all (single-driver executor): repeat is enough
+        hist2 = [Attempt(1, "RuntimeError", "boom")]
+        assert classify(e, hist2) is FailureKind.DETERMINISTIC
+        # different message: not the same failure
+        assert (
+            classify(RuntimeError("other"), hist, computer="w1")
+            is FailureKind.TRANSIENT
+        )
+
+    def test_job_failed_error_carries_history(self):
+        att = [
+            Attempt(1, "ValueError", "x", computer="w0", backoff=0.1),
+            Attempt(2, "ValueError", "x", kind="deterministic",
+                    computer="w1"),
+        ]
+        e = JobFailedError("stage 's' failed", stage="s", attempts=att)
+        assert e.stage == "s"
+        assert len(e.attempts) == 2
+        assert "attempt 1 on w0" in str(e)
+        assert "deterministic" in str(e)
+
+
+class TestFailureWindow:
+    def test_sliding_window_counts(self):
+        w = FailureWindow(10.0)
+        assert w.record(100.0) == 1
+        assert w.record(105.0) == 2
+        assert w.count(109.0) == 2
+        assert w.count(111.0) == 1  # t=100 expired
+        assert w.count(200.0) == 0
+
+
+class TestExecutorBackoff:
+    def test_backoff_schedule_recorded_no_real_sleep(self, mesh8):
+        """Transient stage failures back off per the seeded policy; the
+        injectable sleep records the schedule instead of waiting."""
+        import numpy as np
+
+        from dryad_tpu import DryadConfig, DryadContext
+        from dryad_tpu.exec.faults import set_fake_stage_failure
+
+        ctx = DryadContext(
+            num_partitions_=8,
+            config=DryadConfig(
+                max_stage_failures=4, retry_backoff_base=0.2,
+                retry_jitter=0.5, retry_seed=3,
+            ),
+        )
+        slept = []
+        ctx.executor._sleep = slept.append
+        set_fake_stage_failure("group_by", 2)
+        out = ctx.from_arrays(
+            {"k": np.arange(50, dtype=np.int32)}
+        ).group_by("k", {"n": ("count", None)}).collect()
+        assert out["n"].sum() == 50
+        policy = ctx.executor.retry_policy
+        stage_name = next(
+            e["name"] for e in ctx.events.events()
+            if e["kind"] == "stage_failed"
+        )
+        assert slept == [
+            policy.backoff(stage_name, 1), policy.backoff(stage_name, 2)
+        ]
+        assert all(0.2 <= s <= 0.2 * 2 * 1.5 for s in slept)
+        # events carry the same schedule for post-mortem tooling
+        evs = ctx.events.filter("stage_failed")
+        assert [e["backoff"] for e in evs] == [
+            round(s, 4) for s in slept
+        ]
+        assert all(e["failure_kind"] == "transient" for e in evs)

@@ -158,7 +158,12 @@ def test_splitter_sample_count_scales_with_boost(mesh8):
 
     from jax.sharding import PartitionSpec as P_
 
-    shard_map = jax.shard_map
+    from dryad_tpu.parallel.stage import _CHECK_KW, _shard_map
+
+    def shard_map(fn, **kw):
+        kw[_CHECK_KW] = kw.pop("check_vma")
+        return _shard_map(fn, **kw)
+
     mesh = mesh8
     with mock.patch.object(SORT, "sample_splitters", spy):
         for boost in (1, 2):

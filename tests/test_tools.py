@@ -5,15 +5,8 @@ import pytest
 
 from dryad_tpu import DryadConfig, DryadContext
 from dryad_tpu.exec.executor import StageFailedError
-from dryad_tpu.exec.faults import clear_faults, set_fake_stage_failure
+from dryad_tpu.exec.faults import set_fake_stage_failure
 from dryad_tpu.tools.jobview import build_job, diagnose, main, render
-
-
-@pytest.fixture(autouse=True)
-def _clean_faults():
-    clear_faults()
-    yield
-    clear_faults()
 
 
 def _wordcountish(ctx):
@@ -393,3 +386,101 @@ def test_jobview_live_html(tmp_path, rng):
     log.write_text("".join(J.dumps(e) + "\n" for e in evs2))
     follow_html(str(log), str(out), interval=0.05, max_rounds=2)
     assert "OK" in out.read_text()
+
+
+def test_jobview_computer_health_summary():
+    """Per-computer failure/quarantine fold + render (the machine-
+    blacklist story, post-mortem)."""
+    from dryad_tpu.tools.jobview import (
+        build_computer_health,
+        fold_submission,
+        render_computer_health,
+    )
+
+    events = [
+        {"ts": 1.0, "kind": "vertex_job_start", "seq": 1, "nparts": 1},
+        {"ts": 1.1, "kind": "process_failed", "process": "part0-a0",
+         "computer": "worker1", "error": "RuntimeError: bad disk"},
+        {"ts": 1.2, "kind": "process_failed", "process": "part0-a1",
+         "computer": "worker1", "error": "RuntimeError: bad disk"},
+        {"ts": 1.3, "kind": "computer_quarantined", "computer": "worker1",
+         "failures": 3, "cooldown": 30.0, "probation": False},
+        {"ts": 2.0, "kind": "computer_probation", "computer": "worker1"},
+        {"ts": 2.5, "kind": "computer_readmitted", "computer": "worker1"},
+        {"ts": 3.0, "kind": "vertex_complete", "part": 0, "seconds": 0.1,
+         "computer": "worker0"},
+        {"ts": 3.1, "kind": "vertex_job_complete", "seq": 1},
+    ]
+    health = build_computer_health(events)
+    w1 = health["worker1"]
+    assert w1.failures == 2 and w1.quarantines == 1
+    assert w1.probations == 1 and w1.readmissions == 1
+    assert w1.state == "ok"
+    text = render_computer_health(health)
+    assert "computer health" in text
+    assert "worker1" in text and "bad disk" in text
+    # the submission fold appends the health section
+    folded, ok = fold_submission(events)
+    assert ok and "computer health" in folded
+
+
+def test_jobview_vertex_attempt_history():
+    """vertex_retry events carrying computer/error/backoff render as a
+    per-part attempt history."""
+    from dryad_tpu.tools.jobview import build_vertex_jobs, render_vertex_job
+
+    events = [
+        {"ts": 1.0, "kind": "vertex_job_start", "seq": 1, "nparts": 1},
+        {"ts": 1.5, "kind": "vertex_retry", "part": 0, "attempt": 2,
+         "computer": "worker1", "error": "RuntimeError: injected",
+         "backoff": 0.07, "failure_kind": "transient"},
+        {"ts": 2.0, "kind": "vertex_complete", "part": 0, "seconds": 0.4,
+         "computer": "worker0"},
+        {"ts": 2.1, "kind": "vertex_job_complete", "seq": 1},
+    ]
+    (j,) = build_vertex_jobs(events)
+    assert j.attempt_log[0][0]["computer"] == "worker1"
+    text = render_vertex_job(j)
+    assert "attempt history" in text
+    assert "prev on worker1" in text and "transient" in text
+    assert "backoff 0.070s" in text
+
+
+def test_jobview_stage_attempt_history_and_corruption(mesh8, tmp_path):
+    """A recovered executor job renders its per-stage attempt history;
+    a CRC-corrupt checkpoint shows up in the diagnosis."""
+    from dryad_tpu.exec.faults import set_fake_checkpoint_corruption
+
+    cdir = str(tmp_path / "ck")
+    cfg = DryadConfig(checkpoint_dir=cdir, retry_backoff_base=0.001)
+    ctx1 = DryadContext(num_partitions_=8, config=cfg)
+    set_fake_stage_failure("group_by", 1)
+    set_fake_checkpoint_corruption(1)
+    _wordcountish(ctx1).collect()
+    job1 = build_job(ctx1.events.events())
+    text = render(job1)
+    assert "attempt history" in text
+    assert "transient" in text and "injected failure" in text
+
+    # resume: the corrupted checkpoint is detected and diagnosed
+    ctx2 = DryadContext(num_partitions_=8, config=cfg)
+    _wordcountish(ctx2).collect()
+    job2 = build_job(ctx2.events.events())
+    assert any(s.checkpoint_corrupt for s in job2.stages.values())
+    notes = diagnose(job2)
+    assert any("corrupt checkpoint" in n and "CRC" in n for n in notes)
+
+
+def test_jobview_deterministic_failure_diagnosis(mesh8):
+    """A deterministic stage failure names its domain in the diagnosis
+    instead of blaming the budget."""
+    from dryad_tpu.exec.failure import JobFailedError
+
+    ctx = DryadContext(num_partitions_=8)
+    set_fake_stage_failure("group_by", -1)
+    with pytest.raises(JobFailedError):
+        _wordcountish(ctx).collect()
+    job = build_job(ctx.events.events())
+    assert job.failed
+    notes = diagnose(job)
+    assert any("deterministic failure" in n for n in notes)
