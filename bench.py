@@ -427,13 +427,10 @@ def terasort_device_metric(n: int):
     )
 
 
-def ooc_sort_metric(n: int, chunk_rows: int = 1 << 21):
-    """Out-of-core TeraSort at >= 16x the single-batch device capacity:
-    chunked ingest -> range-bucket spill -> per-bucket device sort
-    (exec.outofcore external distribution sort).  HBM held to one
-    chunk/bucket at a time; the reference's streaming channel stack
-    handles the same scale via bounded buffers
-    (``channelbuffernativereader.cpp``)."""
+def _ooc_sort_once(n: int, chunk_rows: int, depth=None) -> float:
+    """One timed out-of-core sort run; returns seconds.  ``depth``
+    overrides ``stream_pipeline_depth`` (1 = the serial legacy
+    driver, the pre-pipeline baseline)."""
     from dryad_tpu import DryadConfig, DryadContext
 
     rng = np.random.default_rng(3)
@@ -445,35 +442,89 @@ def ooc_sort_metric(n: int, chunk_rows: int = 1 << 21):
     ]
     total = nchunks * chunk_rows
     bucket_rows = max(chunk_rows, 1 << 20)
+    kw = {} if depth is None else {"stream_pipeline_depth": depth}
     cfg = DryadConfig(
         stream_bucket_rows=bucket_rows * 2,
         stream_buckets=max(8, 2 * total // bucket_rows),
+        **kw,
     )
     ctx = DryadContext(config=cfg)
-
-    def run():
-        q = ctx.from_stream(
-            iter([{k: v for k, v in c.items()} for c in chunks])
-        ).order_by(["key"])
-        out = q.collect()
-        assert len(out["key"]) == total
-        assert (np.diff(out["key"]) >= 0).all()
-
     t0 = time.perf_counter()
-    run()
+    q = ctx.from_stream(
+        iter([{k: v for k, v in c.items()} for c in chunks])
+    ).order_by(["key"])
+    out = q.collect()
     t = time.perf_counter() - t0
+    assert len(out["key"]) == total
+    assert (np.diff(out["key"]) >= 0).all()
+    return t
+
+
+def ooc_sort_metric(n: int, chunk_rows: int = 1 << 21):
+    """Out-of-core TeraSort at >= 16x the single-batch device capacity:
+    chunked ingest -> range-bucket spill -> per-bucket device sort
+    (exec.outofcore external distribution sort), through the chunk
+    pipeline (exec.pipeline: prefetch / compute / background spill
+    overlap, observed-size bucket capacities).  HBM held to the
+    pipeline-depth chunk budget; the reference's streaming channel
+    stack handles the same scale via bounded buffers
+    (``channelbuffernativereader.cpp``)."""
+    from dryad_tpu import DryadConfig
+
+    nchunks = max(1, n // chunk_rows)
+    total = nchunks * chunk_rows
+    bucket_rows = max(chunk_rows, 1 << 20)
+    t = _ooc_sort_once(n, chunk_rows)
     return rep_record(
         "oocsort_rows_per_sec", total, [t],
         {"chunks": nchunks, "chunk_rows": chunk_rows,
          "bounded_hbm_rows": max(chunk_rows, 2 * bucket_rows),
-         "capacity_multiple": nchunks},
+         "capacity_multiple": nchunks,
+         "pipeline_depth": DryadConfig().stream_pipeline_depth},
     )
 
 
-def ooc_wordcount_metric(n_words: int, vocab: int = 1 << 14):
+def ooc_pipeline_speedup_metric(n: int, chunk_rows: int = 1 << 20):
+    """Pipelined vs serial out-of-core driver on the SAME sort
+    workload: ``stream_pipeline_depth=1`` runs the pre-pipeline serial
+    loop (fixed worst-case bucket layouts, per-chunk host readback,
+    synchronous spill), the default depth runs the chunk pipeline.
+    Value is the wall-clock ratio serial/pipelined — measured, both
+    runs in this process.  ``cores`` is recorded because the overlap
+    half of the win needs >1 host core; the work-elimination half
+    (observed-size bucket capacities, cached chunk plans, device-
+    resident partials) shows on any host."""
+    from dryad_tpu import DryadConfig
+
+    depth = DryadConfig().stream_pipeline_depth
+    t_piped = _ooc_sort_once(n, chunk_rows)
+    t_serial = _ooc_sort_once(n, chunk_rows, depth=1)
+    ratio = t_serial / max(t_piped, 1e-9)
+    return {
+        "metric": "ooc_pipeline_speedup",
+        "value": round(ratio, 3),
+        "unit": "x",
+        "depth": depth,
+        "baseline": "serial legacy driver (stream_pipeline_depth=1)",
+        "pipelined_s": round(t_piped, 3),
+        "serial_s": round(t_serial, 3),
+        "rows": n,
+        "chunk_rows": chunk_rows,
+        "cores": os.cpu_count(),
+        "platform": _PLATFORM,
+        "contended": False,
+        "spread": 1.0,
+        "reps_s": [round(t_piped, 3)],
+    }
+
+
+def ooc_wordcount_metric(
+    n_words: int, vocab: int = 1 << 14, chunk_bytes: int = 1 << 22
+):
     """Out-of-core WordCount: a corpus file streamed in byte chunks
-    through the native tokenizer, per-chunk partial group_by, running
-    device combine (exec.outofcore partial path)."""
+    through the native tokenizer, per-chunk partial group_by, and the
+    DEVICE-RESIDENT combine of the chunk pipeline (partials accumulate
+    in HBM; one N-ary merge per combine threshold; one D2H total)."""
     import tempfile
 
     from dryad_tpu import DryadConfig, DryadContext
@@ -494,11 +545,12 @@ def ooc_wordcount_metric(n_words: int, vocab: int = 1 << 14):
         path = fh.name
     nbytes = len(corpus)
     del corpus, parts
-    ctx = DryadContext(config=DryadConfig())
+    cfg = DryadConfig()
+    ctx = DryadContext(config=cfg)
 
     def run():
         out = (
-            ctx.text_stream(path, chunk_bytes=1 << 24)
+            ctx.text_stream(path, chunk_bytes=chunk_bytes)
             .group_by("word", {"c": ("count", None)})
             .collect()
         )
@@ -513,7 +565,8 @@ def ooc_wordcount_metric(n_words: int, vocab: int = 1 << 14):
     return rep_record(
         "oocwordcount_rows_per_sec", n_words, [t],
         {"corpus_bytes": nbytes, "vocab": vocab,
-         "chunk_bytes": 1 << 24},
+         "chunk_bytes": chunk_bytes,
+         "pipeline_depth": cfg.stream_pipeline_depth},
     )
 
 
@@ -780,8 +833,17 @@ def child_main() -> None:
              chunk_rows=1 << 22 if accel else 1 << 17),
          240 if accel else 60, False),
         ("oocwordcount_rows_per_sec",
-         lambda: ooc_wordcount_metric(1 << 24 if accel else 1 << 19),
-         200 if accel else 45, False),
+         lambda: ooc_wordcount_metric(
+             1 << 24 if accel else 1 << 21,
+             chunk_bytes=1 << 24 if accel else 1 << 21),
+         200 if accel else 60, False),
+        # pipelined vs serial out-of-core driver (same workload, same
+        # process): the depth=1 run IS the pre-pipeline baseline
+        ("ooc_pipeline_speedup",
+         lambda: ooc_pipeline_speedup_metric(
+             1 << 24 if accel else 1 << 20,
+             chunk_rows=1 << 22 if accel else 1 << 17),
+         200 if accel else 75, False),
     ]
     if platform in ("tpu", "axon"):
         # The Pallas kernel only truly runs on TPU; elsewhere the number

@@ -660,6 +660,28 @@ class DryadContext:
             table = collapse_table(table, self._codecs)
         return table
 
+    def run_to_host_async(self, query: Query):
+        """Dispatch the device job NOW; return a zero-arg ``fetch``
+        closure that blocks on the device->host transfer.  The
+        streaming pipeline's dispatch/drain split: the driver launches
+        bucket k+1's program while bucket k's results transfer
+        (``exec.outofcore`` phase 2).  Not valid for stream-input
+        plans (those route through the StreamExecutor)."""
+        batch, deferred = self._execute_device(query, defer_miss=True)
+
+        def fetch() -> Dict[str, np.ndarray]:
+            valid, host_cols = _fetch_with_miss(batch, deferred)
+            table = batch.to_numpy(
+                query.schema, self.dictionary, _host=(valid, host_cols)
+            )
+            if self._codecs:
+                from dryad_tpu.columnar.codecs import collapse_table
+
+                table = collapse_table(table, self._codecs)
+            return table
+
+        return fetch
+
     def submit(self, query: Query) -> JobHandle:
         return JobHandle(self.run_to_host(query))
 

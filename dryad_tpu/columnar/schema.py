@@ -191,7 +191,11 @@ class StringDictionary:
         return out
 
     def items(self):
-        return self._map.items()
+        # Snapshot: a streaming prefetch thread may register tokens
+        # concurrently with a consumer iterating the dictionary (e.g.
+        # build_tables during lowering) — a live view would raise
+        # "dict changed size during iteration".
+        return list(self._map.items())
 
 
 @dataclasses.dataclass(frozen=True)

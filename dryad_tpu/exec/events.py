@@ -5,6 +5,28 @@ transitions, final topology) to ``calypso.log`` in the job's DFS
 directory (``GraphManager/reporting/DrCalypsoReporting.cpp``), consumed
 post-hoc by the JobBrowser.  Here: JSONL events per job, consumed by
 ``dryad_tpu.tools.jobview``.
+
+Streaming (out-of-core) event kinds, emitted by ``exec.outofcore`` /
+``exec.pipeline`` / ``exec.spill`` and folded by jobview's streaming +
+pipeline lines:
+
+- ``stream_start`` / ``stream_chunk`` / ``stream_spill`` /
+  ``stream_bucket`` / ``stream_bucket_split`` / ``stream_store`` — the
+  chunk/spill/bucket lifecycle;
+- ``stream_prefetch`` — one per prefetched chunk: ``queued`` (queue
+  depth) and ``in_flight`` (pipeline occupancy sample);
+- ``stream_pipeline`` — per-pipeline close summary: ``produced``,
+  ``peak_in_flight``, ``producer_wait_s`` (prefetch stalled on the
+  driver), ``consumer_wait_s`` (driver stalled on ingest);
+- ``stream_pipeline_error`` — a prefetch/spill-thread fault, with its
+  ``exec.failure`` classification, before it re-raises downstream;
+- ``stream_combine`` — partial compaction; ``device=True`` + ``fan_in``
+  for HBM-resident N-ary merges, ``rows_out`` for host merges;
+- ``stream_combine_policy`` — the device→host degrade decision for
+  non-reducing (high-cardinality) merge streams.
+
+Events may be emitted from pipeline threads; ``EventLog`` is
+thread-safe.
 """
 
 from __future__ import annotations

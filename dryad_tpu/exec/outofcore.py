@@ -27,6 +27,7 @@ applied at the spill boundary.
 from __future__ import annotations
 
 import math
+from collections import deque
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -38,7 +39,8 @@ from dryad_tpu.exec.partial import (
     merge_agg_spec,
     partial_plan,
 )
-from dryad_tpu.exec.spill import SpillDir
+from dryad_tpu.exec.pipeline import prefetched
+from dryad_tpu.exec.spill import SpillDir, SpillWriter
 from dryad_tpu.plan.nodes import Node, walk
 from dryad_tpu.utils.logging import get_logger
 
@@ -70,23 +72,44 @@ class _IngestScope:
     (so every chunk compiles to the same shapes) and accumulated
     auto-dense metadata (string vocab / int ranges widen monotonically
     across chunks, so the dense code table saturates and the compile
-    cache holds)."""
+    cache holds).
 
-    def __init__(self, ctx):
+    With ``cache_plans`` (the pipelined driver) the scope also reuses
+    the ingest Node itself: a chunk that introduces no new vocabulary,
+    no wider int range, and fits the stable capacity REBINDS the
+    previous chunk's input node to its arrays instead of building a
+    fresh node — so downstream plan chains, lowering keys, and compiled
+    programs repeat exactly (the cached-chunk-plan half of the
+    pipeline; without it, a widened vocab baked into the coding tables
+    forces a fresh XLA compile per chunk)."""
+
+    def __init__(self, ctx, cache_plans: bool = False):
         self.ctx = ctx
         self.cap: Optional[int] = None
         self.vocab: Dict[str, np.ndarray] = {}
         self.stats: Dict[str, Tuple[int, int]] = {}
+        self.cache_plans = cache_plans
+        # bumps whenever vocab/stats/capacity widen: cached input nodes
+        # and the chains built on them are valid while it holds still
+        self.version = 0
+        # (cap, binding kind) -> (version, node) reusable ingest input
+        self._cached_input: Dict[Tuple, Tuple[int, Node]] = {}
+        # (input node id, pending/extra node ids) -> cloned chain root
+        self.chain_cache: Dict[Tuple, Node] = {}
 
     def _fit_cap(self, n: int, P: int) -> int:
         if self.cap is None or n > self.cap * P:
             self.cap = max(1, math.ceil(n / P / 8) * 8)
+            self.version += 1
         return self.cap
 
     def _widen_vocab(self, col: str, v: np.ndarray) -> np.ndarray:
         prev = self.vocab.get(col)
-        self.vocab[col] = v if prev is None else np.union1d(prev, v)
-        return self.vocab[col]
+        new = v if prev is None else np.union1d(prev, v)
+        if prev is None or len(new) != len(prev):
+            self.version += 1
+        self.vocab[col] = new
+        return new
 
     def ingest(self, table: Dict[str, np.ndarray], schema: Schema):
         ctx = self.ctx
@@ -94,22 +117,62 @@ class _IngestScope:
 
         P = num_partitions(ctx.mesh) if ctx.mesh is not None else 8
         if is_physical_chunk(table, schema):
-            return self._ingest_physical(table, schema, P)
+            return self._maybe_reuse(self._ingest_physical(table, schema, P))
         n = len(next(iter(table.values()))) if table else 0
         self._fit_cap(n, P)
         q = ctx.from_arrays(table, schema=schema, partition_capacity=self.cap)
         node = q.node
-        # widen auto-dense metadata to the stream scope
+        # Widen auto-dense metadata to the stream scope.  The widened
+        # dicts REPLACE the node's params — never written into the
+        # original dicts, which clones share by reference (in-place
+        # widening would leak one chunk's vocabulary into every node
+        # holding the same params dict).
         sv = node.params.get("str_vocab") or {}
-        for col, vocab in sv.items():
-            sv[col] = self._widen_vocab(col, vocab)
+        if sv:
+            node.params["str_vocab"] = {
+                col: self._widen_vocab(col, vocab)
+                for col, vocab in sv.items()
+            }
         cs = node.params.get("col_stats") or {}
-        for col, (mn, mx) in cs.items():
-            if col in self.stats:
-                pmn, pmx = self.stats[col]
-                mn, mx = min(mn, pmn), max(mx, pmx)
-            self.stats[col] = (mn, mx)
-            cs[col] = (mn, mx)
+        if cs:
+            merged = {}
+            for col, (mn, mx) in cs.items():
+                if col in self.stats:
+                    pmn, pmx = self.stats[col]
+                    nmn, nmx = min(mn, pmn), max(mx, pmx)
+                else:
+                    nmn, nmx = mn, mx
+                if self.stats.get(col) != (nmn, nmx):
+                    self.version += 1
+                self.stats[col] = (nmn, nmx)
+                merged[col] = (nmn, nmx)
+            node.params["col_stats"] = merged
+        return self._maybe_reuse(q)
+
+    def _maybe_reuse(self, q):
+        """Swap the freshly built input node for the cached one when
+        this chunk's metadata is covered by it (vocab/stats widen
+        monotonically, so an unchanged version proves coverage)."""
+        if not self.cache_plans:
+            return q
+        from dryad_tpu.api.query import Query
+
+        ctx = self.ctx
+        node = q.node
+        binding = ctx._bindings.get(node.id)
+        if binding is None:
+            return q
+        key = (self.cap, binding[0])
+        cached = self._cached_input.get(key)
+        if cached is not None and cached[0] == self.version:
+            cnode = cached[1]
+            # adopt the fresh chunk's binding under the cached node id;
+            # the content fingerprint is per-binding, so drop the stale
+            # cached one (checkpoint identity must follow the data)
+            ctx._bindings[cnode.id] = ctx._bindings.pop(node.id)
+            ctx._binding_fp_cache.pop(cnode.id, None)
+            return Query(ctx, cnode)
+        self._cached_input[key] = (self.version, node)
         return q
 
     def _ingest_physical(self, table: Dict[str, np.ndarray], schema, P):
@@ -210,6 +273,79 @@ def _chunk_rows(table) -> int:
     return 0
 
 
+class _DeviceCombiner:
+    """Accumulator of device-resident partial batches — the
+    ``DrDynamicAggregateManager.h:117-168`` machine->pod->overall
+    aggregation tree kept entirely in HBM.
+
+    Partials pile up untouched until their combined LAYOUT rows (sum of
+    batch capacities — an upper bound on actual rows known without any
+    device readback, so pushes never block the dispatch loop) exceed
+    ``combine_rows`` or the fan-in cap; then ONE N-ary concat+merge job
+    folds them to a single batch.  Concat is one plan node whatever the
+    arity, so a flush compiles one program per distinct fan-in — and a
+    steady stream flushes at a stable fan-in, reusing it.  This matches
+    the serial driver's combine cadence (few, wide merges — not a
+    per-chunk tree) while skipping its per-chunk D2H and host
+    re-ingest.
+
+    Merging on device only pays while merges actually REDUCE (the
+    "merge where it reduces" scheduling of PAPERS.md "Chasing
+    Similarity"): ``push`` returns False when a flush kept >= 3/4 of
+    its inputs' combined layout — high-cardinality keys, whose merged
+    batch would re-enter the accumulator near the threshold and force
+    a shape-churning flush every chunk.  The caller then ``drain()``s
+    and degrades to host-side threshold accumulation."""
+
+    MAX_FANIN = 64  # bounds single-program arity (trace/compile cost)
+
+    def __init__(self, merge_many, combine_rows: int, emit):
+        self._merge_many = merge_many
+        self._combine_rows = combine_rows
+        self._emit = emit
+        self._pending: List[Any] = []
+        self.combines = 0
+
+    def _cap(self) -> int:
+        return sum(b.capacity for b in self._pending)
+
+    def push(self, batch) -> bool:
+        """Insert one partial; False = the flush this push triggered
+        did not reduce (caller should ``drain()`` and change policy)."""
+        self._pending.append(batch)
+        if len(self._pending) < 2 or (
+            self._cap() <= self._combine_rows
+            and len(self._pending) < self.MAX_FANIN
+        ):
+            return True
+        in_cap = self._cap()
+        fan = len(self._pending)
+        merged = self._merge_many(self._pending)
+        self.combines += 1
+        self._pending = [merged]
+        self._emit("stream_combine", cap_rows=merged.capacity,
+                   device=True, fan_in=fan)
+        return merged.capacity < 0.75 * in_cap
+
+    def drain(self) -> List[Any]:
+        """All held batches; the combiner is empty afterwards."""
+        out = self._pending
+        self._pending = []
+        return out
+
+    def fold(self):
+        """Merge everything left into one batch; None when nothing was
+        pushed."""
+        if not self._pending:
+            return None
+        if len(self._pending) == 1:
+            return self._pending.pop()
+        merged = self._merge_many(self._pending)
+        self.combines += 1
+        self._pending = []
+        return merged
+
+
 class StreamExecutor:
     """Drives a plan whose input is a chunk stream; every device job it
     launches is bounded by the chunk/bucket budgets."""
@@ -220,11 +356,29 @@ class StreamExecutor:
         self.bucket_rows = int(getattr(cfg, "stream_bucket_rows", 1 << 21))
         self.combine_rows = int(getattr(cfg, "stream_combine_rows", 1 << 20))
         self.num_buckets = int(getattr(cfg, "stream_buckets", 32))
+        # chunk pipeline: ingest / compute / readback-spill overlap with
+        # this many chunks in flight; 1 = the serial legacy driver
+        self.pipeline_depth = max(
+            1, int(getattr(cfg, "stream_pipeline_depth", 1))
+        )
+        self.writer_queue = int(getattr(cfg, "stream_writer_queue", 8))
         self.max_split_depth = 3
         self.events = ctx.executor.events if ctx.executor else None
         self._small_nodes: Dict[int, Node] = {}
         self._eval_cache: Dict[int, Tuple[str, Any]] = {}
         self._stream_ids: Optional[set] = None
+
+    @property
+    def _pipelined(self) -> bool:
+        return self.pipeline_depth > 1
+
+    def _scope(self) -> _IngestScope:
+        return _IngestScope(self.ctx, cache_plans=self._pipelined)
+
+    def _spill_writer(self) -> Optional[SpillWriter]:
+        if not self._pipelined:
+            return None
+        return SpillWriter(events=self.events, queue_depth=self.writer_queue)
 
     # ---- public --------------------------------------------------------
 
@@ -307,6 +461,24 @@ class StreamExecutor:
         self._small_nodes[node.id] = q.node
         return q.node
 
+    def _chain_root(self, scope: _IngestScope, q, nodes: Sequence[Node]):
+        """Clone the pending chain onto an ingest query ONCE per
+        (reused) input node; a rebound chunk reuses the whole chain —
+        no per-chunk Node cloning, and the lowering keys repeat."""
+        if not scope.cache_plans:
+            cur = q.node
+            for n in nodes:
+                cur = self._clone(n, [cur] + n.inputs[1:])
+            return cur
+        key = (q.node.id,) + tuple(n.id for n in nodes)
+        root = scope.chain_cache.get(key)
+        if root is None:
+            root = q.node
+            for n in nodes:
+                root = self._clone(n, [root] + n.inputs[1:])
+            scope.chain_cache[key] = root
+        return root
+
     def _realize_table(
         self, table: Dict[str, np.ndarray], stream: _Stream,
         scope: _IngestScope, extra: Sequence[Node] = (),
@@ -324,20 +496,14 @@ class StreamExecutor:
                 )
             return table
         q = scope.ingest(table, stream.base_schema)
-        cur = q.node
-        for n in list(stream.pending) + list(extra):
-            cur = self._clone(n, [cur] + n.inputs[1:])
+        cur = self._chain_root(
+            scope, q, list(stream.pending) + list(extra)
+        )
         return self._run_engine(cur)
 
     def _realized(self, stream: _Stream) -> Iterator[Dict[str, np.ndarray]]:
-        if stream.consumed:
-            raise RuntimeError("stream already consumed (tee over streams "
-                               "needs an explicit to_store)")
-        stream.consumed = True
-        scope = _IngestScope(self.ctx)
-        for table in stream.chunks:
-            if not _chunk_rows(table):
-                continue
+        scope = self._scope()
+        for table in self._iter_base(stream):
             yield self._realize_table(table, stream, scope)
 
     # ---- evaluator -----------------------------------------------------
@@ -416,58 +582,74 @@ class StreamExecutor:
             self._grace_buckets([(stream, keys)], [node], node.schema),
         )
 
-    def _group_partial(self, node, stream, keys, agg_list):
+    def _finalize_query(self, q, plan, keys, out_schema):
+        """Append the merge finalizer (mean = sum/count, renames) to a
+        merged-partials query."""
+        fin = finalize_fn(plan)
+
+        def full(cols, _fin=fin, _keys=keys):
+            from dryad_tpu.exec.partial import copy_physical
+
+            out = {}
+            for kk in _keys:
+                copy_physical(cols, kk, kk, out)
+            out.update(_fin(cols))
+            return out
+
+        return q.select(full, schema=out_schema)
+
+    def _chunk_partial_query(self, scope, stream, table, node, keys, partial):
+        """One chunk's partial group query, chain-cached: a rebound
+        chunk reuses the ingest node, the pending clones, AND the
+        group node — the whole per-chunk plan repeats (tentpole (a))."""
         from dryad_tpu.api.query import Query
 
-        partial, plan = partial_plan(agg_list)
-        merge_spec = merge_agg_spec(plan)
-        scope = _IngestScope(self.ctx)
-        mscope = _IngestScope(self.ctx)
-        acc: List[Dict[str, np.ndarray]] = []
-        acc_rows = 0
-        pschema = None
-
-        def chunk_partial(table):
-            q = scope.ingest(table, stream.base_schema)
-            cur = q.node
-            for n in stream.pending:
-                cur = self._clone(n, [cur] + n.inputs[1:])
+        q = scope.ingest(table, stream.base_schema)
+        key = ("gp", q.node.id)
+        pq = scope.chain_cache.get(key)
+        if pq is None:
+            cur = self._chain_root(scope, q, stream.pending)
             pq = Query(self.ctx, cur).group_by(
                 keys, partial,
                 dense=node.params.get("dense"),
                 salt=node.params.get("salt"),
             )
-            return pq.schema, self.ctx.run_to_host(pq)
+            if scope.cache_plans:
+                scope.chain_cache[key] = pq
+        return pq
+
+    def _group_partial(self, node, stream, keys, agg_list):
+        if self._pipelined:
+            return self._group_partial_device(node, stream, keys, agg_list)
+        return self._group_partial_serial(node, stream, keys, agg_list)
+
+    def _group_partial_serial(self, node, stream, keys, agg_list):
+        """Legacy serial driver (stream_pipeline_depth=1): per-chunk
+        host readback of partials, host-side combine re-ingest."""
+        partial, plan = partial_plan(agg_list)
+        merge_spec = merge_agg_spec(plan)
+        scope = self._scope()
+        mscope = self._scope()
+        acc: List[Dict[str, np.ndarray]] = []
+        acc_rows = 0
+        pschema = None
 
         def combine(tables, final: bool):
             cat = _concat_tables(tables, pschema)
             q = mscope.ingest(cat, pschema).group_by(keys, merge_spec)
             if final:
-                fin = finalize_fn(plan)
-
-                def full(cols, _fin=fin, _keys=keys):
-                    from dryad_tpu.exec.partial import copy_physical
-
-                    out = {}
-                    for kk in _keys:
-                        copy_physical(cols, kk, kk, out)
-                    out.update(_fin(cols))
-                    return out
-
-                q = q.select(full, schema=node.schema)
+                q = self._finalize_query(q, plan, keys, node.schema)
             return self.ctx.run_to_host(q)
 
         nchunks = 0
-        if stream.consumed:
-            raise RuntimeError("stream already consumed")
-        stream.consumed = True
-        for table in stream.chunks:
+        for table in self._iter_base(stream):
             n = _chunk_rows(table)
-            if not n:
-                continue
-            ps, pt = chunk_partial(table)
+            pq = self._chunk_partial_query(
+                scope, stream, table, node, keys, partial
+            )
             if pschema is None:
-                pschema = ps
+                pschema = pq.schema
+            pt = self.ctx.run_to_host(pq)
             rows = len(next(iter(pt.values()))) if pt else 0
             acc.append(pt)
             acc_rows += rows
@@ -481,6 +663,101 @@ class StreamExecutor:
         if pschema is None:  # empty stream
             return "small", _empty_table(node.schema)
         out = combine(acc, final=True)
+        self._emit("stream_group_done", chunks=nchunks,
+                   groups=len(next(iter(out.values()))) if out else 0)
+        return "small", out
+
+    def _batch_to_host(self, batch, schema) -> Dict[str, np.ndarray]:
+        """Materialize a device batch as a host logical table (the
+        degrade path when device-side combining stops paying)."""
+        return batch.to_numpy(schema, self.ctx.dictionary)
+
+    def _group_partial_device(self, node, stream, keys, agg_list):
+        """Pipelined driver: per-chunk partials stay DEVICE-RESIDENT
+        (dispatched, never fetched), accumulate as ColumnBatches in HBM
+        and merge device-to-device — the scatter phase pays one D2H at
+        the END instead of one per chunk (the DrDynamicAggregateManager
+        machine->pod tree folded onto the accelerator; DrJAX's
+        device-resident MapReduce partials).
+
+        High-cardinality streams whose merges show no reduction (static
+        capacity check in :class:`_DeviceCombiner`) degrade to the
+        serial driver's host-side threshold accumulation — on such
+        streams device merging re-processes every row for nothing,
+        while host accumulation pays one cheap transfer per chunk."""
+        partial, plan = partial_plan(agg_list)
+        merge_spec = merge_agg_spec(plan)
+        scope = self._scope()
+        mscope = self._scope()
+        pschema = None
+
+        def merge_many(batches):
+            qs = [self.ctx._from_device_batch(b, pschema) for b in batches]
+            q = qs[0].concat(*qs[1:])  # ONE N-ary concat node/stage
+            return self.ctx._execute_device(q.group_by(keys, merge_spec))
+
+        def host_combine(tables, final: bool):
+            cat = _concat_tables(tables, pschema)
+            q = mscope.ingest(cat, pschema).group_by(keys, merge_spec)
+            if final:
+                q = self._finalize_query(q, plan, keys, node.schema)
+            return self.ctx.run_to_host(q)
+
+        comb = _DeviceCombiner(merge_many, self.combine_rows, self._emit)
+        host_acc: Optional[List[Dict[str, np.ndarray]]] = None
+        host_rows = 0
+        nchunks = 0
+        for table in self._iter_base(stream):
+            n = _chunk_rows(table)
+            pq = self._chunk_partial_query(
+                scope, stream, table, node, keys, partial
+            )
+            if pschema is None:
+                pschema = pq.schema
+            batch = self.ctx._execute_device(pq)  # partial stays in HBM
+            nchunks += 1
+            self._emit("stream_chunk", rows=n, partial_cap=batch.capacity)
+            if host_acc is None and nchunks == 1 \
+                    and batch.capacity >= 0.75 * n:
+                # the FIRST partial barely reduced its chunk: keys are
+                # high-cardinality, device merging cannot pay — degrade
+                # before paying even one probe merge
+                host_acc = []
+                self._emit("stream_combine_policy", mode="host",
+                           chunks=nchunks, static=True)
+            if host_acc is None:
+                if comb.push(batch):
+                    continue
+                # no reduction: degrade to host accumulation
+                host_acc = [
+                    self._batch_to_host(b, pschema) for b in comb.drain()
+                ]
+                host_rows = sum(
+                    len(next(iter(t.values()))) if t else 0
+                    for t in host_acc
+                )
+                self._emit("stream_combine_policy", mode="host",
+                           chunks=nchunks)
+            else:
+                pt = self._batch_to_host(batch, pschema)
+                host_acc.append(pt)
+                host_rows += len(next(iter(pt.values()))) if pt else 0
+            if host_rows > self.combine_rows and len(host_acc) > 1:
+                merged = host_combine(host_acc, final=False)
+                host_acc = [merged]
+                host_rows = len(next(iter(merged.values()))) if merged else 0
+                self._emit("stream_combine", rows_out=host_rows)
+        if pschema is None:  # empty stream
+            return "small", _empty_table(node.schema)
+        if host_acc is not None:
+            out = host_combine(host_acc, final=True)
+        else:
+            folded = comb.fold()
+            q = self.ctx._from_device_batch(folded, pschema).group_by(
+                keys, merge_spec
+            )
+            q = self._finalize_query(q, plan, keys, node.schema)
+            out = self.ctx.run_to_host(q)
         self._emit("stream_group_done", chunks=nchunks,
                    groups=len(next(iter(out.values()))) if out else 0)
         return "small", out
@@ -499,54 +776,116 @@ class StreamExecutor:
             )
         partial, plan = partial_plan(agg_list)
         merge_spec = merge_agg_spec(plan)
-        scope = _IngestScope(self.ctx)
-        acc: List[Dict[str, np.ndarray]] = []
+        scope = self._scope()
+        fin = finalize_fn(plan)
         pschema = None
-        for table in self._iter_base(stream):
+
+        def chunk_query(table):
             q = scope.ingest(table, stream.base_schema)
-            cur = q.node
-            for n in stream.pending:
-                cur = self._clone(n, [cur] + n.inputs[1:])
-            pq = Query(self.ctx, cur).aggregate_as_query(partial)
+            key = ("agg", q.node.id)
+            pq = scope.chain_cache.get(key)
+            if pq is None:
+                cur = self._chain_root(scope, q, stream.pending)
+                pq = Query(self.ctx, cur).aggregate_as_query(partial)
+                if scope.cache_plans:
+                    scope.chain_cache[key] = pq
+            return pq
+
+        if self._pipelined:
+            # device-resident partials + N-ary device merge: one D2H
+            # total (scalar partials are one row each, so flushes
+            # always reduce and never degrade)
+            def merge_many(batches):
+                qs = [
+                    self.ctx._from_device_batch(b, pschema) for b in batches
+                ]
+                q = qs[0].concat(*qs[1:]).aggregate_as_query(merge_spec)
+                return self.ctx._execute_device(q)
+
+            comb = _DeviceCombiner(merge_many, self.combine_rows, self._emit)
+            for table in self._iter_base(stream):
+                pq = chunk_query(table)
+                if pschema is None:
+                    pschema = pq.schema
+                comb.push(self.ctx._execute_device(pq))
+            folded = comb.fold()
+            if folded is None:
+                raise StreamNotSupported(
+                    "scalar aggregate over an empty stream"
+                )
+            q = self.ctx._from_device_batch(folded, pschema)
+            q = q.aggregate_as_query(merge_spec)
+            q = q.select(lambda cols: fin(cols), schema=node.schema)
+            return "small", self.ctx.run_to_host(q)
+
+        # serial driver: host partials, bounded by the SAME combine
+        # threshold as _group_partial — a long stream must not grow the
+        # accumulator one partial row per chunk without bound
+        acc_t: List[Dict[str, np.ndarray]] = []
+        acc_rows = 0
+        mscope = self._scope()
+        for table in self._iter_base(stream):
+            pq = chunk_query(table)
             if pschema is None:
                 pschema = pq.schema
-            acc.append(self.ctx.run_to_host(pq))
+            pt = self.ctx.run_to_host(pq)
+            acc_t.append(pt)
+            acc_rows += len(next(iter(pt.values()))) if pt else 0
+            if acc_rows > self.combine_rows and len(acc_t) > 1:
+                cat = _concat_tables(acc_t, pschema)
+                merged = self.ctx.run_to_host(
+                    mscope.ingest(cat, pschema).aggregate_as_query(merge_spec)
+                )
+                acc_t = [merged]
+                acc_rows = len(next(iter(merged.values()))) if merged else 0
+                self._emit("stream_combine", rows_out=acc_rows)
         if pschema is None:
             raise StreamNotSupported("scalar aggregate over an empty stream")
-        mscope = _IngestScope(self.ctx)
-        cat = _concat_tables(acc, pschema)
+        cat = _concat_tables(acc_t, pschema)
         q = mscope.ingest(cat, pschema).aggregate_as_query(merge_spec)
-        fin = finalize_fn(plan)
         q = q.select(lambda cols: fin(cols), schema=node.schema)
         return "small", self.ctx.run_to_host(q)
 
     def _iter_base(self, stream: _Stream):
+        """Non-empty base chunks, read ahead by the prefetch thread when
+        the pipeline is on: the source generator's host work (tokenize,
+        disk read, decode) for chunk k+2 overlaps the driver's device
+        dispatch of chunk k+1 (``exec.pipeline``)."""
         if stream.consumed:
-            raise RuntimeError("stream already consumed")
+            raise RuntimeError("stream already consumed (tee over streams "
+                               "needs an explicit to_store)")
         stream.consumed = True
-        for table in stream.chunks:
-            if _chunk_rows(table):
-                yield table
+
+        def nonempty():
+            for table in stream.chunks:
+                if _chunk_rows(table):
+                    yield table
+
+        yield from prefetched(
+            nonempty(), self.pipeline_depth, events=self.events,
+            name="ingest",
+        )
 
     # ---- distinct ------------------------------------------------------
 
     def _eval_distinct(self, node: Node, stream: _Stream):
         keys = list(node.params["keys"] or stream.schema.names)
-        scope = _IngestScope(self.ctx)
+        scope = self._scope()
         acc: List[Dict[str, np.ndarray]] = []
         acc_rows = 0
         spill = None
+        writer = None
         try:
             for table in self._iter_base(stream):
                 t = self._realize_table(table, stream, scope, extra=[node])
                 rows = len(next(iter(t.values()))) if t else 0
                 if spill is not None:
-                    self._spill_by_hash(spill, t, keys, 0)
+                    self._spill_by_hash(spill, t, keys, 0, writer=writer)
                     continue
                 acc.append(t)
                 acc_rows += rows
                 if acc_rows > self.combine_rows and len(acc) > 1:
-                    cscope = _IngestScope(self.ctx)
+                    cscope = self._scope()
                     cat = _concat_tables(acc, node.schema)
                     cur = self._clone(
                         node, [cscope.ingest(cat, node.schema).node]
@@ -560,33 +899,43 @@ class StreamExecutor:
                         # high cardinality: switch to Grace spilling
                         spill = SpillDir(self.ctx.dictionary,
                                          root=self._spill_root())
-                        self._spill_by_hash(spill, merged, keys, 0)
+                        writer = self._spill_writer()
+                        self._spill_by_hash(spill, merged, keys, 0,
+                                            writer=writer)
                         acc = []
                         self._emit("stream_distinct_spill", rows=acc_rows)
+            if writer is not None:
+                writer.flush()
         except BaseException:
+            if writer is not None:
+                writer.close(drain=False)
+                writer = None
             if spill is not None:
                 spill.cleanup()
             raise
+        finally:
+            if writer is not None:
+                writer.close()
         if spill is None:
             if not acc:
-                return "small", {f.name: np.array([]) for f in
-                                 node.schema.fields}
-            cscope = _IngestScope(self.ctx)
+                return "small", _empty_table(node.schema)
+            cscope = self._scope()
             cat = _concat_tables(acc, node.schema)
             cur = self._clone(node, [cscope.ingest(cat, node.schema).node])
             return "small", self._run_engine(cur)
 
         def buckets():
             try:
-                bscope = _IngestScope(self.ctx)
+                bscope = self._scope()
                 for b in spill.buckets():
+                    rows = spill.bucket_rows(b)
                     t = spill.read_bucket(b)
+                    bscope.cap = self._bucket_cap(rows)
                     cur = self._clone(
                         node, [bscope.ingest(t, node.schema).node]
                     )
                     out = self._run_engine(cur)
-                    self._emit("stream_bucket", bucket=b,
-                               rows=spill.bucket_rows(b))
+                    self._emit("stream_bucket", bucket=b, rows=rows)
                     yield out
             finally:
                 spill.cleanup()
@@ -601,6 +950,27 @@ class StreamExecutor:
             node.schema, self._external_sort(node, stream, keys)
         )
 
+    def _bucket_cap(self, rows: int) -> int:
+        """Per-partition capacity for a bucket job from its OBSERVED
+        rows: the next power-of-two step of the per-partition need
+        (min 8), capped at the configured bucket budget.  Padding
+        shrinks from the worst-case layout (~16x waste on typical
+        shapes) to < 2x the data, while the pow2 palette keeps the
+        number of distinct compiled programs logarithmic.
+
+        The serial legacy driver (depth 1) keeps its original
+        worst-case capacity — one compiled program for ALL buckets, and
+        the differential baseline the pipeline is measured against."""
+        P = self._P()
+        full = max(1, math.ceil(self.bucket_rows / P / 8) * 8)
+        if not self._pipelined:
+            return full
+        need = max(1, -(-max(rows, 1) // P))
+        cap = 8
+        while cap < need:
+            cap *= 2
+        return min(cap, full)
+
     def _external_sort(
         self, node, stream, keys, pieces=None, depth=0, splitters=None
     ):
@@ -608,17 +978,27 @@ class StreamExecutor:
         each bucket on device and emit in key order.  Oversized buckets
         re-split from observed volume; a single-value bucket falls
         through to the secondary keys (or emits as-is when none —
-        equal-key order is unspecified)."""
+        equal-key order is unspecified).
+
+        Pipelined (depth knob > 1): bucket writes go through the
+        background SpillWriter so they overlap the next chunk's
+        routing, and phase 2 keeps ``stream_pipeline_depth`` bucket
+        sorts in flight — read/decode of bucket k+2 on the prefetch
+        thread, dispatch of k+1, readback of k."""
         primary, pdesc = keys[0]
         spill = SpillDir(self.ctx.dictionary, root=self._spill_root())
+        writer = self._spill_writer()
         try:
-            scope = _IngestScope(self.ctx)
-            src = (
-                self._iter_pieces_realized(pieces)
-                if pieces is not None
-                else (self._realize_table(t, stream, scope)
-                      for t in self._iter_base(stream))
-            )
+            scope = self._scope()
+            if pieces is not None:
+                src = prefetched(
+                    self._iter_pieces_realized(pieces),
+                    self.pipeline_depth, events=self.events,
+                    name=f"resplit{depth}",
+                )
+            else:
+                src = (self._realize_table(t, stream, scope)
+                       for t in self._iter_base(stream))
             # exact per-bucket key extent, tracked at spill time — the
             # all-equal decision below must not rest on a sample (a few
             # minority rows in a fat bucket would go out unsorted)
@@ -636,34 +1016,86 @@ class StreamExecutor:
                         pmn, pmx = extent[b]
                         mn, mx = min(mn, pmn), max(mx, pmx)
                     extent[int(b)] = (mn, mx)
-                    n = spill.append(
-                        int(b), {c: v[sel] for c, v in t.items()}
-                    )
-                    self._emit("stream_spill", bucket=int(b), rows=n,
-                               depth=depth)
+                    piece = {c: v[sel] for c, v in t.items()}
+                    if writer is not None:
+                        writer.submit(spill, int(b), piece, depth)
+                    else:
+                        n = spill.append(int(b), piece)
+                        self._emit("stream_spill", bucket=int(b), rows=n,
+                                   depth=depth)
+            if writer is not None:
+                writer.flush()  # phase barrier: bucket metadata is final
             order = spill.buckets()
             if pdesc:
                 order = list(reversed(order))
-            # ONE ingest scope for every bucket: a shared partition
-            # capacity keeps all bucket sorts on one compiled program
-            bscope = _IngestScope(self.ctx)
-            bscope.cap = max(
-                1, math.ceil(self.bucket_rows / self._P() / 8) * 8
+            yield from self._sort_buckets(
+                node, spill, order, extent, keys, depth
             )
+        finally:
+            if writer is not None:
+                writer.close(drain=False)
+            spill.cleanup()
+
+    def _sort_buckets(self, node, spill, order, extent, keys, depth):
+        """Phase 2 of the external sort: per-bucket device sorts in
+        key order, with read-ahead and a bounded dispatch window when
+        pipelined."""
+        from dryad_tpu.api.query import Query
+
+        primary, _pdesc = keys[0]
+        # one scope for all buckets: the pow2 capacity palette keeps
+        # repeated bucket sizes on the same compiled program
+        bscope = self._scope()
+
+        def reads():
             for b in order:
                 rows = spill.bucket_rows(b)
-                if rows <= self.bucket_rows:
-                    t = spill.read_bucket(b)
+                # oversized buckets are re-split by the driver, which
+                # streams their pieces — don't read them whole ahead
+                table = (
+                    spill.read_bucket(b) if rows <= self.bucket_rows
+                    else None
+                )
+                yield b, rows, table
+
+        src = prefetched(
+            reads(), self.pipeline_depth, events=self.events,
+            name=f"sortread{depth}",
+        )
+        inflight: deque = deque()  # (fetch, bucket, rows)
+
+        def drain_one():
+            fetch, b, rows = inflight.popleft()
+            out = fetch()
+            self._emit("stream_bucket", bucket=b, rows=rows, depth=depth)
+            spill.drop_bucket(b)
+            return out
+
+        try:
+            for b, rows, t in src:
+                if t is not None:
+                    bscope.cap = self._bucket_cap(rows)
                     cur = self._clone(
                         node, [bscope.ingest(t, node.schema).node]
                     )
-                    out = self._run_engine(cur)
-                    self._emit("stream_bucket", bucket=b, rows=rows,
-                               depth=depth)
-                    yield out
-                    spill.drop_bucket(b)
+                    if self._pipelined:
+                        fetch = self.ctx.run_to_host_async(
+                            Query(self.ctx, cur)
+                        )
+                        inflight.append((fetch, b, rows))
+                        while len(inflight) >= self.pipeline_depth:
+                            yield drain_one()
+                    else:
+                        out = self._run_engine(cur)
+                        self._emit("stream_bucket", bucket=b, rows=rows,
+                                   depth=depth)
+                        yield out
+                        spill.drop_bucket(b)
                     continue
-                # oversized: observed-volume adaptation
+                # oversized: results must stay in key order, so the
+                # dispatch window drains before the re-split recursion
+                while inflight:
+                    yield drain_one()
                 if depth >= self.max_split_depth:
                     raise RuntimeError(
                         f"sort bucket {b} still holds {rows} rows at "
@@ -702,8 +1134,11 @@ class StreamExecutor:
                     depth=depth + 1, splitters=sub,
                 )
                 spill.drop_bucket(b)
+            while inflight:
+                yield drain_one()
         finally:
-            spill.cleanup()
+            if hasattr(src, "close"):
+                src.close()
 
     def _iter_pieces_realized(self, pieces):
         spill, b = pieces
@@ -740,30 +1175,33 @@ class StreamExecutor:
     def _grace_join(self, node, ls, rs, lk, rk, depth=0):
         lspill = SpillDir(self.ctx.dictionary, root=self._spill_root())
         rspill = SpillDir(self.ctx.dictionary, root=self._spill_root())
+        writer = self._spill_writer()
         try:
-            lscope = _IngestScope(self.ctx)
-            rscope = _IngestScope(self.ctx)
+            lscope = self._scope()
+            rscope = self._scope()
             for t in (self._realize_table(x, ls, lscope)
                       for x in self._iter_base(ls)):
-                self._spill_by_hash(lspill, t, lk, depth)
+                self._spill_by_hash(lspill, t, lk, depth, writer=writer)
             for t in (self._realize_table(x, rs, rscope)
                       for x in self._iter_base(rs)):
-                self._spill_by_hash(rspill, t, rk, depth)
+                self._spill_by_hash(rspill, t, rk, depth, writer=writer)
+            if writer is not None:
+                writer.flush()
             yield from self._join_buckets(
                 node, lspill, rspill, lk, rk, depth
             )
         finally:
+            if writer is not None:
+                writer.close(drain=False)
             lspill.cleanup()
             rspill.cleanup()
 
     def _join_buckets(self, node, lspill, rspill, lk, rk, depth):
         jkind = node.params.get("join_kind", "inner")
-        # shared per-side scopes: stable capacities -> one compiled
-        # join program across buckets
-        lscope = _IngestScope(self.ctx)
-        rscope = _IngestScope(self.ctx)
-        cap = max(1, math.ceil(self.bucket_rows / self._P() / 8) * 8)
-        lscope.cap = rscope.cap = cap
+        # shared per-side scopes: the pow2 capacity palette keeps
+        # repeated bucket sizes on the same compiled join program
+        lscope = self._scope()
+        rscope = self._scope()
         for b in sorted(set(lspill.buckets()) | set(rspill.buckets())):
             lrows = lspill.bucket_rows(b)
             rrows = rspill.bucket_rows(b)
@@ -800,6 +1238,8 @@ class StreamExecutor:
                 lt = _empty_table(node.inputs[0].schema)
             if not rt:
                 rt = _empty_table(node.inputs[1].schema)
+            lscope.cap = self._bucket_cap(lrows)
+            rscope.cap = self._bucket_cap(rrows)
             lq = lscope.ingest(lt, node.inputs[0].schema)
             rq = rscope.ingest(rt, node.inputs[1].schema)
             cur = self._clone(node, [lq.node, rq.node])
@@ -814,37 +1254,48 @@ class StreamExecutor:
         group_by)."""
         (stream, keys), = sides
         spill = SpillDir(self.ctx.dictionary, root=self._spill_root())
+        writer = self._spill_writer()
         try:
-            scope = _IngestScope(self.ctx)
+            scope = self._scope()
             for t in (self._realize_table(x, stream, scope)
                       for x in self._iter_base(stream)):
-                self._spill_by_hash(spill, t, keys, 0)
-            bscope = _IngestScope(self.ctx)
+                self._spill_by_hash(spill, t, keys, 0, writer=writer)
+            if writer is not None:
+                writer.flush()
+            bscope = self._scope()
             base_schema = stream.schema
             yield from self._grace_bucket_tables(
                 spill, bscope, base_schema, tail_nodes
             )
         finally:
+            if writer is not None:
+                writer.close(drain=False)
             spill.cleanup()
 
     def _grace_bucket_tables(self, spill, bscope, base_schema, tail_nodes):
         for b in spill.buckets():
+            rows = spill.bucket_rows(b)
             t = spill.read_bucket(b)
+            bscope.cap = self._bucket_cap(rows)
             cur = bscope.ingest(t, base_schema).node
             for n in tail_nodes:
                 cur = self._clone(n, [cur] + n.inputs[1:])
             out = self._run_engine(cur)
-            self._emit("stream_bucket", bucket=b, rows=spill.bucket_rows(b))
+            self._emit("stream_bucket", bucket=b, rows=rows)
             yield out
 
-    def _spill_by_hash(self, spill, table, keys, depth):
+    def _spill_by_hash(self, spill, table, keys, depth, writer=None):
         bids = _host_hash_buckets(
             table, keys, self.num_buckets, salt=depth,
             dictionary=self.ctx.dictionary,
         )
         for b in np.unique(bids):
             sel = bids == b
-            n = spill.append(int(b), {c: v[sel] for c, v in table.items()})
+            piece = {c: v[sel] for c, v in table.items()}
+            if writer is not None:
+                writer.submit(spill, int(b), piece, depth)
+                continue
+            n = spill.append(int(b), piece)
             self._emit("stream_spill", bucket=int(b), rows=n, depth=depth)
 
     def _spill_root(self):

@@ -1,0 +1,225 @@
+"""Bounded chunk pipeline for out-of-core execution.
+
+The reference overlaps channel ingest, vertex compute, and channel
+writes with async read-ahead buffers (``channelbufferhdfs.cpp``;
+``RChannelReader`` in ``channelinterface.h:212``): a vertex never waits
+for the byte it is about to need because the buffer pool fetched it
+while the previous one computed.  This module is that overlap for the
+TPU streaming driver (``exec.outofcore``):
+
+- :class:`ChunkPrefetcher` — a background producer pulling (and
+  host-decoding) chunk k+2 from the source iterator while the driver
+  dispatches chunk k+1's device program, with at most
+  ``stream_pipeline_depth`` chunks in flight (semaphore flow control,
+  so "in flight" counts the producer's in-hand chunk too);
+- :class:`PipelineStats` — per-pipeline occupancy/stall accounting
+  (producer vs consumer wait), emitted as ``stream_prefetch`` events
+  per chunk and one ``stream_pipeline`` summary at close for
+  ``tools.jobview``'s stall breakdown;
+- exception plumbing: a fault in the producer thread re-raises in the
+  consumer (annotated with the ``exec.failure`` taxonomy via a
+  ``stream_pipeline_error`` event), the thread always joins, and the
+  semaphore protocol guarantees the producer can never deadlock on a
+  dead consumer.
+
+The spill half of the pipeline (background bucket writes) lives next
+to the format it serializes: ``exec.spill.SpillWriter``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Iterator, Optional
+
+__all__ = ["ChunkPrefetcher", "PipelineStats", "prefetched"]
+
+
+class PipelineStats:
+    """Occupancy/stall counters for one pipeline stage pair."""
+
+    def __init__(self) -> None:
+        self.produced = 0
+        self.consumed = 0
+        self.peak_in_flight = 0
+        self.producer_wait_s = 0.0  # producer blocked: consumer behind
+        self.consumer_wait_s = 0.0  # consumer blocked: producer behind
+
+    def as_fields(self) -> dict:
+        return {
+            "produced": self.produced,
+            "consumed": self.consumed,
+            "peak_in_flight": self.peak_in_flight,
+            "producer_wait_s": round(self.producer_wait_s, 4),
+            "consumer_wait_s": round(self.consumer_wait_s, 4),
+        }
+
+
+class _Done:
+    pass
+
+
+class _Err:
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+class ChunkPrefetcher:
+    """Bounded background iterator: runs ``source`` in a thread, hands
+    items to the consumer IN ORDER, and keeps at most ``depth`` items
+    in flight (queued + the one the producer holds).
+
+    ``close()`` (idempotent; called by ``__exit__`` and generator
+    finalization) stops the producer promptly: it stops pulling new
+    items at the next semaphore check and the thread joins.  An
+    exception in the producer re-raises from the consumer's next
+    ``__next__`` — the original exception object, so the driver's
+    failure taxonomy (``exec.failure.classify``) sees the real class
+    and message.
+    """
+
+    def __init__(
+        self,
+        source: Iterator,
+        depth: int,
+        events=None,
+        name: str = "prefetch",
+    ):
+        if depth < 1:
+            raise ValueError("pipeline depth must be >= 1")
+        self.depth = depth
+        self.name = name
+        self.events = events
+        self.stats = PipelineStats()
+        self._source = source
+        self._sem = threading.Semaphore(depth)  # in-flight budget
+        self._items: list = []
+        self._cv = threading.Condition()
+        self._closed = False
+        self._finished = False
+        self._thread = threading.Thread(
+            target=self._feed, name=f"dryad-{name}", daemon=True
+        )
+        self._thread.start()
+
+    # -- producer ----------------------------------------------------------
+
+    def _feed(self) -> None:
+        tail: Any = _Done()
+        try:
+            it = iter(self._source)
+            while True:
+                t0 = time.monotonic()
+                # acquire BEFORE pulling the next item: in-flight
+                # (queued + producer in-hand) never exceeds depth
+                while not self._sem.acquire(timeout=0.1):
+                    if self._closed:
+                        return
+                self.stats.producer_wait_s += time.monotonic() - t0
+                if self._closed:
+                    return
+                try:
+                    item = next(it)
+                except StopIteration:
+                    return
+                with self._cv:
+                    self._items.append(item)
+                    self.stats.produced += 1
+                    in_flight = self.stats.produced - self.stats.consumed
+                    self.stats.peak_in_flight = max(
+                        self.stats.peak_in_flight, in_flight
+                    )
+                    self._cv.notify_all()
+                if self.events is not None:
+                    self.events.emit(
+                        "stream_prefetch", pipeline=self.name,
+                        queued=len(self._items), in_flight=in_flight,
+                    )
+        except BaseException as e:  # noqa: BLE001 - re-raised in consumer
+            tail = _Err(e)
+        finally:
+            with self._cv:
+                self._finished = True
+                self._items.append(tail)
+                self._cv.notify_all()
+
+    # -- consumer ----------------------------------------------------------
+
+    def __iter__(self) -> Iterator:
+        return self
+
+    def __next__(self):
+        t0 = time.monotonic()
+        with self._cv:
+            while not self._items:
+                self._cv.wait(0.1)
+                if self._closed and not self._items:
+                    raise StopIteration
+            item = self._items.pop(0)
+        self.stats.consumer_wait_s += time.monotonic() - t0
+        if isinstance(item, _Done):
+            self._emit_summary()
+            raise StopIteration
+        if isinstance(item, _Err):
+            self._emit_summary(error=item.exc)
+            raise item.exc
+        self.stats.consumed += 1
+        self._sem.release()
+        return item
+
+    def close(self) -> None:
+        """Stop the producer and join its thread.  Safe to call from
+        ``finally`` blocks and repeatedly."""
+        with self._cv:
+            if self._closed:
+                closed_already = True
+            else:
+                closed_already = False
+                self._closed = True
+            self._cv.notify_all()
+        # unblock a producer waiting on the semaphore
+        self._sem.release()
+        self._thread.join(timeout=30.0)
+        if not closed_already:
+            self._emit_summary()
+
+    def __enter__(self) -> "ChunkPrefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    _summary_emitted = False
+
+    def _emit_summary(self, error: Optional[BaseException] = None) -> None:
+        if self.events is None or self._summary_emitted:
+            return
+        self._summary_emitted = True
+        self.events.emit(
+            "stream_pipeline", pipeline=self.name, depth=self.depth,
+            **self.stats.as_fields(),
+        )
+        if error is not None:
+            from dryad_tpu.exec.failure import classify
+
+            self.events.emit(
+                "stream_pipeline_error", pipeline=self.name,
+                phase="prefetch",
+                failure_kind=classify(error, []).value,
+                error=f"{type(error).__name__}: {error}",
+            )
+
+
+def prefetched(source, depth: int, events=None, name: str = "prefetch"):
+    """Generator wrapper: yield from a :class:`ChunkPrefetcher` over
+    ``source`` when ``depth > 1``, closing it even when the consumer
+    abandons the stream early (``take``); pass-through at depth 1 (the
+    serial driver — no thread, no reordering risk)."""
+    if depth <= 1:
+        yield from source
+        return
+    pf = ChunkPrefetcher(iter(source), depth, events=events, name=name)
+    try:
+        yield from pf
+    finally:
+        pf.close()

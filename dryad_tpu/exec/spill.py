@@ -16,7 +16,9 @@ import contextlib
 import os
 import shutil
 import tempfile
-from typing import Dict, Iterator, List, Optional
+import threading
+import time
+from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -131,3 +133,126 @@ class SpillDir:
             shutil.rmtree(self.root, ignore_errors=True)
         self._pieces.clear()
         self._rows.clear()
+
+
+class SpillWriter:
+    """Buffered background writer: ``SpillDir.append`` moved off the
+    driver loop so bucket writes overlap the next chunk's compute (the
+    async channel-writer half of the reference's buffer pool,
+    ``channelbufferqueue.cpp``).
+
+    One writer THREAD, FIFO order: per-bucket piece indices are
+    assigned in submit order, so the spilled bytes are identical to the
+    serial driver's — the streaming differential guarantee
+    ("byte-identical to the serial path") holds under the pipeline.
+
+    A write error is latched and re-raised from the NEXT ``submit`` or
+    from ``flush()`` — the driver's existing cleanup path (``finally:
+    spill.cleanup()``) then removes the directory, so a mid-stream
+    fault leaves no orphaned spills.  ``flush()`` is the phase barrier:
+    phase 2 may only read bucket metadata after it returns.
+    """
+
+    def __init__(self, events=None, queue_depth: int = 8):
+        self.events = events
+        self._max = max(1, queue_depth)
+        self._q: List[Tuple] = []
+        self._cv = threading.Condition()
+        self._err: Optional[BaseException] = None
+        self._closed = False
+        self._busy = False  # a write is in progress (flush barrier)
+        self.write_s = 0.0  # total seconds spent writing (observability)
+        self.submit_wait_s = 0.0  # driver blocked on a full queue
+        self.pieces = 0
+        self._thread = threading.Thread(
+            target=self._run, name="dryad-spill-writer", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._q and not self._closed:
+                    self._cv.wait(0.1)
+                if not self._q and self._closed:
+                    return
+                job = self._q.pop(0)
+                self._busy = True
+                self._cv.notify_all()
+            spill, bucket, table, depth = job
+            t0 = time.monotonic()
+            try:
+                n = spill.append(bucket, table)
+                self.pieces += 1
+                if self.events is not None and n:
+                    self.events.emit(
+                        "stream_spill", bucket=bucket, rows=n, depth=depth
+                    )
+            except BaseException as e:  # noqa: BLE001 - latched for driver
+                with self._cv:
+                    if self._err is None:
+                        self._err = e
+                    self._q.clear()  # poisoned stream: drop queued writes
+            finally:
+                self.write_s += time.monotonic() - t0
+                with self._cv:
+                    self._busy = False
+                    self._cv.notify_all()
+
+    def _raise_pending(self) -> None:
+        if self._err is not None:
+            err, self._err = self._err, None
+            if self.events is not None:
+                from dryad_tpu.exec.failure import classify
+
+                self.events.emit(
+                    "stream_pipeline_error", pipeline="spill",
+                    phase="spill",
+                    failure_kind=classify(err, []).value,
+                    error=f"{type(err).__name__}: {err}",
+                )
+            raise err
+
+    def submit(self, spill: SpillDir, bucket: int, table, depth: int = 0):
+        """Queue one piece write; blocks when ``queue_depth`` writes are
+        pending (bounded memory), raises a latched writer error."""
+        t0 = time.monotonic()
+        with self._cv:
+            self._raise_pending()
+            while len(self._q) >= self._max and self._err is None \
+                    and not self._closed:
+                self._cv.wait(0.1)
+            self._raise_pending()
+            if self._closed:
+                raise RuntimeError("spill writer is closed")
+            self._q.append((spill, bucket, table, depth))
+            self._cv.notify_all()
+        self.submit_wait_s += time.monotonic() - t0
+
+    def flush(self) -> None:
+        """Barrier: all submitted writes are durable (or the first
+        error raises)."""
+        with self._cv:
+            while (self._q or self._busy) and self._err is None:
+                self._cv.wait(0.1)
+            self._raise_pending()
+
+    def close(self, drain: bool = True) -> None:
+        """Stop the writer.  ``drain=True`` flushes first (clean end of
+        stream); ``drain=False`` abandons queued writes (error path —
+        the caller is about to remove the spill directory anyway)."""
+        if drain and self._err is None:
+            with contextlib.suppress(BaseException):
+                self.flush()
+        with self._cv:
+            self._closed = True
+            if not drain:
+                self._q.clear()
+            self._cv.notify_all()
+        self._thread.join(timeout=30.0)
+
+    def __enter__(self) -> "SpillWriter":
+        return self
+
+    def __exit__(self, exc_type, *exc) -> None:
+        self.close(drain=exc_type is None)

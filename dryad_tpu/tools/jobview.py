@@ -185,6 +185,46 @@ def _fold_job(events: List[Dict[str, Any]]) -> JobInfo:
                 stream_stats["combines"] = (
                     stream_stats.get("combines", 0) + 1
                 )
+                if ev.get("device"):
+                    stream_stats["device_combines"] = (
+                        stream_stats.get("device_combines", 0) + 1
+                    )
+            elif kind == "stream_prefetch":
+                # per-chunk pipeline occupancy sample (in-flight count)
+                stream_stats["prefetched"] = (
+                    stream_stats.get("prefetched", 0) + 1
+                )
+                stream_stats["_occ_sum"] = (
+                    stream_stats.get("_occ_sum", 0)
+                    + ev.get("in_flight", 0)
+                )
+            elif kind == "stream_pipeline":
+                # per-pipeline summary: fold the stall breakdown
+                stream_stats["pipelines"] = (
+                    stream_stats.get("pipelines", 0) + 1
+                )
+                stream_stats["pipeline_depth"] = max(
+                    stream_stats.get("pipeline_depth", 0),
+                    ev.get("depth", 0),
+                )
+                stream_stats["peak_in_flight"] = max(
+                    stream_stats.get("peak_in_flight", 0),
+                    ev.get("peak_in_flight", 0),
+                )
+                stream_stats["ingest_stall_s"] = round(
+                    stream_stats.get("ingest_stall_s", 0.0)
+                    + ev.get("consumer_wait_s", 0.0), 4,
+                )
+                stream_stats["compute_stall_s"] = round(
+                    stream_stats.get("compute_stall_s", 0.0)
+                    + ev.get("producer_wait_s", 0.0), 4,
+                )
+            elif kind == "stream_pipeline_error":
+                stream_stats["pipeline_errors"] = (
+                    stream_stats.get("pipeline_errors", 0) + 1
+                )
+            elif kind == "stream_combine_policy":
+                stream_stats["combine_policy"] = ev.get("mode", "?")
     wall = (t1 - t0) if (t0 is not None and t1 is not None) else 0.0
     return JobInfo(
         stages, declared, started, completed, failed, iters, state_boost,
@@ -311,7 +351,29 @@ def render(job: JobInfo) -> str:
             f"buckets={st.get('buckets', 0)}  "
             f"splits={st.get('splits', 0)}  "
             f"combines={st.get('combines', 0)}"
+            + (f" ({st['device_combines']} on-device)"
+               if st.get("device_combines") else "")
         )
+        if st.get("pipelines"):
+            # occupancy = mean chunks in flight over the prefetch
+            # samples; the stall breakdown names the slow side
+            # (ingest_stall = consumer waited on the prefetch thread;
+            # compute_stall = prefetch waited on the driver)
+            occ = (
+                st.get("_occ_sum", 0) / st["prefetched"]
+                if st.get("prefetched") else 0.0
+            )
+            lines.append(
+                "pipeline: "
+                f"depth={st.get('pipeline_depth', 0)}  "
+                f"occupancy={occ:.1f} "
+                f"(peak {st.get('peak_in_flight', 0)})  "
+                f"stalls: ingest={st.get('ingest_stall_s', 0.0):.3f}s "
+                f"compute={st.get('compute_stall_s', 0.0):.3f}s  "
+                f"errors={st.get('pipeline_errors', 0)}"
+                + (f"  combine_policy={st['combine_policy']}"
+                   if st.get("combine_policy") else "")
+            )
     if any(s.attempt_log for s in job.stages.values()):
         lines.append("-- attempt history --")
         for s in sorted(job.stages.values(), key=lambda s: s.id):

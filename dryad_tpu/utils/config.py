@@ -167,6 +167,17 @@ class DryadConfig:
     stream_spill_dir: Optional[str] = os.environ.get(
         "DRYAD_TPU_STREAM_SPILL_DIR"
     ) or None
+    # Chunk pipeline depth (exec.pipeline): how many chunks may be in
+    # flight at once across ingest / device compute / readback — the
+    # RChannelReader read-ahead budget (channelinterface.h:212).
+    # 1 = the serial legacy driver (no prefetch thread, no background
+    # spill writer, per-chunk host readback of partials).
+    stream_pipeline_depth: int = _env_int(
+        "DRYAD_TPU_STREAM_PIPELINE_DEPTH", 4
+    )
+    # Bounded buffer of the background spill writer, in queued pieces
+    # (exec.spill.SpillWriter): backpressure for the scatter phase.
+    stream_writer_queue: int = _env_int("DRYAD_TPU_STREAM_WRITER_QUEUE", 8)
 
     def __post_init__(self) -> None:
         self.validate()
@@ -217,3 +228,7 @@ class DryadConfig:
             raise ValueError("stream_combine_rows must be >= 1")
         if self.stream_buckets < 2:
             raise ValueError("stream_buckets must be >= 2")
+        if self.stream_pipeline_depth < 1:
+            raise ValueError("stream_pipeline_depth must be >= 1")
+        if self.stream_writer_queue < 1:
+            raise ValueError("stream_writer_queue must be >= 1")
