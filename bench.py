@@ -427,10 +427,20 @@ def terasort_device_metric(n: int):
     )
 
 
-def _ooc_sort_once(n: int, chunk_rows: int, depth=None) -> float:
-    """One timed out-of-core sort run; returns seconds.  ``depth``
-    overrides ``stream_pipeline_depth`` (1 = the serial legacy
-    driver, the pre-pipeline baseline)."""
+def _job_phases(ctx) -> dict:
+    """Per-phase metric summary folded from the context's event stream
+    (obs.metrics.JobMetrics): compile_s, stall seconds, spill bytes,
+    padding-waste ratio — so BENCH records say where time went, not
+    just rows/s."""
+    from dryad_tpu.obs.metrics import JobMetrics
+
+    return JobMetrics.from_events(ctx.events.events()).attribution()
+
+
+def _ooc_sort_once(n: int, chunk_rows: int, depth=None):
+    """One timed out-of-core sort run; returns (seconds, phases).
+    ``depth`` overrides ``stream_pipeline_depth`` (1 = the serial
+    legacy driver, the pre-pipeline baseline)."""
     from dryad_tpu import DryadConfig, DryadContext
 
     rng = np.random.default_rng(3)
@@ -457,7 +467,7 @@ def _ooc_sort_once(n: int, chunk_rows: int, depth=None) -> float:
     t = time.perf_counter() - t0
     assert len(out["key"]) == total
     assert (np.diff(out["key"]) >= 0).all()
-    return t
+    return t, _job_phases(ctx)
 
 
 def ooc_sort_metric(n: int, chunk_rows: int = 1 << 21):
@@ -474,13 +484,14 @@ def ooc_sort_metric(n: int, chunk_rows: int = 1 << 21):
     nchunks = max(1, n // chunk_rows)
     total = nchunks * chunk_rows
     bucket_rows = max(chunk_rows, 1 << 20)
-    t = _ooc_sort_once(n, chunk_rows)
+    t, phases = _ooc_sort_once(n, chunk_rows)
     return rep_record(
         "oocsort_rows_per_sec", total, [t],
         {"chunks": nchunks, "chunk_rows": chunk_rows,
          "bounded_hbm_rows": max(chunk_rows, 2 * bucket_rows),
          "capacity_multiple": nchunks,
-         "pipeline_depth": DryadConfig().stream_pipeline_depth},
+         "pipeline_depth": DryadConfig().stream_pipeline_depth,
+         "phases": phases},
     )
 
 
@@ -497,8 +508,8 @@ def ooc_pipeline_speedup_metric(n: int, chunk_rows: int = 1 << 20):
     from dryad_tpu import DryadConfig
 
     depth = DryadConfig().stream_pipeline_depth
-    t_piped = _ooc_sort_once(n, chunk_rows)
-    t_serial = _ooc_sort_once(n, chunk_rows, depth=1)
+    t_piped, phases_piped = _ooc_sort_once(n, chunk_rows)
+    t_serial, phases_serial = _ooc_sort_once(n, chunk_rows, depth=1)
     ratio = t_serial / max(t_piped, 1e-9)
     return {
         "metric": "ooc_pipeline_speedup",
@@ -508,6 +519,8 @@ def ooc_pipeline_speedup_metric(n: int, chunk_rows: int = 1 << 20):
         "baseline": "serial legacy driver (stream_pipeline_depth=1)",
         "pipelined_s": round(t_piped, 3),
         "serial_s": round(t_serial, 3),
+        "phases": phases_piped,
+        "phases_serial": phases_serial,
         "rows": n,
         "chunk_rows": chunk_rows,
         "cores": os.cpu_count(),
@@ -566,7 +579,8 @@ def ooc_wordcount_metric(
         "oocwordcount_rows_per_sec", n_words, [t],
         {"corpus_bytes": nbytes, "vocab": vocab,
          "chunk_bytes": chunk_bytes,
-         "pipeline_depth": cfg.stream_pipeline_depth},
+         "pipeline_depth": cfg.stream_pipeline_depth,
+         "phases": _job_phases(ctx)},
     )
 
 

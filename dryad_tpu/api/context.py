@@ -156,7 +156,9 @@ class DryadContext:
                 path = os.path.join(
                     self.config.event_log_dir, f"job-{int(time.time()*1000)}.jsonl"
                 )
-            self.events = EventLog(path)
+            self.events = EventLog(
+                path, mem_cap=self.config.obs_events_mem_cap
+            )
             self.executor = GraphExecutor(
                 self.mesh, self.config, self.events,
                 subquery_runner=self._run_subquery,
@@ -651,6 +653,7 @@ class DryadContext:
         # reaches the caller.
         batch, deferred = self._execute_device(query, defer_miss=True)
         valid, host_cols = _fetch_with_miss(batch, deferred)
+        self._account_d2h(valid, host_cols)
         table = batch.to_numpy(
             query.schema, self.dictionary, _host=(valid, host_cols)
         )
@@ -659,6 +662,16 @@ class DryadContext:
 
             table = collapse_table(table, self._codecs)
         return table
+
+    def _account_d2h(self, valid, host_cols) -> None:
+        """Device->host transfer byte accounting (obs.metrics): every
+        result fetch funnels through here or the streaming executor."""
+        if self.executor is not None:
+            self.executor.metrics.add(
+                "d2h_bytes",
+                sum(np.asarray(v).nbytes for v in host_cols.values())
+                + np.asarray(valid).nbytes,
+            )
 
     def run_to_host_async(self, query: Query):
         """Dispatch the device job NOW; return a zero-arg ``fetch``
@@ -671,6 +684,7 @@ class DryadContext:
 
         def fetch() -> Dict[str, np.ndarray]:
             valid, host_cols = _fetch_with_miss(batch, deferred)
+            self._account_d2h(valid, host_cols)
             table = batch.to_numpy(
                 query.schema, self.dictionary, _host=(valid, host_cols)
             )

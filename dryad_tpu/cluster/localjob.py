@@ -61,6 +61,7 @@ from dryad_tpu.exec.failure import (
 )
 from dryad_tpu.exec.jobpackage import pack_query
 from dryad_tpu.exec.stats import StageStatistics
+from dryad_tpu.obs.span import Tracer
 from dryad_tpu.utils.logging import get_logger
 
 log = get_logger("dryad_tpu.cluster.localjob")
@@ -244,9 +245,12 @@ class LocalJobSubmission:
         # submission's event log so quarantine transitions land in the
         # same stream jobview folds.
         self.scheduler = LocalScheduler([], events=self.events)
+        self.tracer = Tracer(self.events)
         self._client = ServiceClient("127.0.0.1", self.service.port)
         self._cp = ControlPlane(self.job_id, -1, mailbox=self.service.mailbox)
         self._status_ver: Dict[int, int] = {}
+        # per-worker telemetry read cursors + clock offsets (obs.gang)
+        self._telemetry_state: Dict[int, Dict] = {}
         # per-plan-signature duration models: the outlier fit assumes
         # repeated attempts of the SAME work (DrStageStatistics), so
         # heterogeneous queries must not share one model
@@ -485,6 +489,7 @@ class LocalJobSubmission:
 
         self.job_id = f"{self._base_job_id}-g{self._gen}"
         self._cp = ControlPlane(self.job_id, -1, mailbox=self.service.mailbox)
+        self._telemetry_state = {}  # fresh namespace, fresh cursors
         self._coord = f"{self.advertise}:{_free_port()}"
         for i in range(self.n):
             self.start_worker(i)
@@ -537,7 +542,8 @@ class LocalJobSubmission:
         job_dir = os.path.join(self.root, self.job_id, f"r{seq}")
         os.makedirs(job_dir, exist_ok=True)
         pkg_rel = f"{self.job_id}/r{seq}/job.pkg"
-        pack_query(query, os.path.join(self.root, pkg_rel))
+        with self.tracer.span("pack", cat="driver", seq=seq):
+            pack_query(query, os.path.join(self.root, pkg_rel))
         result_rel = f"{self.job_id}/r{seq}/result"
 
         cmd = {
@@ -598,11 +604,25 @@ class LocalJobSubmission:
         self.events.emit(
             "gang_run_complete", seq=seq, seconds=round(dt, 3)
         )
+        self._collect_telemetry()
 
         part_ids = sorted(
             {g for p in procs for g in p.result.get("parts", [])}
         )
         return self._assemble(query, result_rel, part_ids)
+
+    def _collect_telemetry(self) -> int:
+        """Absorb worker span/counter batches into the driver's event
+        log (clock-offset corrected) — the cluster-wide trace merge.
+        Best-effort: a telemetry hiccup must never fail a job that
+        already completed."""
+        try:
+            return self._cp.drain_telemetry(
+                self.n, self._telemetry_state, self.events
+            )
+        except Exception as e:  # noqa: BLE001 — observability only
+            log.warning("worker telemetry drain failed: %s", e)
+            return 0
 
     # -- independent vertex tasks with speculative duplication ---------------
     _PARTITIONED_OPS = frozenset(
@@ -918,6 +938,7 @@ class LocalJobSubmission:
                     if p.state not in terminal:
                         self.scheduler.cancel(p)
         self.events.emit("vertex_job_complete", seq=seq)
+        self._collect_telemetry()
         table = self._assemble(
             query, result_rel, list(range(nparts)),
             dictionary=query.ctx.dictionary,
@@ -1325,7 +1346,9 @@ class LocalJobSubmission:
         # (assemble time ~ max partition, not the sum; the async
         # channel-reader role, HttpReader.cs:78 + dryadvertex.h:33-48).
         w0, r0 = self._client.wire_bytes, self._client.raw_bytes
-        with ThreadPoolExecutor(
+        with self.tracer.span(
+            "assemble", cat="driver", parts=len(part_ids)
+        ), ThreadPoolExecutor(
             max_workers=min(8, max(len(part_ids), 1))
         ) as ex:
             cols_parts = list(

@@ -333,6 +333,14 @@ class LocalScheduler(Scheduler):
                 entry, comp = pick
                 self._queue.remove(entry)
                 self._busy[comp.name] += 1
+                wait = self._clock() - entry.enqueued
+            # queue-wait accounting (obs): how long placement took,
+            # including locality-relaxation delays — the scheduling-
+            # latency half of the gang telemetry story
+            self._emit(
+                "process_dispatch", process=entry.process.name,
+                computer=comp.name, wait_s=round(wait, 4),
+            )
             threading.Thread(
                 target=self._run, args=(entry.process, comp), daemon=True
             ).start()

@@ -107,7 +107,17 @@ def _run_part(cmd: Dict, args, client, pkgs: _PackageCache) -> Dict:
     return {"state": "completed", "parts": [part]}
 
 
-def _run_command(cmd: Dict, args, client, cp) -> Dict:
+def _absorb_ctx_events(wlog, ctx) -> None:
+    """Move the job context's engine events (stage spans, xla_compile,
+    stream events) into the worker's telemetry log so they ship to the
+    driver with the next batch."""
+    if wlog is None or ctx is None:
+        return
+    for ev in ctx.events.drain():
+        wlog.absorb(ev)
+
+
+def _run_command(cmd: Dict, args, client, cp, wlog=None) -> Dict:
     """Execute one ``run`` command: fetch the package, run the plan SPMD
     over the global mesh, write owned result partitions."""
     import numpy as np
@@ -166,6 +176,7 @@ def _run_command(cmd: Dict, args, client, cp) -> Dict:
         # All partitions durable before anyone reports success — the
         # driver may start reading as soon as one status arrives.
         cp.barrier(f"done/{cmd['seq']}", args.nproc)
+        _absorb_ctx_events(wlog, ctx)
         return {"state": "completed", "parts": parts}
     finally:
         os.unlink(pkg_path)
@@ -212,6 +223,16 @@ def main(argv=None) -> int:
     cp = ControlPlane(args.job, args.pid, client=client)
     cp.announce({"devices": args.devices_per_proc, "ospid": os.getpid()})
     cp.start_heartbeat()
+
+    # Worker-local telemetry (obs): spans around command execution plus
+    # the job context's engine events, shipped back to the driver
+    # through the ControlPlane mailbox after every command — the
+    # reporter-inside-the-GM analog, aggregated in cluster.localjob.
+    from dryad_tpu.exec.events import EventLog
+    from dryad_tpu.obs.span import Tracer
+
+    wlog = EventLog(None, mem_cap=8192)
+    wtracer = Tracer(wlog)
 
     after = 0
     pkgs = _PackageCache()
@@ -266,18 +287,36 @@ def main(argv=None) -> int:
             continue
         if cmd["kind"] in ("run", "runpart"):
             try:
-                if cmd["kind"] == "runpart":
-                    if delay["count"] > 0:
-                        delay["count"] -= 1
-                        time.sleep(delay["seconds"])
-                    status = _run_part(cmd, args, client, pkgs)
-                else:
-                    status = _run_command(cmd, args, client, cp)
+                with wtracer.span(
+                    cmd["kind"], cat="worker", seq=cmd.get("seq"),
+                    part=cmd.get("part"),
+                ):
+                    if cmd["kind"] == "runpart":
+                        if delay["count"] > 0:
+                            delay["count"] -= 1
+                            time.sleep(delay["seconds"])
+                        status = _run_part(cmd, args, client, pkgs)
+                        _absorb_ctx_events(
+                            wlog,
+                            pkgs.query.ctx if pkgs.query is not None
+                            else None,
+                        )
+                    else:
+                        status = _run_command(
+                            cmd, args, client, cp, wlog=wlog
+                        )
             except Exception as e:  # noqa: BLE001 — report, keep serving
                 traceback.print_exc()
                 info = {"error": f"{type(e).__name__}: {e}", "cmd": cmd}
                 cp.report_failure(info)
                 status = {"state": "failed", "error": info["error"]}
+            # telemetry ships BEFORE the status post: the driver drains
+            # right after it sees the status, so shipping after would
+            # race the batch against the drain
+            try:
+                cp.ship_telemetry(wlog.drain())
+            except Exception:  # noqa: BLE001 — telemetry is best-effort
+                pass
             status["cseq"] = cseq
             client.set_prop(
                 args.job, f"status/{args.pid}", json.dumps(status).encode()

@@ -36,6 +36,7 @@ from dryad_tpu.columnar.batch import ColumnBatch
 from dryad_tpu.columnar.io import read_partition_file, write_partition_file
 from dryad_tpu.exec import faults
 from dryad_tpu.exec.failure import CheckpointCorruptionError
+from dryad_tpu.obs.span import Tracer
 from dryad_tpu.plan.lower import Stage
 from dryad_tpu.utils.logging import get_logger
 
@@ -97,6 +98,7 @@ class CheckpointStore:
     def __init__(self, root: str, events=None):
         self.root = root
         self.events = events  # optional EventLog for integrity reports
+        self._tracer = Tracer(events)  # save/load IO spans (cat=checkpoint)
         # Checkpoints touched (saved or loaded) by THIS run: exempt from
         # gc, so a retention lease shorter than the job's wall time can't
         # delete earlier stages of the running job out from under a
@@ -109,6 +111,14 @@ class CheckpointStore:
         return os.path.join(self.root, f"{name}-{fp}")
 
     def save(
+        self, stage: Stage, fp: str, outputs: Tuple[ColumnBatch, ...]
+    ) -> str:
+        with self._tracer.span(
+            f"ckpt_save:{stage.name}", cat="checkpoint"
+        ):
+            return self._save(stage, fp, outputs)
+
+    def _save(
         self, stage: Stage, fp: str, outputs: Tuple[ColumnBatch, ...]
     ) -> str:
         d = self._dir(stage, fp)
@@ -171,6 +181,12 @@ class CheckpointStore:
         meta_path = os.path.join(d, "meta.json")
         if not os.path.exists(meta_path):
             return None
+        with self._tracer.span(f"ckpt_load:{stage.name}", cat="checkpoint"):
+            return self._load(stage, fp, d, meta_path, mesh)
+
+    def _load(
+        self, stage: Stage, fp: str, d: str, meta_path: str, mesh
+    ) -> Optional[Tuple[ColumnBatch, ...]]:
         try:
             with open(meta_path) as fh:
                 meta = json.load(fh)

@@ -4,26 +4,21 @@ The reference GM appends timestamped job events (process/vertex state
 transitions, final topology) to ``calypso.log`` in the job's DFS
 directory (``GraphManager/reporting/DrCalypsoReporting.cpp``), consumed
 post-hoc by the JobBrowser.  Here: JSONL events per job, consumed by
-``dryad_tpu.tools.jobview``.
+``dryad_tpu.tools.jobview`` and exported to Perfetto by
+``dryad_tpu.obs.trace``.
 
-Streaming (out-of-core) event kinds, emitted by ``exec.outofcore`` /
-``exec.pipeline`` / ``exec.spill`` and folded by jobview's streaming +
-pipeline lines:
+Every event carries two clocks: ``ts`` (wall, ``time.time()`` — for
+human-readable placement and cross-process merging) and ``mono``
+(``time.monotonic()`` — for derived durations, immune to wall-clock
+steps).  Field values are normalized to native Python types before
+serialization so numeric folds (jobview, ``obs.metrics``) never see
+stringified numpy scalars.
 
-- ``stream_start`` / ``stream_chunk`` / ``stream_spill`` /
-  ``stream_bucket`` / ``stream_bucket_split`` / ``stream_store`` — the
-  chunk/spill/bucket lifecycle;
-- ``stream_prefetch`` — one per prefetched chunk: ``queued`` (queue
-  depth) and ``in_flight`` (pipeline occupancy sample);
-- ``stream_pipeline`` — per-pipeline close summary: ``produced``,
-  ``peak_in_flight``, ``producer_wait_s`` (prefetch stalled on the
-  driver), ``consumer_wait_s`` (driver stalled on ingest);
-- ``stream_pipeline_error`` — a prefetch/spill-thread fault, with its
-  ``exec.failure`` classification, before it re-raises downstream;
-- ``stream_combine`` — partial compaction; ``device=True`` + ``fan_in``
-  for HBM-resident N-ary merges, ``rows_out`` for host merges;
-- ``stream_combine_policy`` — the device→host degrade decision for
-  non-reducing (high-cardinality) merge streams.
+The full event schema lives in :data:`EVENT_KINDS` below — one entry
+per ``kind`` emitted anywhere in the package.  A static lint test
+(``tests/test_event_schema.py``) cross-references this registry against
+every ``emit(...)`` call site, so the schema cannot rot as kinds are
+added.
 
 Events may be emitted from pipeline threads; ``EventLog`` is
 thread-safe.
@@ -35,16 +30,131 @@ import json
 import os
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional
+
+# ``kind`` -> one-line schema doc.  Kept in sync with emit() call sites
+# by tests/test_event_schema.py (both directions: every emitted kind is
+# documented; every documented kind is emitted somewhere).
+EVENT_KINDS: Dict[str, str] = {
+    # -- job / stage lifecycle (exec.executor) ----------------------------
+    "job_start": "job begins; stages=count, topology=[{id,name,deps}]",
+    "job_complete": "job drained cleanly (after deferred miss checks)",
+    "job_failed": "terminal job failure; stage/name/failure_kind/reason",
+    "stage_start": "one stage attempt begins; stage/name/version/boost",
+    "stage_complete": "attempt succeeded; seconds, async/deferred flags",
+    "stage_failed": "attempt failed; error, failure_kind, backoff",
+    "stage_overflow": "shuffle capacity overflow; retried at boost*2",
+    "stage_straggler": "attempt duration beyond the outlier threshold",
+    "stage_dispatched": "speculative dispatch joined the overflow window",
+    "overflow_drain": "batched readback of the speculative window's flags",
+    "stage_fanout": "stage lowered at reduced width; nparts/of",
+    "stage_width_adapt": "observed-volume width adaptation; nparts/of",
+    "stage_delay_injected": "fault-injection delay before the attempt",
+    "dict_miss": "rows outside the dense key domain; stage_name/rows",
+    # -- checkpointing (exec.checkpoint / executor) -----------------------
+    "stage_checkpoint_hit": "stage served from the checkpoint store",
+    "stage_checkpoint_saved": "stage outputs persisted; path",
+    "checkpoint_corrupt": "CRC mismatch at load; recomputed instead",
+    "checkpoint_gc": "retention lease removed old checkpoints; removed",
+    # -- do_while (exec.executor) -----------------------------------------
+    "do_while_iter": "driver-loop iteration began; iter",
+    "do_while_max_iter": "loop stopped at the iteration budget",
+    "do_while_state_boost": "loop state outgrew capacity; boost",
+    "do_while_device_start": "whole loop compiled on device; boost",
+    "do_while_device_done": "device loop finished; iters",
+    "do_while_device_fallback": "device lowering rejected; driver loop",
+    # -- apply_host (exec.executor) ---------------------------------------
+    "apply_host_start": "host-callback stage began; stage",
+    "apply_host_done": "host-callback stage finished; stage",
+    # -- out-of-core streaming (exec.outofcore / pipeline / spill) --------
+    "stream_start": "a stream binding began evaluation; node",
+    "stream_chunk": "one ingest chunk processed; rows, partial_rows/cap",
+    "stream_spill": "one bucket piece spilled; bucket/rows/depth",
+    "stream_bucket": "one bucket's device job finished; bucket/rows",
+    "stream_bucket_split": "oversized bucket re-split; mode/fanout",
+    "stream_store": "streamed results persisted; path/rows/partitions",
+    "stream_prefetch": "one chunk prefetched; queued, in_flight sample",
+    "stream_pipeline": "pipeline close summary; produced, stall seconds",
+    "stream_pipeline_error": "prefetch/spill-thread fault; failure_kind",
+    "stream_combine": "partial compaction; device/fan_in or rows_out",
+    "stream_combine_policy": "device->host combine degrade decision",
+    "stream_group_done": "streaming group_by finished; chunks/groups",
+    "stream_distinct_spill": "distinct switched to Grace spilling; rows",
+    # -- observability (obs.span / obs.metrics / executor) ----------------
+    "span": "closed hierarchical span; name/cat/span_id/parent_id/dur",
+    "metrics": "counter/histogram registry snapshot; counters/hists",
+    "xla_compile": "stage (re)compiled; stage/key/trace_s/compile_s",
+    "telemetry_merged": "driver absorbed worker span/counter batches",
+    # -- cluster: scheduler / quarantine (cluster.scheduler) --------------
+    "process_failed": "a scheduled process failed; computer/error",
+    "process_stranded": "hard affinity unsatisfiable after removal",
+    "process_dispatch": "queued process placed on a computer; wait_s",
+    "computer_quarantined": "failure threshold crossed; cooldown",
+    "computer_probation": "cooldown expired; probation re-admission",
+    "computer_readmitted": "probation success; computer healthy again",
+    # -- cluster: gang / vertex jobs (cluster.localjob) -------------------
+    "worker_started": "worker process launched; worker",
+    "worker_joined": "worker announced on the control plane; worker",
+    "worker_dead": "worker process died; worker",
+    "gang_run_start": "gang SPMD submission began; seq/workers",
+    "gang_run_complete": "gang SPMD submission finished; seconds",
+    "gang_straggler": "gang run duration beyond the outlier threshold",
+    "gang_rebuild": "gang reshaped/restarted; dead/workers/generation",
+    "gang_member_lost_mid_job": "mid-job death; auto-shrink attempt",
+    "vertex_job_start": "independent vertex-task job began; nparts",
+    "vertex_job_complete": "vertex-task job finished; seq",
+    "vertex_job_failed": "a vertex task exhausted retries; part",
+    "vertex_complete": "one vertex task finished; part/seconds/computer",
+    "vertex_retry": "vertex task re-executed; attempt/backoff/error",
+    "vertex_duplicate": "straggling task speculatively duplicated",
+    "vertex_duplicate_win": "the duplicate finished first; winner",
+    "vertex_duplicate_cancel": "the losing attempt was canceled; loser",
+    "vertex_routed": "driver routed inputs for a shuffle-bearing plan",
+    "vertex_partials_merged": "driver merged per-vertex partials; rows",
+    "assemble_fetch": "result partitions fetched; wire/raw bytes",
+}
+
+
+def _to_native(v: Any) -> Any:
+    """Normalize numpy scalars/arrays (and containers of them) to
+    native Python types so JSON round-trips preserve numbers — the
+    old ``default=str`` fallback silently stringified them, corrupting
+    jobview's numeric folds."""
+    # numpy scalars expose .item(); arrays expose .tolist(); test by
+    # attribute to avoid importing numpy on the hot path
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    if isinstance(v, dict):
+        return {str(k): _to_native(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_to_native(x) for x in v]
+    item = getattr(v, "item", None)
+    if item is not None and getattr(v, "shape", None) == ():
+        return v.item()  # numpy scalar (0-d)
+    tolist = getattr(v, "tolist", None)
+    if tolist is not None:
+        return v.tolist()  # numpy array
+    return v
 
 
 class EventLog:
-    """Thread-safe append-only JSONL event sink."""
+    """Thread-safe append-only JSONL event sink.
 
-    def __init__(self, path: Optional[str] = None):
+    ``mem_cap`` bounds the in-memory mirror with a ring buffer (long
+    out-of-core jobs emit per-chunk events without bound); the file
+    sink, when configured, always keeps the full stream.  ``None``
+    keeps the unbounded list (test-friendly default).
+    """
+
+    def __init__(self, path: Optional[str] = None,
+                 mem_cap: Optional[int] = None):
         self.path = path
+        self.mem_cap = mem_cap
         self._lock = threading.Lock()
-        self._mem: List[Dict[str, Any]] = []
+        self._mem = (
+            deque(maxlen=mem_cap) if mem_cap else []
+        )  # type: ignore[var-annotated]
         if path:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
             self._fh = open(path, "a", buffering=1)
@@ -52,7 +162,19 @@ class EventLog:
             self._fh = None
 
     def emit(self, kind: str, **fields: Any) -> None:
-        ev = {"ts": time.time(), "kind": kind, **fields}
+        ev = {
+            "ts": time.time(), "mono": time.monotonic(), "kind": kind,
+            **{k: _to_native(v) for k, v in fields.items()},
+        }
+        self._append(ev)
+
+    def absorb(self, ev: Dict[str, Any]) -> None:
+        """Append a pre-stamped event AS-IS (no re-stamping) — the
+        driver-side merge path for worker telemetry batches whose
+        clocks were already offset-corrected (``obs.gang``)."""
+        self._append({k: _to_native(v) for k, v in ev.items()})
+
+    def _append(self, ev: Dict[str, Any]) -> None:
         with self._lock:
             self._mem.append(ev)
             if self._fh:
@@ -68,6 +190,15 @@ class EventLog:
         (quarantine, retry, corruption) without refolding the stream."""
         with self._lock:
             return [e for e in self._mem if e["kind"] in kinds]
+
+    def drain(self) -> List[Dict[str, Any]]:
+        """Atomically snapshot AND clear the in-memory mirror — the
+        worker-side telemetry shipping primitive (the file sink, if
+        any, is unaffected)."""
+        with self._lock:
+            out = list(self._mem)
+            self._mem.clear()
+            return out
 
     def close(self) -> None:
         with self._lock:

@@ -41,6 +41,8 @@ from dryad_tpu.exec.partial import (
 )
 from dryad_tpu.exec.pipeline import prefetched
 from dryad_tpu.exec.spill import SpillDir, SpillWriter
+from dryad_tpu.obs.metrics import MetricsRegistry
+from dryad_tpu.obs.span import Tracer
 from dryad_tpu.plan.nodes import Node, walk
 from dryad_tpu.utils.logging import get_logger
 
@@ -111,6 +113,23 @@ class _IngestScope:
         self.vocab[col] = new
         return new
 
+    def _account(self, table: Dict[str, np.ndarray], n: int, P: int) -> None:
+        """Ingest-side byte/row accounting: H2D-bound bytes and the
+        layout-vs-valid rows behind the padding-waste ratio."""
+        ex = getattr(self.ctx, "executor", None)
+        if ex is None or self.cap is None:
+            return
+        ex.metrics.add(
+            "h2d_bytes",
+            sum(
+                np.asarray(v).nbytes for c, v in table.items()
+                if c != "#vocab"
+            ),
+        )
+        ex.metrics.add("rows_in", n)
+        ex.metrics.add("valid_rows", n)
+        ex.metrics.add("layout_rows", self.cap * P)
+
     def ingest(self, table: Dict[str, np.ndarray], schema: Schema):
         ctx = self.ctx
         from dryad_tpu.parallel.mesh import num_partitions
@@ -120,6 +139,7 @@ class _IngestScope:
             return self._maybe_reuse(self._ingest_physical(table, schema, P))
         n = len(next(iter(table.values()))) if table else 0
         self._fit_cap(n, P)
+        self._account(table, n, P)
         q = ctx.from_arrays(table, schema=schema, partition_capacity=self.cap)
         node = q.node
         # Widen auto-dense metadata to the stream scope.  The widened
@@ -190,6 +210,7 @@ class _IngestScope:
             self._widen_vocab(col, v)
         n = len(next(iter(table.values()))) if table else 0
         self._fit_cap(n, P)
+        self._account(table, n, P)
         node = Node(
             "input", [], schema, PartitionInfo.roundrobin(),
             source="host_physical",
@@ -364,6 +385,12 @@ class StreamExecutor:
         self.writer_queue = int(getattr(cfg, "stream_writer_queue", 8))
         self.max_split_depth = 3
         self.events = ctx.executor.events if ctx.executor else None
+        # driver-loop spans (cat=chunk structural, engine jobs land on
+        # cat=execute inside) + the shared counter registry
+        self.tracer = Tracer(self.events)
+        self.metrics = (
+            ctx.executor.metrics if ctx.executor else MetricsRegistry()
+        )
         self._small_nodes: Dict[int, Node] = {}
         self._eval_cache: Dict[int, Tuple[str, Any]] = {}
         self._stream_ids: Optional[set] = None
@@ -385,8 +412,10 @@ class StreamExecutor:
     def run_to_host(self, root: Node) -> Dict[str, np.ndarray]:
         kind, val = self._eval(root)
         if kind == "small":
+            self.metrics.emit(self.events)
             return val
         tables = list(self._realized(val))
+        self.metrics.emit(self.events)
         return _concat_tables(tables, val.schema)
 
     def run_stream(self, root: Node):
@@ -422,6 +451,7 @@ class StreamExecutor:
             i += 1
         write_store_meta(path, i, schema, self.ctx.dictionary)
         self._emit("stream_store", path=path, rows=total, partitions=i)
+        self.metrics.emit(self.events)
         return total
 
     # ---- helpers -------------------------------------------------------
@@ -441,7 +471,8 @@ class StreamExecutor:
     def _run_engine(self, node: Node) -> Dict[str, np.ndarray]:
         from dryad_tpu.api.query import Query
 
-        return self.ctx.run_to_host(Query(self.ctx, node))
+        with self.tracer.span(f"engine:{node.kind}", cat="chunk"):
+            return self.ctx.run_to_host(Query(self.ctx, node))
 
     def _clone(self, n: Node, new_inputs: Sequence[Node]) -> Node:
         return Node(n.kind, list(new_inputs), n.schema, n.partition, **n.params)
@@ -670,6 +701,11 @@ class StreamExecutor:
     def _batch_to_host(self, batch, schema) -> Dict[str, np.ndarray]:
         """Materialize a device batch as a host logical table (the
         degrade path when device-side combining stops paying)."""
+        self.metrics.add(
+            "d2h_bytes",
+            sum(int(v.nbytes) for v in batch.data.values())
+            + int(batch.valid.nbytes),
+        )
         return batch.to_numpy(schema, self.ctx.dictionary)
 
     def _group_partial_device(self, node, stream, keys, agg_list):
@@ -1017,10 +1053,17 @@ class StreamExecutor:
                         mn, mx = min(mn, pmn), max(mx, pmx)
                     extent[int(b)] = (mn, mx)
                     piece = {c: v[sel] for c, v in t.items()}
+                    self.metrics.observe(
+                        "partition_rows", int(sel.sum()), depth=depth
+                    )
                     if writer is not None:
                         writer.submit(spill, int(b), piece, depth)
                     else:
+                        b0 = spill.bytes_written
                         n = spill.append(int(b), piece)
+                        self.metrics.add(
+                            "spill_bytes", spill.bytes_written - b0
+                        )
                         self._emit("stream_spill", bucket=int(b), rows=n,
                                    depth=depth)
             if writer is not None:
@@ -1292,10 +1335,18 @@ class StreamExecutor:
         for b in np.unique(bids):
             sel = bids == b
             piece = {c: v[sel] for c, v in table.items()}
+            # per-partition row histogram = the skew signal
+            # distribution-aware scheduling needs (PAPERS.md "Chasing
+            # Similarity"); one sample per (bucket, piece)
+            self.metrics.observe(
+                "partition_rows", int(sel.sum()), depth=depth
+            )
             if writer is not None:
                 writer.submit(spill, int(b), piece, depth)
                 continue
+            b0 = spill.bytes_written
             n = spill.append(int(b), piece)
+            self.metrics.add("spill_bytes", spill.bytes_written - b0)
             self._emit("stream_spill", bucket=int(b), rows=n, depth=depth)
 
     def _spill_root(self):

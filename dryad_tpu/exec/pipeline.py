@@ -32,6 +32,8 @@ import threading
 import time
 from typing import Any, Iterator, Optional
 
+from dryad_tpu.obs.span import Tracer
+
 __all__ = ["ChunkPrefetcher", "PipelineStats", "prefetched"]
 
 
@@ -90,6 +92,9 @@ class ChunkPrefetcher:
         self.depth = depth
         self.name = name
         self.events = events
+        # producer-thread spans (cat=prefetch): each source pull is one
+        # slice on the prefetch track of the Perfetto export
+        self._tracer = Tracer(events)
         self.stats = PipelineStats()
         self._source = source
         self._sem = threading.Semaphore(depth)  # in-flight budget
@@ -119,7 +124,11 @@ class ChunkPrefetcher:
                 if self._closed:
                     return
                 try:
-                    item = next(it)
+                    with self._tracer.span(
+                        self.name, cat="prefetch",
+                        chunk=self.stats.produced,
+                    ):
+                        item = next(it)
                 except StopIteration:
                     return
                 with self._cv:

@@ -23,6 +23,7 @@ from typing import Dict, Iterator, List, Optional, Tuple
 import numpy as np
 
 from dryad_tpu.columnar.io import read_partition_file, write_partition_file
+from dryad_tpu.obs.span import Tracer
 
 _STR_MARK = "#spillstr_"  # physical prefix for hash-encoded string cols
 
@@ -155,6 +156,9 @@ class SpillWriter:
 
     def __init__(self, events=None, queue_depth: int = 8):
         self.events = events
+        # writer-thread spans (cat=spill, with piece bytes): the spill
+        # track of the Perfetto export + the spill_bytes accounting
+        self._tracer = Tracer(events)
         self._max = max(1, queue_depth)
         self._q: List[Tuple] = []
         self._cv = threading.Condition()
@@ -182,7 +186,12 @@ class SpillWriter:
             spill, bucket, table, depth = job
             t0 = time.monotonic()
             try:
-                n = spill.append(bucket, table)
+                b0 = spill.bytes_written
+                with self._tracer.span(
+                    "spill_piece", cat="spill", bucket=bucket, depth=depth,
+                ) as sp:
+                    n = spill.append(bucket, table)
+                    sp.add(rows=n, bytes=spill.bytes_written - b0)
                 self.pieces += 1
                 if self.events is not None and n:
                     self.events.emit(

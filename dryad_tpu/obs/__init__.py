@@ -1,0 +1,34 @@
+"""Observability: structured tracing + cluster-wide metrics.
+
+The reference runs dedicated reporters (Calypso/Artemis) inside the
+GraphManager and a JobBrowser GUI over them (PAPER.md "Side column:
+Observability").  This package is that subsystem for the TPU-native
+framework, layered over the existing ``exec.events.EventLog`` stream:
+
+- :mod:`dryad_tpu.obs.span` — thread-safe hierarchical spans
+  (monotonic clocks, context manager + decorator, parent ids) that
+  serialize as ``span`` events;
+- :mod:`dryad_tpu.obs.metrics` — a counter/histogram registry (rows
+  and bytes per stage and partition, compile count/time, transfer
+  bytes, padding waste, spill bytes) plus the :class:`JobMetrics`
+  snapshot folding events into a compile/execute/stall/spill time
+  attribution;
+- :mod:`dryad_tpu.obs.trace` — a Chrome-trace (Perfetto) exporter
+  rendering prefetch / compute / spill threads as separate tracks;
+- :mod:`dryad_tpu.obs.gang` — worker->driver telemetry aggregation
+  through the ControlPlane mailbox with clock-offset correction (the
+  Calypso-reporter-in-GM analog).
+"""
+
+from dryad_tpu.obs.metrics import JobMetrics, MetricsRegistry
+from dryad_tpu.obs.span import Span, Tracer
+from dryad_tpu.obs.trace import chrome_trace, write_chrome_trace
+
+__all__ = [
+    "JobMetrics",
+    "MetricsRegistry",
+    "Span",
+    "Tracer",
+    "chrome_trace",
+    "write_chrome_trace",
+]

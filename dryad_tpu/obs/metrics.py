@@ -1,0 +1,293 @@
+"""Counter/histogram registry + the JobMetrics attribution snapshot.
+
+The reference GM aggregates per-vertex statistics (Artemis reporters)
+into job-level summaries the JobBrowser renders.  Here:
+
+- :class:`MetricsRegistry` — thread-safe labeled counters and
+  histograms the runtime layers feed (rows/bytes in and out per stage
+  and partition, XLA compile count + time per lowering key, D2H/H2D
+  transfer bytes, layout padding waste, spill bytes).  Histograms keep
+  count/sum/min/max plus power-of-two bucket counts, so per-partition
+  row distributions double as skew histograms (the per-partition
+  volume statistics distribution-aware scheduling needs, PAPERS.md
+  "Chasing Similarity").
+- :class:`JobMetrics` — the programmatic time-attribution snapshot
+  (compile vs execute vs ingest-stall vs spill), foldable from any
+  event stream (live ``EventLog`` or a loaded JSONL file), which is
+  also what ``tools.jobview`` renders and ``bench.py`` attaches to
+  BENCH records.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+__all__ = ["MetricsRegistry", "JobMetrics"]
+
+
+def _labels_key(labels: Dict[str, Any]) -> Tuple:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+class _Hist:
+    __slots__ = ("n", "sum", "min", "max", "buckets")
+
+    def __init__(self):
+        self.n = 0
+        self.sum = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+        self.buckets: Dict[int, int] = {}  # pow2 exponent -> count
+
+    def observe(self, v: float) -> None:
+        self.n += 1
+        self.sum += v
+        self.min = min(self.min, v)
+        self.max = max(self.max, v)
+        b = max(0, int(v).bit_length()) if v >= 1 else 0
+        self.buckets[b] = self.buckets.get(b, 0) + 1
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "n": self.n, "sum": round(self.sum, 6),
+            "min": self.min if self.n else 0,
+            "max": self.max if self.n else 0,
+            # skew signal without shipping raw samples: pow2 buckets
+            "buckets": {str(k): v for k, v in sorted(self.buckets.items())},
+        }
+
+
+class MetricsRegistry:
+    """Thread-safe labeled counters + histograms.
+
+    ``add`` accumulates a counter; ``observe`` feeds a histogram (one
+    sample per call — per-partition rows, per-piece bytes).  A
+    ``snapshot()`` is JSON-ready and ``emit(events)`` serializes it as
+    ONE ``metrics`` event so snapshots ride the same stream jobview
+    and the gang-telemetry path already carry.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[Tuple[str, Tuple], float] = {}
+        self._hists: Dict[Tuple[str, Tuple], _Hist] = {}
+
+    def add(self, name: str, value: float = 1.0, **labels: Any) -> None:
+        key = (name, _labels_key(labels))
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0.0) + value
+
+    def observe(self, name: str, value: float, **labels: Any) -> None:
+        key = (name, _labels_key(labels))
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None:
+                h = self._hists[key] = _Hist()
+            h.observe(value)
+
+    def counter(self, name: str, **labels: Any) -> float:
+        """Current value of one counter (0.0 when never touched)."""
+        with self._lock:
+            return self._counters.get((name, _labels_key(labels)), 0.0)
+
+    def total(self, name: str) -> float:
+        """Sum of one counter across ALL label sets."""
+        with self._lock:
+            return sum(
+                v for (n, _l), v in self._counters.items() if n == name
+            )
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            counters = [
+                {"name": n, "labels": dict(lk), "value": round(v, 6)}
+                for (n, lk), v in sorted(self._counters.items())
+            ]
+            hists = [
+                {"name": n, "labels": dict(lk), **h.as_dict()}
+                for (n, lk), h in sorted(self._hists.items())
+            ]
+        return {"counters": counters, "hists": hists}
+
+    def emit(self, events) -> None:
+        """Serialize the registry into the event stream (one
+        ``metrics`` event holding the whole snapshot)."""
+        if events is not None:
+            events.emit("metrics", **self.snapshot())
+
+
+# -- job-level attribution snapshot -----------------------------------------
+
+# span categories that count as LEAF time (mutually exclusive regions);
+# structural cats (chunk, bucket, driver, worker, gang) group the
+# Perfetto view but contain leaf spans and must not double-count
+_LEAF_CATS = {
+    "compile": "compile_s",
+    "execute": "execute_s",
+    "prefetch": "ingest_s",
+    "spill": "spill_write_s",
+    "checkpoint": "checkpoint_s",
+}
+
+
+@dataclasses.dataclass
+class JobMetrics:
+    """Where the time (and bytes) went — the programmatic snapshot the
+    acceptance criteria name, foldable from any event stream.
+
+    Time attribution (seconds):
+    - ``compile_s``/``compile_count``: XLA trace+compile per lowering
+      key (``xla_compile`` events) — the vocab-recompile signal;
+    - ``execute_s``: engine stage attempts (``span`` cat=execute);
+    - ``ingest_stall_s``: driver blocked waiting on the prefetch
+      thread (``stream_pipeline`` consumer_wait_s);
+    - ``compute_stall_s``: prefetch thread blocked waiting on the
+      driver (``stream_pipeline`` producer_wait_s);
+    - ``ingest_s``/``spill_write_s``/``checkpoint_s``: background
+      thread time (prefetch pulls, spill piece writes, checkpoint IO).
+
+    Byte/row accounting: spill bytes, D2H/H2D transfer bytes, layout
+    vs valid rows (``padding_waste`` = fraction of layout rows that
+    were padding), retry/quarantine counts.
+    """
+
+    compile_count: int = 0
+    compile_s: float = 0.0
+    execute_s: float = 0.0
+    ingest_s: float = 0.0
+    ingest_stall_s: float = 0.0
+    compute_stall_s: float = 0.0
+    spill_write_s: float = 0.0
+    checkpoint_s: float = 0.0
+    spill_bytes: int = 0
+    spill_rows: int = 0
+    d2h_bytes: int = 0
+    h2d_bytes: int = 0
+    layout_rows: int = 0
+    valid_rows: int = 0
+    rows_in: int = 0
+    rows_out: int = 0
+    retries: int = 0
+    quarantines: int = 0
+    workers: int = 0  # distinct workers whose telemetry was merged
+    spans: int = 0
+
+    @property
+    def padding_waste(self) -> float:
+        """Fraction of device layout rows that were padding (0 when no
+        layout accounting was recorded)."""
+        if self.layout_rows <= 0:
+            return 0.0
+        return max(0.0, 1.0 - self.valid_rows / self.layout_rows)
+
+    def attribution(self) -> Dict[str, float]:
+        """The compile/execute/stall/spill summary as a flat dict (the
+        BENCH-record / jobview rendering surface)."""
+        return {
+            "compile_s": round(self.compile_s, 4),
+            "compile_count": self.compile_count,
+            "execute_s": round(self.execute_s, 4),
+            "ingest_stall_s": round(self.ingest_stall_s, 4),
+            "compute_stall_s": round(self.compute_stall_s, 4),
+            "spill_write_s": round(self.spill_write_s, 4),
+            "checkpoint_s": round(self.checkpoint_s, 4),
+            "spill_bytes": self.spill_bytes,
+            "d2h_bytes": self.d2h_bytes,
+            "h2d_bytes": self.h2d_bytes,
+            "padding_waste": round(self.padding_waste, 4),
+            "retries": self.retries,
+            "quarantines": self.quarantines,
+        }
+
+    # counter names folded from ``metrics`` snapshot events into the
+    # scalar fields above
+    _COUNTER_FIELDS = {
+        "d2h_bytes": "d2h_bytes",
+        "h2d_bytes": "h2d_bytes",
+        "layout_rows": "layout_rows",
+        "valid_rows": "valid_rows",
+        "rows_in": "rows_in",
+        "rows_out": "rows_out",
+        "spill_bytes": "spill_bytes",
+    }
+
+    @classmethod
+    def from_events(cls, events: Iterable[Dict[str, Any]]) -> "JobMetrics":
+        """Fold an event stream (live or loaded) into one snapshot.
+
+        ``metrics`` snapshot events are CUMULATIVE per source registry,
+        so only the LAST snapshot per (worker, counter) contributes —
+        re-emitting a registry never double-counts.
+        """
+        m = cls()
+        # (worker, counter name) -> latest cumulative value
+        last_counter: Dict[Tuple[Any, str], float] = {}
+        workers = set()
+        for ev in events:
+            kind = ev.get("kind")
+            if "worker" in ev and kind == "span":
+                workers.add(ev["worker"])
+            if kind == "span":
+                m.spans += 1
+                field = _LEAF_CATS.get(ev.get("cat"))
+                if field is not None:
+                    setattr(m, field, getattr(m, field) + ev.get("dur", 0.0))
+                if ev.get("cat") == "spill":
+                    m.spill_bytes += int(ev.get("bytes", 0) or 0)
+            elif kind == "xla_compile":
+                m.compile_count += 1
+                m.compile_s += ev.get("compile_s", 0.0)
+            elif kind == "stream_pipeline":
+                m.ingest_stall_s += ev.get("consumer_wait_s", 0.0)
+                m.compute_stall_s += ev.get("producer_wait_s", 0.0)
+            elif kind == "stream_spill":
+                m.spill_rows += int(ev.get("rows", 0) or 0)
+            elif kind == "stream_chunk":
+                m.rows_in += int(ev.get("rows", 0) or 0)
+            elif kind in ("stage_failed", "vertex_retry"):
+                m.retries += 1
+            elif kind == "computer_quarantined":
+                m.quarantines += 1
+            elif kind == "metrics":
+                src = ev.get("worker", "driver")
+                for c in ev.get("counters", []):
+                    name = c.get("name")
+                    if name in cls._COUNTER_FIELDS:
+                        last_counter[(src, name)] = c.get("value", 0.0)
+        m.workers = len(workers)
+        for (_src, name), v in last_counter.items():
+            field = cls._COUNTER_FIELDS[name]
+            setattr(m, field, getattr(m, field) + int(v))
+        return m
+
+
+def format_attribution(m: JobMetrics) -> List[str]:
+    """Human-readable attribution lines (shared by jobview's text
+    report; empty when the stream carries no obs data)."""
+    if not (m.spans or m.compile_count or m.ingest_stall_s
+            or m.compute_stall_s):
+        return []
+    lines = [
+        "time attribution: "
+        f"compile={m.compile_s:.3f}s ({m.compile_count} compiles)  "
+        f"execute={m.execute_s:.3f}s  "
+        f"ingest_stall={m.ingest_stall_s:.3f}s  "
+        f"spill={m.spill_write_s:.3f}s"
+        + (f"  checkpoint={m.checkpoint_s:.3f}s" if m.checkpoint_s else "")
+    ]
+    parts = []
+    if m.spill_bytes:
+        parts.append(f"spill_bytes={m.spill_bytes}")
+    if m.d2h_bytes or m.h2d_bytes:
+        parts.append(f"d2h={m.d2h_bytes}B h2d={m.h2d_bytes}B")
+    if m.layout_rows:
+        parts.append(f"padding_waste={m.padding_waste:.1%}")
+    if m.retries or m.quarantines:
+        parts.append(f"retries={m.retries} quarantines={m.quarantines}")
+    if m.workers:
+        parts.append(f"worker_telemetry={m.workers} workers")
+    if parts:
+        lines.append("resources: " + "  ".join(parts))
+    return lines

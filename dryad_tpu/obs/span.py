@@ -1,0 +1,176 @@
+"""Hierarchical spans over the ``EventLog`` stream.
+
+A span measures one timed region (job, stage attempt, vertex attempt,
+chunk, pipeline phase) on monotonic clocks and serializes into the
+existing event stream as ONE ``span`` event at close:
+
+``{"kind": "span", "name", "cat", "span_id", "parent_id", "dur",
+"thread", ...fields}``
+
+plus the stamps ``EventLog.emit`` adds (``ts`` wall-clock at close,
+``mono``).  The span's start is recoverable as ``ts - dur`` /
+``mono - dur`` — no separate begin event, so a span costs one log
+record and the stream can never hold an unmatched begin.
+
+Parenting is implicit per thread (a thread-local stack), so nested
+``with`` blocks form the job -> stage -> chunk hierarchy without
+plumbing ids; a pipeline thread that logically works FOR a driver-side
+span passes ``parent=`` explicitly (capture it with
+:meth:`Tracer.current_id` before handing work to the thread).
+
+Span ids are unique process-wide (one shared counter), so any module
+may construct its own ``Tracer(events)`` over the same log and the
+hierarchy stays consistent.
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+import threading
+import time
+from typing import Any, Optional
+
+__all__ = ["Span", "Tracer"]
+
+# process-wide id source: tracers are cheap per-module conveniences,
+# so ids must not collide across instances
+_ids = itertools.count(1)
+_ids_lock = threading.Lock()
+
+
+def _next_id() -> int:
+    with _ids_lock:
+        return next(_ids)
+
+
+_UNSET = object()
+
+
+class Span:
+    """One open timed region; emits its ``span`` event at ``__exit__``.
+
+    ``add(**fields)`` attaches result facts discovered mid-region
+    (rows, bytes, bucket ids) to the closing event.
+    """
+
+    __slots__ = (
+        "_tracer", "name", "cat", "fields", "span_id", "parent_id", "_t0"
+    )
+
+    def __init__(self, tracer, name, cat, parent_id, fields):
+        self._tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.fields = fields
+        self.span_id = _next_id()
+        self.parent_id = parent_id
+        self._t0 = 0.0
+
+    def add(self, **fields: Any) -> "Span":
+        self.fields.update(fields)
+        return self
+
+    def __enter__(self) -> "Span":
+        self._tracer._push(self)
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        dur = time.monotonic() - self._t0
+        self._tracer._pop(self)
+        if exc_type is not None and exc_type is not StopIteration:
+            # StopIteration is iterator protocol, not a fault (the
+            # prefetch span around a source pull ends its stream with it)
+            self.fields.setdefault("error", f"{exc_type.__name__}: {exc}")
+        self._tracer._events.emit(
+            "span", name=self.name, cat=self.cat, span_id=self.span_id,
+            parent_id=self.parent_id, dur=round(dur, 6),
+            thread=threading.current_thread().name, **self.fields,
+        )
+
+
+class _NullSpan:
+    """Shared no-op span for disabled tracers."""
+
+    __slots__ = ()
+    span_id = None
+    parent_id = None
+
+    def add(self, **fields: Any) -> "_NullSpan":
+        return self
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+
+_NULL = _NullSpan()
+
+
+class Tracer:
+    """Span factory bound to one :class:`~dryad_tpu.exec.events.EventLog`.
+
+    Thread-safe: each thread keeps its own open-span stack, so spans
+    emitted concurrently from pipeline threads nest correctly within
+    their own thread and never corrupt another thread's hierarchy.
+    ``events=None`` (or ``enabled=False``) yields no-op spans with no
+    allocation, so instrumented code needs no guards.
+    """
+
+    def __init__(self, events=None, enabled: bool = True):
+        self._events = events
+        self.enabled = enabled and events is not None
+        self._local = threading.local()
+
+    # -- per-thread stack --------------------------------------------------
+    def _stack(self):
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def _push(self, span: Span) -> None:
+        self._stack().append(span)
+
+    def _pop(self, span: Span) -> None:
+        st = self._stack()
+        if st and st[-1] is span:
+            st.pop()
+        elif span in st:  # mis-nested exit: drop it and everything above
+            del st[st.index(span):]
+
+    def current_id(self) -> Optional[int]:
+        """Id of this thread's innermost open span (to pass as
+        ``parent=`` into work handed to another thread)."""
+        st = self._stack()
+        return st[-1].span_id if st else None
+
+    # -- public ------------------------------------------------------------
+    def span(self, name: str, cat: str = "driver", parent=_UNSET,
+             **fields: Any):
+        """Open a span as a context manager.  ``parent`` defaults to
+        this thread's innermost open span; pass an explicit id (or
+        None) when the logical parent lives on another thread."""
+        if not self.enabled:
+            return _NULL
+        pid = self.current_id() if parent is _UNSET else parent
+        return Span(self, name, cat, pid, dict(fields))
+
+    def traced(self, name: Optional[str] = None, cat: str = "driver",
+               **fields: Any):
+        """Decorator form: the wrapped call body runs inside a span."""
+
+        def deco(fn):
+            sname = name or fn.__name__
+
+            @functools.wraps(fn)
+            def wrapper(*a, **k):
+                with self.span(sname, cat=cat, **fields):
+                    return fn(*a, **k)
+
+            return wrapper
+
+        return deco

@@ -211,6 +211,22 @@ class ControlPlane:
                 )
             time.sleep(poll)
 
+    # -- telemetry (obs.gang: worker span/counter batches) ------------------
+    def ship_telemetry(self, batch) -> None:
+        """Worker side: publish one drained event batch as a numbered
+        ``telemetry/<pid>/<seq>`` property (see ``obs.gang``)."""
+        from dryad_tpu.obs.gang import ship_telemetry
+
+        ship_telemetry(self, batch)
+
+    def drain_telemetry(self, n: int, state: Dict, events) -> int:
+        """Driver side: absorb every worker's unread telemetry batches
+        into ``events`` with clock-offset correction; returns the
+        number of absorbed events (see ``obs.gang``)."""
+        from dryad_tpu.obs.gang import drain_telemetry
+
+        return drain_telemetry(self, n, state, events)
+
     # -- failures -----------------------------------------------------------
     def report_failure(self, info: Dict) -> None:
         self._set(

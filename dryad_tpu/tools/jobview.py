@@ -6,9 +6,18 @@ event log and runs a failure **Diagnosis** pass
 (``JobBrowser/JOM/jobinfo.cs:62``, ``JobBrowser/JobBrowser/Diagnosis.cs``).
 Here the event source is the executor's JSONL event log
 (``dryad_tpu.exec.events``); this module rebuilds the job model,
-renders a text report, and diagnoses common failure shapes.
+renders a text report (with the obs time-attribution summary: compile
+vs execute vs ingest-stall vs spill), diagnoses common failure shapes,
+and exports the stream as a Chrome/Perfetto trace.
 
-CLI: ``python -m dryad_tpu.tools.jobview <events.jsonl>``
+CLI: ``python -m dryad_tpu.tools.jobview [--html out.html]
+[--trace out.json] [--follow] <events.jsonl>``
+
+- ``--trace out.json`` writes a Chrome-trace (Perfetto) JSON of the
+  stream: span slices on per-thread tracks (prefetch / compute /
+  spill), pipeline-occupancy counters, instant markers for state
+  transitions, one process per worker for merged gang telemetry
+  (``dryad_tpu.obs.trace``).  Load it at ``ui.perfetto.dev``.
 """
 
 from __future__ import annotations
@@ -785,12 +794,44 @@ def fold_submission(
     return "\n\n".join(parts), ok
 
 
+def render_attribution(events: List[Dict[str, Any]]) -> str:
+    """The obs time-attribution block (compile vs execute vs
+    ingest-stall vs spill) plus a critical-path line (wall time vs the
+    accounted leaf time, and the longest single span — the place to
+    attack first).  Empty when the stream has no obs data."""
+    from dryad_tpu.obs.metrics import JobMetrics, format_attribution
+
+    m = JobMetrics.from_events(events)
+    lines = format_attribution(m)
+    if not lines:
+        return ""
+    ts = [e["ts"] for e in events if "ts" in e]
+    wall = (max(ts) - min(ts)) if len(ts) > 1 else 0.0
+    spans = [e for e in events if e.get("kind") == "span"]
+    if wall > 0 and spans:
+        accounted = (
+            m.compile_s + m.execute_s + m.ingest_stall_s + m.spill_write_s
+            + m.checkpoint_s
+        )
+        top = max(spans, key=lambda e: e.get("dur", 0.0))
+        lines.append(
+            f"critical path: wall={wall:.3f}s  accounted="
+            f"{min(accounted / wall, 1.0):.0%}  longest span="
+            f"{top.get('name')} ({top.get('dur', 0.0):.3f}s, "
+            f"{top.get('cat')})"
+        )
+    return "\n".join(["-- time attribution --"] + ["  " + l for l in lines])
+
+
 def _render_stream(events: List[Dict[str, Any]]) -> str:
     """Render whichever job model the stream holds."""
     kinds = {e["kind"] for e in events}
     if kinds & {"vertex_job_start", "gang_run_start"}:
-        return fold_submission(events)[0]
-    return render(build_job(events))
+        text = fold_submission(events)[0]
+    else:
+        text = render(build_job(events))
+    attr = render_attribution(events)
+    return text + ("\n" + attr if attr else "")
 
 
 def _load_tolerant(path: str) -> List[Dict[str, Any]]:
@@ -891,22 +932,36 @@ def follow_html(
 
 def main(argv: Optional[List[str]] = None) -> int:
     argv = argv if argv is not None else sys.argv[1:]
-    html_out: Optional[str] = None
-    if "--html" in argv:
-        i = argv.index("--html")
+
+    def _flag_with_arg(name: str) -> Optional[str]:
+        nonlocal argv
+        if name not in argv:
+            return None
+        i = argv.index(name)
         try:
-            html_out = argv[i + 1]
+            val = argv[i + 1]
         except IndexError:
-            print("--html requires an output path")
-            return 2
-        argv = argv[:i] + argv[i + 2 :]
+            raise SystemExit(f"{name} requires an output path")
+        argv = argv[:i] + argv[i + 2:]
+        return val
+
+    html_out = _flag_with_arg("--html")
+    trace_out = _flag_with_arg("--trace")
     live = "--follow" in argv
     if live:
         argv.remove("--follow")
     if len(argv) != 1:
         print(
             "usage: python -m dryad_tpu.tools.jobview [--html out.html] "
-            "[--follow] <events.jsonl>   (--follow --html = live page)"
+            "[--trace out.json] [--follow] <events.jsonl>\n"
+            "  --html out.html   standalone HTML report "
+            "(--follow --html = live page)\n"
+            "  --trace out.json  Chrome-trace (Perfetto) export: span "
+            "tracks per thread\n"
+            "                    (prefetch/compute/spill), occupancy "
+            "counters, one process\n"
+            "                    per worker for merged gang telemetry\n"
+            "  --follow          live re-render as the log grows"
         )
         return 2
     if live:
@@ -917,8 +972,16 @@ def main(argv: Optional[List[str]] = None) -> int:
             follow(argv[0])
         return 0
     events = EventLog.load(argv[0])
+    if trace_out:
+        from dryad_tpu.obs.trace import write_chrome_trace
+
+        write_chrome_trace(events, trace_out)
+        print(f"wrote {trace_out}")
+    attr = render_attribution(events)
     if {e["kind"] for e in events} & {"vertex_job_start", "gang_run_start"}:
         text, ok = fold_submission(events)
+        if attr:
+            text = text + "\n" + attr
         if html_out:
             with open(html_out, "w") as fh:
                 fh.write(_submission_html(text))
@@ -931,6 +994,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             fh.write(render_html(job))
         print(f"wrote {html_out}")
     print(render(job))
+    if attr:
+        print(attr)
     return 0 if job.ok else 1
 
 

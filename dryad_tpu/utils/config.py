@@ -178,6 +178,11 @@ class DryadConfig:
     # Bounded buffer of the background spill writer, in queued pieces
     # (exec.spill.SpillWriter): backpressure for the scatter phase.
     stream_writer_queue: int = _env_int("DRYAD_TPU_STREAM_WRITER_QUEUE", 8)
+    # Ring-buffer cap for the context EventLog's in-memory mirror
+    # (exec.events): long out-of-core jobs emit per-chunk/span events
+    # without bound; the file sink (event_log_dir) keeps the full
+    # stream.  0 = unbounded (legacy behavior).
+    obs_events_mem_cap: int = _env_int("DRYAD_TPU_OBS_EVENTS_MEM_CAP", 1 << 16)
 
     def __post_init__(self) -> None:
         self.validate()
@@ -232,3 +237,5 @@ class DryadConfig:
             raise ValueError("stream_pipeline_depth must be >= 1")
         if self.stream_writer_queue < 1:
             raise ValueError("stream_writer_queue must be >= 1")
+        if self.obs_events_mem_cap < 0:
+            raise ValueError("obs_events_mem_cap must be >= 0")

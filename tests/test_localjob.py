@@ -167,3 +167,42 @@ def test_apply_host_across_gang(submission):
     ).order_by(["x"])
     table = submission.submit(q)
     assert table["x"].tolist() == [float(i * i) for i in range(16)]
+
+
+def test_gang_telemetry_merges_worker_spans(submission):
+    """Observability acceptance: a gang run (2 workers) merges worker
+    span/counter telemetry into ONE driver-side event stream with
+    per-worker attribution and clock-offset correction, and the
+    Chrome-trace export renders each worker as its own process."""
+    rng = np.random.default_rng(7)
+    driver_ctx = DryadContext(num_partitions_=8)
+    q = (
+        driver_ctx.from_arrays(
+            {"k": rng.integers(0, 16, 256).astype(np.int32)}
+        )
+        .group_by("k", {"c": ("count", None)})
+        .order_by(["k"])
+    )
+    submission.submit(q)
+    evs = submission.events.events()
+    wspans = [
+        e for e in evs
+        if e["kind"] == "span" and e.get("cat") == "worker"
+    ]
+    # every gang member shipped its command span back
+    assert {e["worker"] for e in wspans} == {0, 1}
+    assert all("clock_offset" in e for e in wspans)
+    assert any(e["kind"] == "telemetry_merged" for e in evs)
+    # workers also ship their engine events (stage spans/completions)
+    assert any(
+        e["kind"] == "stage_complete" and "worker" in e for e in evs
+    )
+    from dryad_tpu.obs.trace import chrome_trace
+
+    tr = chrome_trace(evs)
+    procs = {
+        e["args"]["name"]
+        for e in tr["traceEvents"]
+        if e["ph"] == "M" and e["name"] == "process_name"
+    }
+    assert {"driver", "worker0", "worker1"} <= procs
