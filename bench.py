@@ -584,6 +584,63 @@ def ooc_wordcount_metric(
     )
 
 
+def ooc_vocab_metric(
+    n_words: int, chunk_rows: int = 1 << 15, vocab_step: int = 1 << 9,
+    runtime_tables=None,
+):
+    """Out-of-core WordCount over a WIDENING vocabulary: every chunk
+    introduces new words, so the dense-string coding tables grow the
+    whole stream.  With ``stringcode_runtime_tables`` (default on) the
+    tables ride the compiled program as runtime operands on a pow2
+    shape palette — compiles are bounded by palette tiers
+    (O(log vocab)) and per-chunk table H2D traffic shrinks to the
+    widened delta; off re-bakes the tables per widen (O(chunks)
+    compiles — the ROADMAP vocab-recompile open item's failure mode).
+    The record carries ``dense_compiles`` and the phases' compile_s /
+    compile_count so the compile-amortization win tracks in the perf
+    trajectory."""
+    from dryad_tpu import DryadConfig, DryadContext
+
+    rng = np.random.default_rng(7)
+    nchunks = max(2, n_words // chunk_rows)
+    final_vocab = nchunks * vocab_step
+    words = np.array([f"w{j:06d}" for j in range(final_vocab)])
+
+    def chunks():
+        for i in range(nchunks):
+            hi = (i + 1) * vocab_step
+            yield {"word": rng.choice(words[:hi], chunk_rows)}
+
+    kw = {} if runtime_tables is None else {
+        "stringcode_runtime_tables": runtime_tables
+    }
+    cfg = DryadConfig(**kw)
+    ctx = DryadContext(config=cfg)
+    t0 = time.perf_counter()
+    out = (
+        ctx.from_stream(chunks())
+        .group_by("word", {"c": ("count", None)})
+        .collect()
+    )
+    t = time.perf_counter() - t0
+    assert int(np.asarray(out["c"]).sum()) == nchunks * chunk_rows
+    dense_compiles = sum(
+        1 for e in ctx.executor.events.events()
+        if e["kind"] == "xla_compile" and "group_by" in e.get("stage", "")
+    )
+    pool = ctx.executor.operand_pool
+    return rep_record(
+        "oocvocab_rows_per_sec", nchunks * chunk_rows, [t],
+        {"chunks": nchunks, "chunk_rows": chunk_rows,
+         "final_vocab": final_vocab,
+         "runtime_tables": cfg.stringcode_runtime_tables,
+         "dense_compiles": dense_compiles,
+         "operand_uploads": pool.full_uploads,
+         "operand_delta_scatters": pool.delta_scatters,
+         "phases": _job_phases(ctx)},
+    )
+
+
 # Analytic single-chip ceilings (BASELINE.md "round-4 pass-count
 # analysis", v5e): the factorized one-hot kernel's per-PASS ceiling is
 # ~7.5e9 rows/s (contraction rate; NOT the old 4.8e10, which assumed
@@ -851,6 +908,15 @@ def child_main() -> None:
              1 << 24 if accel else 1 << 21,
              chunk_bytes=1 << 24 if accel else 1 << 21),
          200 if accel else 60, False),
+        # widening-vocab stream: compile-once dictionary coding
+        # (runtime-operand tables; dense_compiles bounded by palette
+        # tiers instead of chunks)
+        ("oocvocab_rows_per_sec",
+         lambda: ooc_vocab_metric(
+             1 << 22 if accel else 1 << 19,
+             chunk_rows=1 << 18 if accel else 1 << 15,
+             vocab_step=1 << 11 if accel else 1 << 9),
+         200 if accel else 75, False),
         # pipelined vs serial out-of-core driver (same workload, same
         # process): the depth=1 run IS the pre-pipeline baseline
         ("ooc_pipeline_speedup",

@@ -40,7 +40,13 @@ from dryad_tpu.exec.failure import (
     StageFailedError,
     classify,
 )
-from dryad_tpu.exec.kernels import NON_OVERFLOW_OPS, build_stage_fn
+from dryad_tpu.exec.kernels import (
+    NON_OVERFLOW_OPS,
+    OPERAND_PARAMS,
+    build_stage_fn,
+    stage_operand_objs,
+)
+from dryad_tpu.exec.operands import DeviceOperandPool, is_operand_capable
 from dryad_tpu.exec.stats import StageStatistics
 from dryad_tpu.obs.metrics import MetricsRegistry
 from dryad_tpu.obs.span import Tracer
@@ -205,6 +211,17 @@ class GraphExecutor:
         self.metrics = MetricsRegistry()
         self.P = num_partitions(mesh)
         self._compiled: Dict[Tuple, Any] = {}
+        # Static-vs-operand split for plan params: OPERAND-registered
+        # params (the string coding tables) key the compile cache by
+        # shape-palette TIER and travel as call-time device inputs via
+        # the content-addressed operand pool — vocabulary widening
+        # within a tier reuses the compiled program and scatters only
+        # the widened table delta to the device.  Off = the legacy
+        # baked-constant path (key by content; recompile per widen).
+        self.runtime_operands = bool(
+            getattr(self.config, "stringcode_runtime_tables", True)
+        )
+        self.operand_pool = DeviceOperandPool(mesh, metrics=self.metrics)
         # do_while loop-state compaction programs (see _compact_loop_state)
         self._compact_cache: Dict[Tuple, Any] = {}
         self.stats: Dict[str, StageStatistics] = {}
@@ -237,16 +254,32 @@ class GraphExecutor:
         self._sleep: Callable[[float], None] = time.sleep
 
     # -- compilation cache -------------------------------------------------
-    @staticmethod
-    def _stage_key(stage: Stage) -> Tuple:
+    def _stage_key(self, stage: Stage, split_operands: bool = True) -> Tuple:
         """Structural stage identity: op kinds + static params + fn object
         ids.  Re-lowering the same logical plan yields new stage ids but
         identical structure (fn objects live on the plan nodes), so
-        repeated collect()/do_while iterations hit the cache."""
+        repeated collect()/do_while iterations hit the cache.
+
+        Params registered as OPERANDs (``kernels.OPERAND_PARAMS``, with
+        the runtime-tables split on) key by their shape-palette TIER
+        (``operand_signature()``) instead of content — the compiled fn
+        takes their arrays as call-time device inputs, so every table
+        of a tier shares one program.  ``split_operands=False`` keeps
+        the content key (the do_while device path, which builds its
+        loop body without operand plumbing and must not share programs
+        across table contents)."""
+        split = split_operands and self.runtime_operands
         parts = []
         for op in stage.ops:
             items = []
             for k, v in sorted(op.params.items()):
+                if (
+                    split
+                    and (op.kind, k) in OPERAND_PARAMS
+                    and is_operand_capable(v)
+                ):
+                    items.append((k, ("operand", v.operand_signature())))
+                    continue
                 if isinstance(v, list):
                     v = tuple(v)
                 try:
@@ -260,6 +293,19 @@ class GraphExecutor:
                 items.append((k, v))
             parts.append((op.kind, tuple(items)))
         return (tuple(parts), tuple(stage.out_slots))
+
+    def _stage_rep(self, stage: Stage) -> Tuple:
+        """Call-time replicated operand arrays for a dispatch of
+        ``stage`` — the flattened device buffers of every OPERAND
+        param, in ``stage_operand_objs`` order (the same enumeration
+        ``build_stage_fn`` bound the trace against)."""
+        if not self.runtime_operands:
+            return ()
+        return tuple(
+            a
+            for obj in stage_operand_objs(stage)
+            for a in self.operand_pool.get(obj)
+        )
 
     def _get_compiled(
         self, stage: Stage, boost: int, shape_key: Tuple,
@@ -282,6 +328,10 @@ class GraphExecutor:
                 run_stage, self.P, self.config.shuffle_slack, boost,
                 mesh_axes(self.mesh),
                 tuple(self.mesh.shape[a] for a in mesh_axes(self.mesh)),
+                operand_objs=tuple(
+                    stage_operand_objs(run_stage)
+                    if self.runtime_operands else ()
+                ),
             )
             hit = _CompileTimed(
                 compile_stage(self.mesh, fn), self, run_stage.name,
@@ -896,7 +946,12 @@ class GraphExecutor:
                     stage.name, cat="execute", stage=stage.id,
                     version=version, boost=boost,
                 ):
-                    outs, (overflow, dict_miss) = fn(inputs, ())
+                    # OPERAND params ride the replicated slot: current
+                    # table content from the pool (uploaded/scattered
+                    # once per content, reused across dispatches)
+                    outs, (overflow, dict_miss) = fn(
+                        inputs, self._stage_rep(stage)
+                    )
                     counts_dev = None
                     if want_count:
                         import jax.numpy as jnp
@@ -1287,9 +1342,14 @@ class GraphExecutor:
                 _, (covf, _cm) = cond_fn((bout,), ())
                 return (bout,), (ovf | covf, it, miss)
 
+            # split_operands=False: these fns were built WITHOUT
+            # operand plumbing (the loop body bakes table constants),
+            # so the cache must key by table content, not tier.
             key = (
-                "do_while_device", self._stage_key(body_stage),
-                self._stage_key(cond_stage), self._shape_key((current,)),
+                "do_while_device",
+                self._stage_key(body_stage, split_operands=False),
+                self._stage_key(cond_stage, split_operands=False),
+                self._shape_key((current,)),
                 max_iter, boost,
             )
             fn = self._compiled.get(key)

@@ -29,6 +29,43 @@ def _round8(n: float) -> int:
     return max(8, int(math.ceil(n / 8.0)) * 8)
 
 
+# (op kind, param name) pairs whose values are RUNTIME OPERANDS under
+# ``stringcode_runtime_tables``: the executor keys its compile cache on
+# the param's ``operand_signature()`` (shape-palette tier) instead of
+# its content, and the arrays arrive through the stage fn's replicated
+# input slot at call time (``exec.operands.DeviceOperandPool``).
+# Kernels MUST read these params' arrays via ``ctx.operand(...)`` —
+# materializing them with np/jnp.asarray inside the traced body would
+# silently re-bake the content as compiled constants (the AST lint in
+# tests/test_operand_lint.py enforces this in both directions).
+OPERAND_PARAMS = frozenset({
+    ("string_code", "table"),
+    ("group_reduce_dense", "decode"),
+})
+
+
+def stage_operand_objs(stage) -> List[Any]:
+    """Operand-protocol objects of a stage's OPERAND-registered params,
+    in deterministic (op order, param name) order and deduplicated by
+    identity — the ONE enumeration shared by the trace-time binding
+    (``build_stage_fn``), the executor's cache key, and the call-time
+    operand upload, so the replicated tuple always lines up."""
+    from dryad_tpu.exec.operands import is_operand_capable
+
+    objs: List[Any] = []
+    seen = set()
+    for op in stage.ops:
+        for k in sorted(op.params):
+            if (op.kind, k) not in OPERAND_PARAMS:
+                continue
+            v = op.params[k]
+            if v is None or not is_operand_capable(v) or id(v) in seen:
+                continue
+            seen.add(id(v))
+            objs.append(v)
+    return objs
+
+
 class StageContext:
     """Mutable trace-time state while composing one stage function."""
 
@@ -42,11 +79,20 @@ class StageContext:
         self.boost = boost
         self.slots: Dict[int, ColumnBatch] = {}
         self.entry_caps: Dict[int, int] = {}
+        # id(param object) -> tuple of traced operand arrays (bound
+        # from the replicated inputs by build_stage_fn); empty on the
+        # legacy baked-constant path
+        self.operand_map: Dict[int, Tuple] = {}
         self.overflow = jnp.zeros((), jnp.bool_)
         # Rows whose STRING hash words missed the context dictionary
         # (runtime-fabricated values the dense path would silently
         # drop); surfaced by the executor after the job drains.
         self.dict_miss = jnp.zeros((), jnp.int32)
+
+    def operand(self, obj) -> Any:
+        """Traced device arrays for an OPERAND-registered param object,
+        or None when the stage runs the legacy baked-constant path."""
+        return self.operand_map.get(id(obj))
 
     def bind_inputs(self, batches: Tuple[ColumnBatch, ...]) -> None:
         for i, b in enumerate(batches):
@@ -359,8 +405,11 @@ def _k_group_reduce_dense(ctx: StageContext, p) -> None:
     else:
         # auto-dense STRING key: gather this partition's code range from
         # the dictionary decode table to reconstruct the physical
-        # (#h0, #h1, #r0, #r1) words (ops/stringcode.py)
-        words = decode.slice_rows(me * per, per)  # (per, 4) uint32
+        # (#h0, #h1, #r0, #r1) words (ops/stringcode.py); the table
+        # arrives as a runtime operand when registered, else baked
+        words = decode.slice_rows(
+            me * per, per, operands=ctx.operand(decode)
+        )  # (per, 4) uint32
         okey = p["out_key"]
         out = {
             f"{okey}#{w}": words[:, i]
@@ -389,18 +438,22 @@ def _k_group_reduce_dense(ctx: StageContext, p) -> None:
 def _k_string_code(ctx: StageContext, p) -> None:
     """Map a STRING column's Hash64 words to dense dictionary codes
     (``ops/stringcode.py``) — the bridge that lets a plain group_by
-    over strings ride the MXU dense path.  Misses map to num_codes,
-    which the dense kernel's range mask drops."""
+    over strings ride the MXU dense path.  Misses map to the padded
+    code domain, which the dense kernel's range mask drops."""
     b = ctx.slots[p["slot"]]
-    codes = p["table"].lookup(b.data[p["h0"]], b.data[p["h1"]])
-    # Out-of-dictionary rows (miss -> num_codes) would be silently
-    # dropped by the dense kernel's range mask; count them so the
-    # executor can surface the loss instead (deferred readback, no
-    # sync on the dense fast path).
+    table = p["table"]
+    rt = ctx.operand(table)  # runtime-operand arrays, or None = baked
+    codes = table.lookup(b.data[p["h0"]], b.data[p["h1"]], operands=rt)
+    # Out-of-dictionary rows (miss -> num_codes_padded) would be
+    # silently dropped by the dense kernel's range mask; count them so
+    # the executor can surface the loss instead (deferred readback, no
+    # sync on the dense fast path).  The threshold is the TIER bound on
+    # the operand path — num_codes itself would re-bake a per-widen
+    # trace constant; nothing occupies [num_codes, padded), so the two
+    # thresholds count identically.
+    bound = table.num_codes_padded if rt is not None else table.num_codes
     miss = jnp.sum(
-        (b.valid & (codes >= jnp.int32(p["table"].num_codes))).astype(
-            jnp.int32
-        )
+        (b.valid & (codes >= jnp.int32(bound))).astype(jnp.int32)
     )
     ctx.dict_miss = ctx.dict_miss + miss
     ctx.slots[p["slot"]] = ColumnBatch(
@@ -974,12 +1027,30 @@ _KERNELS = {
 
 def build_stage_fn(stage, P: int, slack: float, boost: int,
                    axes: "Tuple[str, ...]" = (AXIS,),
-                   axis_sizes: "Tuple[int, ...]" = ()):
-    """Compose the stage's ops into one per-partition function."""
+                   axis_sizes: "Tuple[int, ...]" = (),
+                   operand_objs: "Tuple[Any, ...]" = ()):
+    """Compose the stage's ops into one per-partition function.
 
-    def fn(sharded_inputs, _replicated):
+    ``operand_objs``: the stage's OPERAND-registered param objects (in
+    ``stage_operand_objs`` order) whose arrays arrive flattened through
+    the replicated input slot at call time instead of being baked as
+    trace constants; empty = the legacy baked path (every caller that
+    passes operands must feed the matching arrays on every call)."""
+
+    def fn(sharded_inputs, replicated):
         ctx = StageContext(P, slack, boost, axes, axis_sizes)
         ctx.bind_inputs(tuple(sharded_inputs))
+        rep = tuple(replicated)
+        pos = 0
+        for obj in operand_objs:
+            n = obj.operand_arity
+            ctx.operand_map[id(obj)] = rep[pos:pos + n]
+            pos += n
+        if pos != len(rep):
+            raise ValueError(
+                f"stage {stage.name!r}: {len(rep)} replicated operand "
+                f"arrays for {pos} registered operand slots"
+            )
         for op in stage.ops:
             if op.kind == "do_while":
                 raise RuntimeError("do_while stages are driver-evaluated")

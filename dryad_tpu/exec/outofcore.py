@@ -91,8 +91,19 @@ class _IngestScope:
         self.vocab: Dict[str, np.ndarray] = {}
         self.stats: Dict[str, Tuple[int, int]] = {}
         self.cache_plans = cache_plans
-        # bumps whenever vocab/stats/capacity widen: cached input nodes
-        # and the chains built on them are valid while it holds still
+        # Runtime-operand coding tables (compile-once dictionary
+        # coding): vocab widening within a pow2 palette tier keeps
+        # every traced shape identical, so it must NOT bump the cached-
+        # plan epoch — the cached input node is reused with its
+        # str_vocab param refreshed in place (_maybe_reuse), the
+        # lowering rebuilds the widened tables, and the executor's
+        # operand pool scatters just the delta.
+        self._runtime_tables = bool(
+            getattr(ctx.config, "stringcode_runtime_tables", True)
+        )
+        # bumps whenever vocab/stats/capacity widen beyond what cached
+        # plans can absorb: cached input nodes and the chains built on
+        # them are valid while it holds still
         self.version = 0
         # (cap, binding kind) -> (version, node) reusable ingest input
         self._cached_input: Dict[Tuple, Tuple[int, Node]] = {}
@@ -106,10 +117,19 @@ class _IngestScope:
         return self.cap
 
     def _widen_vocab(self, col: str, v: np.ndarray) -> np.ndarray:
+        from dryad_tpu.ops.stringcode import palette_domain
+
         prev = self.vocab.get(col)
         new = v if prev is None else np.union1d(prev, v)
-        if prev is None or len(new) != len(prev):
+        if prev is None:
             self.version += 1
+        elif len(new) != len(prev):
+            if not self._runtime_tables or palette_domain(
+                len(new)
+            ) != palette_domain(len(prev)):
+                # legacy baked tables invalidate on ANY widen; runtime
+                # tables only on a palette-tier crossing
+                self.version += 1
         self.vocab[col] = new
         return new
 
@@ -191,6 +211,17 @@ class _IngestScope:
             # cached one (checkpoint identity must follow the data)
             ctx._bindings[cnode.id] = ctx._bindings.pop(node.id)
             ctx._binding_fp_cache.pop(cnode.id, None)
+            # refresh the cached node's vocabulary metadata in place: a
+            # within-tier widen reuses the node (and every chain/compiled
+            # program built on it) but the NEXT lowering must code
+            # against the full accumulated vocab — a stale str_vocab
+            # would build tables missing this chunk's new words and fail
+            # them loudly as dictionary misses.
+            sv = cnode.params.get("str_vocab")
+            if sv:
+                cnode.params["str_vocab"] = {
+                    c: self.vocab.get(c, vv) for c, vv in sv.items()
+                }
             return Query(ctx, cnode)
         self._cached_input[key] = (self.version, node)
         return q

@@ -10,11 +10,17 @@ with no shuffle — the reference pays a full hash repartition for the
 same query (``DryadLinqQueryNode.cs:3581``).
 
 The mapping table is host-built open addressing over the 64-bit hash
-(linear probing, power-of-two slots, load <= 0.5), shipped to the
-device as three constant arrays; lookup is ``max_probe`` unrolled
-vectorized gathers.  Tables are wrapped in VALUE-equal objects so the
-executor's structural compile cache keys on table *content* — a grown
-dictionary recompiles, a rebuilt identical pipeline does not.
+(linear probing, power-of-two slots, load <= 0.5); lookup is an
+unrolled vectorized gather loop.  Tables are wrapped in VALUE-equal
+objects so the executor's structural compile cache can key on table
+*content* (the legacy baked-constant path), or — with
+``stringcode_runtime_tables`` — on the table's **shape palette tier**
+only, with the arrays fed as call-time device operands (the
+static-vs-operand split: DrJAX keeps MapReduce primitives compiling
+once per shape the same way).  Every table dimension is quantized to
+the power-of-two palette (:func:`palette_domain`), so a widening
+vocabulary crosses O(log vocab) tiers instead of forcing O(widenings)
+recompiles.
 """
 
 from __future__ import annotations
@@ -29,19 +35,39 @@ def _mix(h0: np.ndarray, h1: np.ndarray) -> np.ndarray:
     return (h0 ^ (h1 * np.uint32(0x9E3779B9))).astype(np.uint32)
 
 
+def palette_domain(n: int) -> int:
+    """Power-of-two shape-palette step for a dense code domain of ``n``
+    codes (min 4).  ONE quantization shared by CodeTable slot sizing,
+    DecodeTable padding, and the ingest scope's tier-change test — a
+    vocabulary that widens within a step keeps every traced shape (and
+    therefore every compile-cache key) identical."""
+    d = 4
+    while d < max(n, 1):
+        d *= 2
+    return d
+
+
 class CodeTable:
     """Open-addressing (h0, h1) -> dense code map; VALUE-equal.
 
     ``slots_h0/h1``: uint32 hash words per slot; ``slots_code``: int32
-    code or -1 for empty; ``num_codes`` = K; misses map to K (the dense
-    kernel's out-of-range drop)."""
+    code or -1 for empty; ``num_codes`` = K; misses map to
+    ``num_codes_padded`` (past every real code — the dense kernel's
+    out-of-range drop in BOTH palette modes).
+
+    Shape palette: ``num_slots`` is ``2 * palette_domain(K)`` (load
+    <= 0.5) and the unrolled probe loop runs ``probe_bound`` (the
+    observed max probe rounded up to a power of two) iterations, so the
+    traced lookup depends only on the ``(num_slots, probe_bound)`` tier
+    — two tables of the same tier produce byte-identical traces and the
+    arrays can travel as runtime operands (``operand_arrays``)."""
+
+    operand_arity = 3  # (slots_h0, slots_h1, slots_code)
 
     def __init__(self, pairs: np.ndarray):
         """``pairs``: (K, 2) uint32 — (h0, h1) per code, in code order."""
         K = len(pairs)
-        S = 8
-        while S < 2 * max(K, 1):
-            S *= 2
+        S = 2 * palette_domain(K)
         h0 = pairs[:, 0].astype(np.uint32)
         h1 = pairs[:, 1].astype(np.uint32)
         slots_h0 = np.zeros(S, np.uint32)
@@ -61,18 +87,27 @@ class CodeTable:
             max_probe = max(max_probe, probe)
         self.num_slots = S
         self.num_codes = K
+        self.num_codes_padded = S // 2  # pow2 >= K: the palette domain
         self.max_probe = max_probe
+        # pow2-quantized probe budget: tier-static, so an append that
+        # lengthens one probe chain within the budget does not change
+        # the traced loop (probing past a key's true chain is harmless:
+        # hits require an exact stored (h0, h1) match)
+        self.probe_bound = palette_domain(max_probe)
         self.slots_h0 = slots_h0
         self.slots_h1 = slots_h1
         self.slots_code = slots_code
         import hashlib
 
-        self._fp = hash(
-            (S, K, max_probe, slots_h0.tobytes(), slots_h1.tobytes())
-        )
+        # Content digest FIRST; the Python-level fingerprint derives
+        # from it so __hash__ is process-stable (Python's hash() over
+        # bytes is per-process salted — job packages and checkpoint
+        # meta compare fingerprints across processes).
         self._sha = hashlib.sha1(
-            slots_h0.tobytes() + slots_h1.tobytes() + slots_code.tobytes()
-        ).hexdigest()[:12]
+            np.int64(S).tobytes()
+            + slots_h0.tobytes() + slots_h1.tobytes() + slots_code.tobytes()
+        ).hexdigest()
+        self._fp = int(self._sha[:16], 16)
 
     def __eq__(self, other) -> bool:
         return (
@@ -89,44 +124,75 @@ class CodeTable:
 
     def __repr__(self) -> str:
         # content-addressed and PROCESS-STABLE (checkpoint fingerprints
-        # embed repr(param); Python hash() is per-process salted);
-        # digest frozen at init — the arrays are immutable
+        # embed repr(param)); digest frozen at init — arrays immutable
         return (
             f"CodeTable(S={self.num_slots},K={self.num_codes},"
-            f"probe={self.max_probe},sha={self._sha})"
+            f"probe={self.max_probe},sha={self._sha[:12]})"
         )
 
-    def lookup(self, h0, h1):
+    # -- runtime-operand protocol (exec.operands.DeviceOperandPool) ----
+    def operand_signature(self) -> Tuple:
+        """Shape-palette tier: everything the traced lookup bakes in.
+        Tables sharing a signature are interchangeable at call time."""
+        return ("CodeTable", self.num_slots, self.probe_bound)
+
+    def operand_arrays(self) -> Tuple[np.ndarray, ...]:
+        return (self.slots_h0, self.slots_h1, self.slots_code)
+
+    def operand_sha(self) -> str:
+        return self._sha
+
+    def lookup(self, h0, h1, operands=None):
         """Device lookup: (n,) uint32 words -> (n,) int32 codes, misses
-        -> num_codes (dropped by the dense kernel's range mask)."""
+        -> num_codes_padded (dropped by the dense kernel's range mask).
+
+        ``operands``: the (slots_h0, slots_h1, slots_code) device
+        arrays when the tables travel as runtime operands; None bakes
+        them into the trace as constants (legacy path).  Either way the
+        trace depends only on ``operand_signature()`` values."""
         import jax.numpy as jnp
 
         S = self.num_slots
-        th0 = jnp.asarray(self.slots_h0)
-        th1 = jnp.asarray(self.slots_h1)
-        tco = jnp.asarray(self.slots_code)
+        if operands is not None:
+            th0, th1, tco = operands
+        else:
+            th0 = jnp.asarray(self.slots_h0)
+            th1 = jnp.asarray(self.slots_h1)
+            tco = jnp.asarray(self.slots_code)
         idx = (h0 ^ (h1 * jnp.uint32(0x9E3779B9))).astype(jnp.uint32) & jnp.uint32(S - 1)
         idx = idx.astype(jnp.int32)
         code = jnp.full(h0.shape, -1, jnp.int32)
-        for p in range(self.max_probe):
+        for p in range(self.probe_bound):
             j = (idx + p) & (S - 1)
             hit = (th0[j] == h0) & (th1[j] == h1) & (tco[j] >= 0)
             code = jnp.where(hit & (code < 0), tco[j], code)
-        return jnp.where(code < 0, jnp.int32(self.num_codes), code)
+        return jnp.where(code < 0, jnp.int32(self.num_codes_padded), code)
 
 
 class DecodeTable:
     """Dense code -> STRING physical words (h0, h1, r0, r1); VALUE-equal.
 
-    ``words``: (K, 4) uint32 in code order; the dense kernel gathers its
-    partition's row range to reconstruct the key columns."""
+    ``words``: (K, 4) uint32 in code order.  The padded gather buffer
+    (``2 * palette_domain(K)`` rows, zero-filled past K) is built ONCE
+    at construction — it doubles as the zero-pad for any per-partition
+    slice and as the fixed-shape runtime operand."""
+
+    operand_arity = 1  # (padded words buffer,)
 
     def __init__(self, words: np.ndarray):
         import hashlib
 
         self.words = np.ascontiguousarray(words, np.uint32)
-        self._fp = hash(self.words.tobytes())
-        self._sha = hashlib.sha1(self.words.tobytes()).hexdigest()[:12]
+        K = len(self.words)
+        self.num_codes_padded = palette_domain(K)
+        R = 2 * self.num_codes_padded
+        padded = np.zeros((R, 4), np.uint32)
+        padded[:K] = self.words
+        self.words_padded = padded
+        self._sha = hashlib.sha1(
+            np.int64(R).tobytes() + self.words.tobytes()
+        ).hexdigest()
+        self._fp = int(self._sha[:16], 16)
 
     def __eq__(self, other) -> bool:
         return (
@@ -139,23 +205,34 @@ class DecodeTable:
         return self._fp
 
     def __repr__(self) -> str:
-        return f"DecodeTable(K={len(self.words)},sha={self._sha})"
+        return f"DecodeTable(K={len(self.words)},sha={self._sha[:12]})"
 
-    def slice_rows(self, start, count: int):
+    # -- runtime-operand protocol --------------------------------------
+    def operand_signature(self) -> Tuple:
+        return ("DecodeTable", self.words_padded.shape[0])
+
+    def operand_arrays(self) -> Tuple[np.ndarray, ...]:
+        return (self.words_padded,)
+
+    def operand_sha(self) -> str:
+        return self._sha
+
+    def slice_rows(self, start, count: int, operands=None):
         """Device gather of ``count`` code rows from ``start`` (dynamic):
-        returns a (count, 4) uint32 block, rows past K zero-filled."""
+        returns a (count, 4) uint32 block, rows past K zero-filled.
+
+        ``operands``: the padded device buffer when it travels as a
+        runtime operand; None bakes the precomputed host buffer in as a
+        trace constant (legacy path — no per-call ``np.concatenate``)."""
         import jax
         import jax.numpy as jnp
 
-        K = len(self.words)
-        pad = max(0, count - 1)
-        tab = jnp.asarray(
-            np.concatenate([self.words, np.zeros((pad, 4), np.uint32)])
-            if pad
-            else self.words
+        R = self.words_padded.shape[0]
+        tab = operands[0] if operands is not None else jnp.asarray(
+            self.words_padded
         )
         return jax.lax.dynamic_slice_in_dim(
-            tab, jnp.clip(start, 0, max(K - 1, 0)), count, axis=0
+            tab, jnp.clip(start, 0, R - count), count, axis=0
         )
 
 
@@ -210,22 +287,27 @@ def build_tables_subset(
 ) -> Tuple[CodeTable, DecodeTable]:
     """Build the (code, decode) pair over a SUBSET of the dictionary —
     the key column's own per-ingest vocabulary (``api.query.
-    static_str_vocab``) — in sorted-hash order (deterministic across
-    driver and workers; the job package ships the tables inside the
-    lowered plan).  Hashes absent from the dictionary are skipped:
-    they cannot decode, and the runtime miss guard covers fabricated
-    values.  A (len, digest)-keyed memo on the dictionary makes warm
-    re-lowers O(1)."""
+    static_str_vocab``) — in dictionary INSERTION order (deterministic
+    given the context dictionary; the job package ships the tables
+    inside the lowered plan).  Insertion order makes a widening
+    vocabulary's tables APPEND-ONLY: existing codes keep their values
+    and their probe slots, so the runtime-operand pool can scatter just
+    the new entries into the device buffers instead of re-uploading
+    (sorted-hash order would renumber every code past each insertion
+    point).  Hashes absent from the dictionary are skipped: they cannot
+    decode, and the runtime miss guard covers fabricated values.  A
+    (len, digest)-keyed memo on the dictionary makes warm re-lowers
+    O(1)."""
     hs = np.unique(np.asarray(hashes, np.uint64))
     key = (len(dictionary), hs.tobytes())
     cached = getattr(dictionary, "_stringcode_subset_cache", None)
     if cached is not None and cached[0] == key:
         return cached[1]
-    strings = []
+    want = set(hs.tolist())
     kept = []
-    for h in hs.tolist():
-        s = dictionary._map.get(h)
-        if s is not None:
+    strings = []
+    for h, s in dictionary.items():  # insertion (= code) order
+        if h in want:
             kept.append(h)
             strings.append(s)
     tables = _tables_from(kept, strings)

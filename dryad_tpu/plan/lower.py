@@ -423,6 +423,18 @@ class _Builder:
             code_t, dec_t = build_tables_subset(self.dictionary, vocab)
         else:
             code_t, dec_t = build_tables(self.dictionary)
+        # Runtime-operand tables: the bucket domain is the table's
+        # shape-palette tier (pow2 >= K), not K itself — K is a
+        # per-widen value whose baking would put the vocabulary size
+        # back into the trace the operand split just removed.  Codes in
+        # [K, padded) never occur (misses map to padded exactly), so
+        # the extra buckets stay empty and drop at the validity mask.
+        runtime = bool(
+            getattr(self.config, "stringcode_runtime_tables", True)
+        )
+        num_buckets = (
+            code_t.num_codes_padded if runtime else code_t.num_codes
+        )
         stage.ops.append(StageOp(
             "string_code",
             dict(slot=slot, h0=f"{key}#h0", h1=f"{key}#h1",
@@ -431,7 +443,7 @@ class _Builder:
         stage.ops.append(StageOp(
             "group_reduce_dense",
             dict(slot=slot, key="#code", aggs=aggs,
-                 num_buckets=code_t.num_codes, decode=dec_t,
+                 num_buckets=num_buckets, decode=dec_t,
                  out_key=key),
         ))
         want = K.group_carry_cols(node.schema, node.schema.names)

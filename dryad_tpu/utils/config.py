@@ -119,6 +119,18 @@ class DryadConfig:
     # path (with a range-miss guard for post-ingest fabrication).
     auto_dense_ints: bool = True
     auto_dense_limit: int = _env_int("DRYAD_TPU_AUTO_DENSE_LIMIT", 1 << 17)
+    # Compile-once dictionary coding (static-vs-operand param split):
+    # the string CodeTable/DecodeTable arrays ride the compiled program
+    # as call-time DEVICE OPERANDS on a power-of-two shape palette —
+    # the compile cache keys on the palette tier, a widening vocabulary
+    # pays O(log vocab) compiles instead of O(widenings), and the
+    # executor's operand pool scatters only the widened table delta to
+    # the device.  Off = the legacy baked-constant path (each table
+    # content is its own compile-cache key) kept as the differential
+    # baseline.
+    stringcode_runtime_tables: bool = _env_bool(
+        "DRYAD_TPU_STRINGCODE_RUNTIME_TABLES", True
+    )
     # Device-resident input cache budget in bytes (0 disables): ingested
     # host/store tables stay sharded in HBM across submits, LRU-evicted
     # by size — the on-device analog of the ProcessService LRU block
