@@ -641,6 +641,78 @@ def ooc_vocab_metric(
     )
 
 
+def codedagg_metric(nrows: int = 60_000, nparts: int = 2, delay: float = 6.0):
+    """Coded k-of-n vs duplicate-on-straggle under an injected straggler
+    (dryad_tpu.redundancy): one worker stalls its vertex ``delay``
+    seconds; the duplicate baseline must IDENTIFY the straggler with a
+    robust outlier model (>= 3 completed samples — with k=2 shards it
+    can never converge, so the stall runs to completion), while the
+    coded path needs only the coarse any-k-of-n spare trigger
+    (exec.stats.spare_threshold) and reconstructs the stage output from
+    the fast worker's systematic + parity completions, bit-exactly for
+    the integer accumulators.  Value = duplicate/coded makespan ratio."""
+    from dryad_tpu import DryadContext
+    from dryad_tpu.cluster.localjob import LocalJobSubmission
+
+    rng = np.random.default_rng(11)
+    tbl = {
+        "k": rng.integers(0, 64, nrows).astype(np.int32),
+        "v": rng.integers(-1000, 1000, nrows).astype(np.int32),
+    }
+    with LocalJobSubmission(num_workers=2, devices_per_worker=1) as sub:
+        ctx = DryadContext(num_partitions_=1)
+        q = ctx.from_arrays(tbl).group_by(
+            "k", {"c": ("count", None), "s": ("sum", "v")}
+        )
+        # warm package/compile caches on both paths and both workers
+        base = sub.submit_partitioned(q, nparts=nparts, coded=False)
+        coded_out = sub.submit_partitioned(q, nparts=nparts, coded=True)
+        assert sorted(
+            zip(base["k"].tolist(), base["c"].tolist(), base["s"].tolist())
+        ) == sorted(
+            zip(coded_out["k"].tolist(), coded_out["c"].tolist(),
+                coded_out["s"].tolist())
+        )
+
+        sub.inject_delay(worker=1, seconds=delay, count=1)
+        t0 = time.perf_counter()
+        sub.submit_partitioned(q, nparts=nparts, coded=False)
+        t_dup = time.perf_counter() - t0
+
+        sub.inject_delay(worker=1, seconds=delay, count=1)
+        t0 = time.perf_counter()
+        out = sub.submit_partitioned(q, nparts=nparts, coded=True)
+        t_coded = time.perf_counter() - t0
+        assert out["c"].tobytes() == coded_out["c"].tobytes()
+        assert out["s"].tobytes() == coded_out["s"].tobytes()
+
+        evs = sub.events.events()
+        rec = [e for e in evs if e["kind"] == "coded_reconstruct"][-1]
+        waste = sum(
+            e.get("bytes", 0) for e in evs
+            if e["kind"] == "coded_waste_bytes"
+        )
+    ratio = t_dup / max(t_coded, 1e-9)
+    return {
+        "metric": "codedagg_makespan_speedup",
+        "value": round(ratio, 3),
+        "unit": "x",
+        "baseline": "duplicate-on-straggle (speculative duplication)",
+        "duplicate_s": round(t_dup, 3),
+        "coded_s": round(t_coded, 3),
+        "injected_delay_s": delay,
+        "rows": nrows,
+        "nparts": nparts,
+        "parity_used": rec.get("parity_used", 0),
+        "exact_reconstruct": bool(rec.get("exact")),
+        "coded_waste_bytes": waste,
+        "platform": _PLATFORM,
+        "contended": False,
+        "spread": 1.0,
+        "reps_s": [round(t_coded, 3)],
+    }
+
+
 # Analytic single-chip ceilings (BASELINE.md "round-4 pass-count
 # analysis", v5e): the factorized one-hot kernel's per-PASS ceiling is
 # ~7.5e9 rows/s (contraction rate; NOT the old 4.8e10, which assumed
@@ -917,6 +989,12 @@ def child_main() -> None:
              chunk_rows=1 << 18 if accel else 1 << 15,
              vocab_step=1 << 11 if accel else 1 << 9),
          200 if accel else 75, False),
+        # coded k-of-n vs duplicate-on-straggle makespan under an
+        # injected straggler (2 worker processes; host-bound — the
+        # workers pin JAX_PLATFORMS=cpu on any backend)
+        ("codedagg_makespan_speedup",
+         lambda: codedagg_metric(),
+         90, False),
         # pipelined vs serial out-of-core driver (same workload, same
         # process): the depth=1 run IS the pre-pipeline baseline
         ("ooc_pipeline_speedup",

@@ -13,7 +13,7 @@ combine after (``DryadLinqDecomposition.cs:34``;
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from dryad_tpu.columnar.schema import ColumnType
 
@@ -36,6 +36,17 @@ class Decomposable:
     aggregation applied to custom combiners
     (``DrDynamicAggregateManager``).  Without it, decomposable plans
     keep the gang path (state dtypes are unknown until trace).
+
+    **Linearity** (coded stage redundancy, ``dryad_tpu.redundancy``):
+    ``linear=True`` declares that ``merge`` is ELEMENTWISE ADDITION of
+    the state columns and ``identity`` is their additive zero — the
+    contract that lets the scheduler encode the k per-partition
+    partials as n = k + r coded vertices and reconstruct the stage
+    output from ANY k completions (finalize may still be arbitrary;
+    only the state merge must be linear).  Declaring ``linear=True``
+    REQUIRES registering the identity element — one zero per state
+    column — enforced here and by the AST lint in
+    ``tests/test_coded_lint.py``.
     """
 
     seed: Callable[[Dict], Dict]
@@ -44,3 +55,131 @@ class Decomposable:
     out_fields: Sequence[Tuple[str, ColumnType]]
     finalize: Optional[Callable[[Dict], Dict]] = None
     state_fields: Optional[Sequence[Tuple[str, ColumnType]]] = None
+    linear: bool = False
+    identity: Optional[Dict[str, Any]] = None
+
+    def __post_init__(self) -> None:
+        if not self.linear:
+            return
+        if self.identity is None:
+            raise ValueError(
+                "Decomposable(linear=True) requires a registered "
+                "identity element: identity={state_col: 0, ...}"
+            )
+        if set(self.identity) != set(self.state_cols):
+            raise ValueError(
+                f"identity keys {sorted(self.identity)} must match "
+                f"state_cols {sorted(self.state_cols)}"
+            )
+        bad = {k: v for k, v in self.identity.items() if v != 0}
+        if bad:
+            raise ValueError(
+                "a linear Decomposable's merge is elementwise addition, "
+                f"so its identity must be the additive zero; got {bad}"
+            )
+
+
+# Registry of known-linear Decomposables: the coded-redundancy property
+# suite (tests/test_coded.py) sweeps every entry, asserting that any
+# k-subset of n coded partials reconstructs the merged state exactly
+# (ints) / within tolerance (floats).  Users may register their own.
+LINEAR_DECOMPOSABLES: Dict[str, Decomposable] = {}
+
+
+def register_linear(name: str, dec: Decomposable) -> Decomposable:
+    """Register a linear Decomposable exemplar (validates the flag)."""
+    if not dec.linear:
+        raise ValueError(f"{name!r} is not declared linear=True")
+    LINEAR_DECOMPOSABLES[name] = dec
+    return dec
+
+
+# -- builtin linear exemplars (sum / count / moment histograms) -------------
+
+def _vecsum_seed(cols):
+    return {"s": cols["v"]}
+
+
+def _vecsum_merge(a, b):
+    return {"s": a["s"] + b["s"]}
+
+
+def _countsum_seed(cols):
+    import jax.numpy as jnp
+
+    return {"cnt": jnp.ones_like(cols["v"]), "s": cols["v"]}
+
+
+def _countsum_merge(a, b):
+    return {"cnt": a["cnt"] + b["cnt"], "s": a["s"] + b["s"]}
+
+
+def _countsum_finalize(cols):
+    import jax.numpy as jnp
+
+    return {"mean": cols["s"] / jnp.maximum(cols["cnt"], 1)}
+
+
+def _moments_seed(cols):
+    import jax.numpy as jnp
+
+    return {
+        "cnt": jnp.ones_like(cols["v"]),
+        "s1": cols["v"],
+        "s2": cols["v"] * cols["v"],
+    }
+
+
+def _moments_merge(a, b):
+    return {k: a[k] + b[k] for k in ("cnt", "s1", "s2")}
+
+
+def _moments_finalize(cols):
+    import jax.numpy as jnp
+
+    c = jnp.maximum(cols["cnt"], 1)
+    m = cols["s1"] / c
+    return {"var": cols["s2"] / c - m * m}
+
+
+def _intsum_seed(cols):
+    return {"t": cols["v"]}
+
+
+def _intsum_merge(a, b):
+    return {"t": a["t"] + b["t"]}
+
+
+register_linear("vecsum", Decomposable(
+    seed=_vecsum_seed, merge=_vecsum_merge, state_cols=["s"],
+    out_fields=[("s", ColumnType.FLOAT32)],
+    state_fields=[("s", ColumnType.FLOAT32)],
+    linear=True, identity={"s": 0},
+))
+register_linear("countsum", Decomposable(
+    seed=_countsum_seed, merge=_countsum_merge, state_cols=["cnt", "s"],
+    out_fields=[("mean", ColumnType.FLOAT32)],
+    state_fields=[
+        ("cnt", ColumnType.FLOAT32), ("s", ColumnType.FLOAT32),
+    ],
+    finalize=_countsum_finalize,
+    linear=True, identity={"cnt": 0, "s": 0},
+))
+register_linear("moments", Decomposable(
+    seed=_moments_seed, merge=_moments_merge,
+    state_cols=["cnt", "s1", "s2"],
+    out_fields=[("var", ColumnType.FLOAT32)],
+    state_fields=[
+        ("cnt", ColumnType.FLOAT32), ("s1", ColumnType.FLOAT32),
+        ("s2", ColumnType.FLOAT32),
+    ],
+    finalize=_moments_finalize,
+    linear=True, identity={"cnt": 0, "s1": 0, "s2": 0},
+))
+register_linear("intsum", Decomposable(
+    seed=_intsum_seed, merge=_intsum_merge,
+    state_cols=["t"],
+    out_fields=[("t", ColumnType.INT32)],
+    state_fields=[("t", ColumnType.INT32)],
+    linear=True, identity={"t": 0},
+))

@@ -615,10 +615,17 @@ class LocalJobSubmission:
         """Absorb worker span/counter batches into the driver's event
         log (clock-offset corrected) — the cluster-wide trace merge.
         Best-effort: a telemetry hiccup must never fail a job that
-        already completed."""
+        already completed.  Also the shared-quarantine exchange point:
+        the driver ships its scheduler's local failure deltas through
+        the same channel and folds any peer driver's deltas into its
+        own blacklist (multihost quarantine, ``obs.gang``)."""
         try:
+            from dryad_tpu.obs.gang import ship_failure_deltas
+
+            ship_failure_deltas(self._cp, self.scheduler, self.events)
             return self._cp.drain_telemetry(
-                self.n, self._telemetry_state, self.events
+                self.n, self._telemetry_state, self.events,
+                scheduler=self.scheduler,
             )
         except Exception as e:  # noqa: BLE001 — observability only
             log.warning("worker telemetry drain failed: %s", e)
@@ -634,6 +641,7 @@ class LocalJobSubmission:
         query,
         nparts: Optional[int] = None,
         speculation: bool = True,
+        coded: Optional[bool] = None,
     ) -> Dict[str, np.ndarray]:
         """Run a partition-local plan as ``nparts`` INDEPENDENT vertex
         tasks — the reference's execution model (one re-executable
@@ -656,6 +664,16 @@ class LocalJobSubmission:
         plans run as one gang-scheduled SPMD program via
         :meth:`submit`, where lockstep collectives make mid-program
         speculation meaningless.
+
+        **Coded redundancy** (``dryad_tpu.redundancy``): when the
+        terminal partial's combiner is LINEAR (sum/count/mean, or a
+        ``Decomposable(linear=True)``), the job runs as k systematic +
+        r parity CODED vertices instead — any k of the k+r completions
+        reconstruct the stage output (exactly for integer
+        accumulators), so a straggler needs no identification and a
+        killed vertex no re-execution.  ``coded=None`` follows
+        ``config.coded_redundancy``; True forces it (raising if the
+        plan is ineligible); False keeps the duplicate path.
         """
         from dryad_tpu.cluster.interfaces import ProcessState as PS
         from dryad_tpu.plan.lower import lower
@@ -717,6 +735,28 @@ class LocalJobSubmission:
             overrides = overrides[1]
         query = run_query
         nparts = nparts or self._auto_fanout(query)
+        if merge is not None and overrides is None:
+            from dryad_tpu.redundancy import policy as coded_policy
+
+            decision = coded_policy.decide(
+                query, merge, query.ctx.config, nparts, requested=coded,
+            )
+            if decision.apply:
+                return self._submit_coded(query, merge, nparts, decision)
+            if coded is True:
+                raise ValueError(
+                    f"coded submission requested but the plan is "
+                    f"ineligible: {decision.reason}"
+                )
+            if coded is None and query.ctx.config.coded_redundancy:
+                self.events.emit(
+                    "coded_fallback", reason=decision.reason,
+                )
+        elif coded is True:
+            raise ValueError(
+                "coded submission requires a terminal linear partial "
+                "aggregation over unrouted inputs — use coded=None/False"
+            )
         self._seq += 1
         seq = self._seq
         job_dir = os.path.join(self.root, self.job_id, f"r{seq}")
@@ -950,6 +990,321 @@ class LocalJobSubmission:
                 rows=len(next(iter(table.values()), [])),
             )
         return table
+
+    # -- coded k-of-n vertex execution (dryad_tpu.redundancy) ----------------
+    def _submit_coded(self, query, merge, nparts, decision):
+        """Run a qualifying partial aggregation as k systematic + r
+        parity CODED vertices (``redundancy.coding``): ANY k of the
+        k + r coded completions reconstruct the merged stage output
+        (``redundancy.reconstruct`` — bit-exact for integer
+        accumulators), so
+
+        - spares launch on the coarse floor trigger
+          (``exec.stats.spare_threshold``) — coding needs no straggler
+          IDENTIFICATION, only a suspicion that up to r vertices are
+          slow — and immediately on the first vertex failure (failure
+          masking with zero re-executions);
+        - at k completions the rest are canceled and completed-but-
+          unused coded output is accounted as ``coded_waste_bytes``;
+        - a coded vertex is relaunched ONLY if failures make k
+          completions impossible (fewer than k live+done vertices) —
+          the bounded fallback to re-execution semantics.
+        """
+        from dryad_tpu.cluster.interfaces import ProcessState as PS
+        from dryad_tpu.redundancy.coding import CodedSpec
+        from dryad_tpu.redundancy.reconstruct import merge_coded
+
+        cfg = query.ctx.config
+        spec = CodedSpec(int(nparts), int(decision.r))
+        self._seq += 1
+        seq = self._seq
+        os.makedirs(
+            os.path.join(self.root, self.job_id, f"r{seq}"), exist_ok=True
+        )
+        pkg_rel = f"{self.job_id}/r{seq}/job.pkg"
+        self._register_strings(query)
+        pack_query(query, os.path.join(self.root, pkg_rel))
+        result_rel = f"{self.job_id}/r{seq}/result"
+        self.events.emit(
+            "coded_job_start", seq=seq, k=spec.k, n=spec.n, r=spec.r,
+            agg=decision.kind,
+        )
+        t_job0 = time.monotonic()
+        stats = StageStatistics(floor_ratio=cfg.straggler_floor_ratio)
+        run_t0: Dict[int, float] = {}
+        retry_policy = RetryPolicy(
+            backoff_base=cfg.retry_backoff_base,
+            backoff_max=cfg.retry_backoff_max,
+            jitter=cfg.retry_jitter, seed=cfg.retry_seed,
+        )
+
+        def make_proc(j: int, attempt: int) -> ClusterProcess:
+            cmd = {
+                "kind": "runcoded", "package": pkg_rel, "coded": j,
+                "parts": spec.support(j), "coeffs": spec.coeffs(j),
+                "nparts": spec.k, "keys": list(decision.key_cols),
+                "state": list(decision.state_cols),
+                "result_dir": result_rel, "seq": seq,
+                "cseq": self._next_cseq(),
+            }
+            affs = (
+                [Affinity(f"worker{j % self.n}")]
+                if not spec.is_parity(j) and attempt == 0 else []
+            )
+            p = ClusterProcess(
+                self._placed_round_trip(cmd),
+                name=f"coded{seq}-c{j}-a{attempt}", affinities=affs,
+            )
+
+            def watch(pr: ClusterProcess) -> None:
+                if pr.state is PS.RUNNING:
+                    run_t0[pr.id] = time.monotonic()
+
+            p.on_state(watch)
+            return p
+
+        terminal = (PS.COMPLETED, PS.FAILED, PS.CANCELED)
+        tasks: Dict[int, Dict] = {}
+        for j in range(spec.k):
+            tasks[j] = {
+                "procs": [make_proc(j, 0)], "attempts": [], "seen": set(),
+                "retry_at": None,
+            }
+        self.scheduler.schedule_batch([tasks[j]["procs"][0]
+                                       for j in range(spec.k)])
+        completed: Dict[int, ClusterProcess] = {}
+        parity_launched = False
+        # parity support spans all k shards, so budget parity waves at
+        # full-stage cost on top of the systematic waves
+        waves = -(-spec.n // max(self.n, 1)) + 1
+        deadline = time.monotonic() + self.timeout * waves + 30.0
+
+        def all_failed(t) -> bool:
+            return bool(t["procs"]) and all(
+                p.state in (PS.FAILED, PS.CANCELED) for p in t["procs"]
+            )
+
+        def launch_parity(trigger: str, threshold) -> None:
+            nonlocal parity_launched
+            parity_launched = True
+            spares = []
+            for j in range(spec.k, spec.n):
+                tasks[j] = {
+                    "procs": [make_proc(j, 0)], "attempts": [],
+                    "seen": set(), "retry_at": None,
+                }
+                spares.append(tasks[j]["procs"][0])
+            self.scheduler.schedule_batch(spares)
+            self.events.emit(
+                "coded_launch", seq=seq, k=spec.k, n=spec.n, r=spec.r,
+                trigger=trigger,
+                threshold=round(threshold, 4) if threshold else None,
+            )
+
+        try:
+            while len(completed) < spec.k:
+                self._reap_dead_workers()
+                now = time.monotonic()
+                for j in sorted(tasks):
+                    t = tasks[j]
+                    if j in completed:
+                        continue
+                    winner = next(
+                        (p for p in t["procs"] if p.state is PS.COMPLETED),
+                        None,
+                    )
+                    if winner is not None:
+                        dur = now - run_t0.get(winner.id, now)
+                        stats.record(dur)
+                        completed[j] = winner
+                        self.events.emit(
+                            "coded_task_complete", seq=seq, coded=j,
+                            parity=spec.is_parity(j),
+                            seconds=round(dur, 4),
+                            computer=winner.computer,
+                        )
+                        continue
+                    if all_failed(t):
+                        for p in t["procs"]:
+                            if (
+                                p.state is PS.FAILED
+                                and p.error is not None
+                                and p.id not in t["seen"]
+                            ):
+                                t["seen"].add(p.id)
+                                kind = classify(
+                                    p.error, t["attempts"],
+                                    computer=p.computer,
+                                )
+                                t["attempts"].append(Attempt(
+                                    number=len(t["attempts"]) + 1,
+                                    error_type=type(p.error).__name__,
+                                    error=str(p.error), kind=kind.value,
+                                    computer=p.computer,
+                                ))
+                                self.events.emit(
+                                    "coded_task_failed", seq=seq,
+                                    coded=j, parity=spec.is_parity(j),
+                                    error=str(p.error)[:200],
+                                    failure_kind=kind.value,
+                                )
+                # failure masking: the FIRST failure launches all r
+                # spares at once — parity covers ANY r losses, so
+                # there is nothing to target
+                failed_now = [j for j, t in tasks.items()
+                              if j not in completed and all_failed(t)]
+                if failed_now and not parity_launched:
+                    launch_parity("failure", None)
+                # straggler masking: the coarse spare trigger (no
+                # per-task identification needed — see spare_threshold)
+                if not parity_launched:
+                    thr = stats.spare_threshold()
+                    if thr is not None and any(
+                        p.state is PS.RUNNING
+                        and now - run_t0.get(p.id, now) > thr
+                        for j, t in tasks.items() if j not in completed
+                        for p in t["procs"]
+                    ):
+                        launch_parity("straggler", thr)
+                # coverage shortfall: relaunch dead vertices only when
+                # k completions are otherwise impossible
+                live = sum(
+                    1 for j, t in tasks.items()
+                    if j not in completed and not all_failed(t)
+                )
+                shortfall = spec.k - len(completed) - live
+                if shortfall > 0:
+                    for j in failed_now:
+                        if shortfall <= 0:
+                            break
+                        t = tasks[j]
+                        if len(t["procs"]) >= retry_policy.max_attempts:
+                            errs = "; ".join(
+                                str(p.error)
+                                for p in t["procs"] if p.error
+                            )
+                            raise JobFailedError(
+                                f"coded vertex {j} failed on all "
+                                f"{len(t['procs'])} attempts and the "
+                                f"remaining coded vertices cannot reach "
+                                f"k={spec.k} completions: {errs}",
+                                stage=f"coded{j}", attempts=t["attempts"],
+                            )
+                        if t["retry_at"] is None:
+                            t["retry_at"] = now + retry_policy.backoff(
+                                f"coded{j}", len(t["attempts"]) or 1
+                            )
+                        if now >= t["retry_at"]:
+                            t["retry_at"] = None
+                            np_ = make_proc(j, len(t["procs"]))
+                            t["procs"].append(np_)
+                            self.scheduler.schedule(np_)
+                            shortfall -= 1
+                            self.events.emit(
+                                "coded_retry", seq=seq, coded=j,
+                                attempt=len(t["procs"]),
+                            )
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"coded job timed out with "
+                        f"{len(completed)}/{spec.k} completions"
+                    )
+                if len(completed) < spec.k:
+                    time.sleep(0.05)
+        finally:
+            canceled = 0
+            for t in tasks.values():
+                for p in t["procs"]:
+                    if p.state not in terminal:
+                        self.scheduler.cancel(p)
+                        canceled += 1
+            if canceled:
+                self.events.emit(
+                    "coded_cancel", seq=seq, canceled=canceled,
+                )
+        # prefer systematic rows among the completions (identity
+        # weights decode fastest and keep float paths exact); the
+        # result is subset-independent for integer states anyway
+        used = sorted(completed)[: spec.k]
+        waste = 0
+        unused = []
+        for j in sorted(tasks):
+            if j in used:
+                continue
+            path = os.path.join(self.root, result_rel, f"cpart{j}.dpf")
+            if os.path.exists(path):
+                waste += os.path.getsize(path)
+                unused.append(j)
+        self.events.emit(
+            "coded_waste_bytes", seq=seq, bytes=waste, unused=unused,
+        )
+        t_rec0 = time.monotonic()
+        tables = [
+            parse_partition_bytes(
+                self._client.read_whole_file(
+                    f"{result_rel}/cpart{j}.dpf", compress=True
+                )
+            )
+            for j in used
+        ]
+        merged, info = merge_coded(
+            [spec.row(j) for j in used], tables,
+            list(decision.key_cols), list(decision.state_cols),
+            max_amplification=cfg.coded_max_amplification,
+        )
+        self.events.emit(
+            "coded_reconstruct", seq=seq, used=used,
+            parity_used=sum(1 for j in used if spec.is_parity(j)),
+            exact=info["exact"],
+            amplification=round(float(info["amplification"]), 4),
+            seconds=round(time.monotonic() - t_rec0, 4),
+        )
+        self.events.emit(
+            "coded_job_complete", seq=seq,
+            seconds=round(time.monotonic() - t_job0, 4),
+        )
+        self._collect_telemetry()
+        return self._finalize_coded(merged, merge)
+
+    def _finalize_coded(self, merged, merge):
+        """Produce the user-facing table from the reconstructed merged
+        state columns (the coded twin of :meth:`_merge_partials`; keys
+        arrive in sorted order from the union alignment, which is
+        completion-subset independent)."""
+        kind, keys, plan_or_dec, out_schema = merge
+        result: Dict[str, np.ndarray] = {
+            k: np.asarray(merged[k]) for k in keys
+        }
+        if kind == "group_dec":
+            dec = plan_or_dec
+            full = dict(result)
+            # states narrow back to their declared dtypes BEFORE
+            # finalize so user fns see what the uncoded path feeds them
+            for name, ct in dec.state_fields:
+                full[name] = np.asarray(merged[name]).astype(
+                    ct.numpy_dtype
+                )
+            if dec.finalize is not None:
+                full = {
+                    k: np.asarray(v) for k, v in dec.finalize(full).items()
+                }
+            for name, _ct in dec.out_fields:
+                dt = out_schema.field(name).ctype.numpy_dtype
+                result[name] = np.asarray(full[name]).astype(dt)
+            return result
+        plan = plan_or_dec
+        for out, op, pcols in plan:
+            if op == "mean":
+                s = np.asarray(merged[pcols[0]], np.float64)
+                c = np.maximum(
+                    np.asarray(merged[pcols[1]], np.float64), 1.0
+                )
+                vals = s / c
+            else:  # sum / count (linear by policy)
+                vals = merged[pcols[0]]
+            dt = out_schema.field(out).ctype.numpy_dtype
+            result[out] = np.asarray(vals).astype(dt)
+        return result
 
     # row-local node kinds that preserve key VALUES between an input
     # binding and the routed operator (where removes rows, project
@@ -1388,18 +1743,33 @@ class LocalJobSubmission:
         )
         return batch.to_numpy(query.schema, dictionary)
 
-    def inject_fault(self, stage: Optional[str], count: int = 1) -> None:
-        """Broadcast a fault-injection command to every worker (remote
-        SetFakeVertexFailure; ``stage=None`` clears).  All gang members
-        must fault together — a partial fault would strand the rest in a
-        collective."""
+    def inject_fault(
+        self,
+        stage: Optional[str],
+        count: int = 1,
+        plan: Optional[Dict] = None,
+        workers: Optional[List[int]] = None,
+    ) -> None:
+        """Send a fault-injection command to workers (remote
+        SetFakeVertexFailure; ``stage=None`` with no plan clears).
+
+        ``plan``: a seeded :class:`exec.faults.FaultPlan` as a dict —
+        including ``worker_kill_prob`` process kills, the gang chaos
+        scenario.  ``workers``: target subset (default all).  For gang
+        SPMD jobs a *stage fault* must reach EVERY member (a partial
+        fault strands the rest in a collective); partial targeting is
+        for vertex/coded tasks and for kill scenarios, where stranding
+        the peers mid-collective is exactly the point."""
         self._sync_membership()
         cmd = {
             "kind": "set_fault", "stage": stage, "count": count,
             "cseq": self._next_cseq(),
         }
+        if plan is not None:
+            cmd["plan"] = plan
+        targets = list(workers) if workers is not None else list(range(self.n))
         procs = []
-        for i in range(self.n):
+        for i in targets:
             p = ClusterProcess(
                 self._command_round_trip(i, cmd),
                 name=f"fault-w{i}",
@@ -1407,7 +1777,7 @@ class LocalJobSubmission:
             )
             self.scheduler.schedule(p)
             procs.append(p)
-        for i, p in enumerate(procs):
+        for i, p in zip(targets, procs):
             if not p.wait(30.0) or p.state is not ProcessState.COMPLETED:
                 raise RuntimeError(f"fault injection on worker {i} failed: {p.error}")
 

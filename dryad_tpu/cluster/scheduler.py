@@ -78,6 +78,10 @@ class LocalScheduler(Scheduler):
         self._failures: Dict[str, FailureWindow] = {}
         self._quarantine: Dict[str, float] = {}  # name -> cooldown end
         self._probation: Set[str] = set()
+        # LOCAL failures not yet exported to peer drivers (multihost
+        # shared quarantine, obs.gang.ship_failure_deltas); remote
+        # absorptions never land here, so deltas can't echo.
+        self._unshipped: Dict[str, int] = {}
         self._stop = False
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, name="dryad-scheduler", daemon=True
@@ -104,9 +108,11 @@ class LocalScheduler(Scheduler):
             self._computers.pop(name, None)
             # a re-added computer of the same name is a fresh worker:
             # its predecessor's failure history must not follow it
+            # (locally or through the shared-quarantine channel)
             self._failures.pop(name, None)
             self._quarantine.pop(name, None)
             self._probation.discard(name)
+            self._unshipped.pop(name, None)
             # Fail fast queued processes whose HARD affinity named the
             # removed computer and can no longer be satisfied by any
             # remaining member — _eligible would never match a missing
@@ -150,11 +156,13 @@ class LocalScheduler(Scheduler):
         with self._lock:
             self._note_failure_locked(computer)
 
-    def _note_failure_locked(self, name: str) -> None:
+    def _note_failure_locked(self, name: str, remote: bool = False) -> None:
         now = self._clock()
         count = self._failures.setdefault(
             name, FailureWindow(self.quarantine_window)
         ).record(now)
+        if not remote:
+            self._unshipped[name] = self._unshipped.get(name, 0) + 1
         if name in self._probation:
             # a probation failure proves the cooldown solved nothing
             self._probation.discard(name)
@@ -201,6 +209,34 @@ class LocalScheduler(Scheduler):
         with self._lock:
             return sorted(self._quarantined_now_locked())
 
+    # -- multihost shared quarantine (ROADMAP; GM-global machine failure
+    # counts): every driver in a multi-controller gang ships its LOCAL
+    # failure deltas through the telemetry mailbox channel
+    # (obs.gang.ship_failure_deltas) and folds its peers' deltas into
+    # the same sliding windows, so the whole gang converges on one
+    # blacklist without a central coordinator.
+    def failure_delta(self) -> Dict[str, int]:
+        """Drain the not-yet-shipped LOCAL failure counts (the export
+        half of the shared blacklist; remote absorptions are excluded
+        so a delta can never echo back and forth)."""
+        with self._lock:
+            out = {k: v for k, v in self._unshipped.items() if v > 0}
+            self._unshipped.clear()
+            return out
+
+    def absorb_remote_failures(
+        self, deltas: Dict[str, int], source=None
+    ) -> None:
+        """Fold a peer driver's failure deltas into this scheduler's
+        windows/quarantine WITHOUT re-exporting them."""
+        with self._lock:
+            for name, n in deltas.items():
+                for _ in range(int(n)):
+                    self._note_failure_locked(name, remote=True)
+        self._emit(
+            "quarantine_absorbed", source=source, deltas=dict(deltas),
+        )
+
     def computers(self) -> List[Computer]:
         with self._lock:
             return list(self._computers.values())
@@ -221,6 +257,17 @@ class LocalScheduler(Scheduler):
         with self._lock:
             process._transition(ProcessState.QUEUED)
             self._queue.append(_Entry(process, self._clock()))
+            self._lock.notify_all()
+
+    def schedule_batch(self, processes: List[ClusterProcess]) -> None:
+        """Enqueue several processes atomically (one lock round, one
+        wakeup) — the coded-spare launch path enqueues all r parity
+        vertices at once so they contend for slots as one decision."""
+        with self._lock:
+            now = self._clock()
+            for p in processes:
+                p._transition(ProcessState.QUEUED)
+                self._queue.append(_Entry(p, now))
             self._lock.notify_all()
 
     def cancel(self, process: ClusterProcess) -> None:

@@ -107,6 +107,45 @@ def _run_part(cmd: Dict, args, client, pkgs: _PackageCache) -> Dict:
     return {"state": "completed", "parts": [part]}
 
 
+def _run_coded(cmd: Dict, args, client, pkgs: _PackageCache) -> Dict:
+    """Execute ONE CODED vertex (``dryad_tpu.redundancy``): run the
+    partial plan over each shard in the vertex's support, linearly
+    combine the partial tables with the generator coefficients
+    (``exec.partial.coded_combine`` — exact int64 for integer states),
+    and write the coded partial as ``cpart<j>.dpf``.  A systematic
+    vertex (support of one shard, coefficient 1) does exactly one
+    shard's work; a parity vertex pays the full-support redundancy
+    work that buys any-k-of-n reconstruction."""
+    from dryad_tpu.columnar.io import write_partition_file
+    from dryad_tpu.exec.jobpackage import slice_binding
+    from dryad_tpu.exec.partial import coded_combine
+
+    q, pristine = pkgs.load(cmd["package"], client)
+    nparts = int(cmd["nparts"])
+    tables = []
+    for part in cmd["parts"]:
+        for nid, binding in pristine.items():
+            q.ctx._bindings[nid] = slice_binding(
+                binding, int(part), nparts
+            )
+        # stale fingerprints would restore another part's checkpoint
+        q.ctx._binding_fp_cache.clear()
+        batch = q.ctx._execute_device(q)
+        tables.append(batch.to_numpy(q.schema, q.ctx.dictionary))
+    combined = coded_combine(
+        tables, [int(c) for c in cmd["coeffs"]],
+        list(cmd["keys"]), list(cmd["state"]),
+    )
+    out_dir = os.path.join(args.root, cmd["result_dir"])
+    os.makedirs(out_dir, exist_ok=True)
+    j = int(cmd["coded"])
+    final = os.path.join(out_dir, f"cpart{j}.dpf")
+    tmp = f"{final}.w{args.pid}.tmp"
+    write_partition_file(tmp, combined)
+    os.replace(tmp, final)
+    return {"state": "completed", "coded": [j]}
+
+
 def _absorb_ctx_events(wlog, ctx) -> None:
     """Move the job context's engine events (stage spans, xla_compile,
     stream events) into the worker's telemetry log so they ship to the
@@ -256,12 +295,17 @@ def main(argv=None) -> int:
             return 0
         if cmd["kind"] == "set_fault":
             # Remote fault injection (SetFakeVertexFailure over the
-            # command mailbox): must reach EVERY worker — a fault raised
-            # in only some gang members would strand the others in a
-            # collective, so the driver broadcasts this to all.
+            # command mailbox).  Stage faults must reach EVERY gang
+            # member (a fault raised in only some would strand the
+            # others in a collective); a seeded FaultPlan — including
+            # worker_kill_prob process kills, the mid-collective-death
+            # chaos scenario — may target a worker subset, where
+            # stranding the peers is exactly what is under test.
             from dryad_tpu.exec import faults
 
-            if cmd.get("stage"):
+            if cmd.get("plan"):
+                faults.install_plan(faults.FaultPlan(**cmd["plan"]))
+            elif cmd.get("stage"):
                 faults.set_fake_stage_failure(
                     cmd["stage"], int(cmd.get("count", 1))
                 )
@@ -285,17 +329,24 @@ def main(argv=None) -> int:
                 json.dumps({"state": "delay_set", "cseq": cseq}).encode(),
             )
             continue
-        if cmd["kind"] in ("run", "runpart"):
+        if cmd["kind"] in ("run", "runpart", "runcoded"):
             try:
                 with wtracer.span(
                     cmd["kind"], cat="worker", seq=cmd.get("seq"),
-                    part=cmd.get("part"),
+                    part=cmd.get("part", cmd.get("coded")),
                 ):
-                    if cmd["kind"] == "runpart":
+                    if cmd["kind"] in ("runpart", "runcoded"):
+                        # injected straggler applies to coded vertices
+                        # too, so coded-vs-duplicate comparisons stall
+                        # the same way
                         if delay["count"] > 0:
                             delay["count"] -= 1
                             time.sleep(delay["seconds"])
-                        status = _run_part(cmd, args, client, pkgs)
+                        status = (
+                            _run_part(cmd, args, client, pkgs)
+                            if cmd["kind"] == "runpart"
+                            else _run_coded(cmd, args, client, pkgs)
+                        )
                         _absorb_ctx_events(
                             wlog,
                             pkgs.query.ctx if pkgs.query is not None

@@ -113,6 +113,22 @@ EVENT_KINDS: Dict[str, str] = {
     "vertex_routed": "driver routed inputs for a shuffle-bearing plan",
     "vertex_partials_merged": "driver merged per-vertex partials; rows",
     "assemble_fetch": "result partitions fetched; wire/raw bytes",
+    # -- coded stage redundancy (cluster.localjob / redundancy) -----------
+    "coded_job_start": "coded k-of-n stage began; seq/k/n/r/kind",
+    "coded_launch": "parity spares launched; trigger/threshold/spares",
+    "coded_task_complete": "one coded vertex done; coded/parity/seconds",
+    "coded_task_failed": "one coded vertex failed; coded/error",
+    "coded_retry": "coded vertex relaunched (coverage shortfall); coded",
+    "coded_cancel": "unneeded coded vertices canceled at k completions",
+    "coded_reconstruct": "output reconstructed; used/parity_used/exact",
+    "coded_waste_bytes": "completed-but-unused coded output bytes",
+    "coded_job_complete": "coded stage finished; seq/seconds",
+    "coded_fallback": "stage ineligible for coding; reason",
+    # -- gang chaos (exec.faults via cluster.worker set_fault) ------------
+    "worker_killed_injected": "seeded chaos kill: process exits mid-stage",
+    # -- multihost shared quarantine (obs.gang / cluster.scheduler) -------
+    "quarantine_delta": "local failure deltas shipped to peer drivers",
+    "quarantine_absorbed": "peer failure delta folded into local blacklist",
 }
 
 

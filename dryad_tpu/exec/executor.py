@@ -23,6 +23,7 @@ Fault tolerance keeps the reference *semantics* in TPU form:
 from __future__ import annotations
 
 import contextlib
+import os
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -923,6 +924,18 @@ class GraphExecutor:
             t0 = time.time()
             try:
                 faults.registry.maybe_fail(stage.name)
+                if faults.registry.maybe_kill(stage.name):
+                    # Gang chaos (FaultPlan.worker_kill_prob, installed
+                    # on workers via the set_fault mailbox command):
+                    # this PROCESS dies mid-stage, leaving gang peers
+                    # inside the stage's collectives — the
+                    # mid-collective-death scenario the driver's
+                    # auto-recovery (rebuild_gang) must absorb.
+                    self.events.emit(
+                        "worker_killed_injected", stage=stage.id,
+                        name=stage.name,
+                    )
+                    os._exit(113)
                 inj_delay = faults.registry.maybe_delay(stage.name)
                 if inj_delay:
                     self.events.emit(

@@ -56,7 +56,15 @@ class FaultPlan:
       slow-stage injection (the slow-worker scenario);
     - ``checkpoint_corruption_prob``: probability that a just-saved
       checkpoint gets payload bytes flipped (silent bit rot the CRC
-      verification must catch).
+      verification must catch);
+    - ``worker_kill_prob`` / ``max_worker_kills``: per-stage-attempt
+      probability that the hosting PROCESS dies outright
+      (``os._exit``) — the gang chaos scenario: a worker dying inside
+      a stage leaves its peers stranded in the stage's collectives
+      (mid-collective death).  Only install kill-bearing plans on
+      WORKER processes (via the ``set_fault`` mailbox command,
+      ``cluster.worker``); a driver-side plan with kills would kill
+      the test/driver process itself.
     """
 
     seed: int = 0
@@ -67,6 +75,8 @@ class FaultPlan:
     stage_delay_seconds: float = 0.0
     checkpoint_corruption_prob: float = 0.0
     max_checkpoint_corruptions: int = 1
+    worker_kill_prob: float = 0.0
+    max_worker_kills: int = 1
 
 
 class _Registry:
@@ -79,6 +89,7 @@ class _Registry:
         self._plan_rng = random.Random(0)
         self._plan_failures: Dict[str, int] = {}
         self._plan_corruptions = 0
+        self._plan_kills = 0
         self._corrupt_rng = random.Random(0xC0FFEE)  # count-based mode
 
     # -- count-based knobs (the remote-controllable switches) ----------------
@@ -111,6 +122,7 @@ class _Registry:
             self._plan_rng = random.Random(plan.seed if plan else 0)
             self._plan_failures.clear()
             self._plan_corruptions = 0
+            self._plan_kills = 0
 
     def clear(self) -> None:
         with self._lock:
@@ -120,6 +132,7 @@ class _Registry:
             self._plan = None
             self._plan_failures.clear()
             self._plan_corruptions = 0
+            self._plan_kills = 0
 
     # -- consultation points -------------------------------------------------
     def _plan_matches(self, tokens: set) -> bool:
@@ -165,6 +178,25 @@ class _Registry:
                     f"chaos(seed={p.seed}): injected failure #{k} for "
                     f"stage {stage_name!r}"
                 )
+
+    def maybe_kill(self, stage_name: str) -> bool:
+        """Seeded gang-chaos draw: True when the installed plan says the
+        hosting PROCESS should die before executing this stage attempt
+        (the caller ``os._exit``s).  Returns False unless a plan with
+        ``worker_kill_prob > 0`` is installed — so in-process chaos
+        suites (which never set it) can never kill the test runner."""
+        with self._lock:
+            p = self._plan
+            if p is None or p.worker_kill_prob <= 0.0:
+                return False
+            if self._plan_kills >= p.max_worker_kills:
+                return False
+            if not self._plan_matches(set(stage_name.split("+"))):
+                return False
+            if self._plan_rng.random() < p.worker_kill_prob:
+                self._plan_kills += 1
+                return True
+        return False
 
     def maybe_delay(self, stage_name: str) -> float:
         """Seconds this stage attempt should stall (0.0 = no delay)."""

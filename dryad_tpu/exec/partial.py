@@ -19,6 +19,20 @@ MERGEABLE_AGGS = frozenset(
     {"sum", "count", "min", "max", "mean", "any", "all", "first"}
 )
 
+# The LINEAR subset: partials that merge by elementwise ADDITION over
+# their state columns ("mean" decomposes to sum + count, both linear).
+# Only these qualify for coded stage redundancy (``redundancy.policy``):
+# an integer linear combination of linear partials is itself a valid
+# partial, so any k of n coded vertices reconstruct the stage output.
+# min/max/any/all are lattice ops (idempotent, not invertible) and
+# "first" is order-dependent — none of them form a vector space.
+LINEAR_AGGS = frozenset({"sum", "count", "mean"})
+
+
+def plan_is_linear(plan) -> bool:
+    """True when every merge-plan row is a linear aggregate."""
+    return all(op in LINEAR_AGGS for _out, op, _pcols in plan)
+
 
 def partial_plan(agg_list):
     """Decompose builtin aggs into partial specs plus the merge plan.
@@ -55,6 +69,75 @@ def merge_agg_spec(plan):
         else:  # pragma: no cover - guarded by MERGEABLE_AGGS
             raise AssertionError(f"unmergeable agg {op}")
     return spec
+
+
+# -- coded combine (redundancy/: k-of-n partial aggregates) -----------------
+
+def align_partials(tables, key_cols, state_cols):
+    """Align partial STATE tables onto the sorted union of their keys.
+
+    Returns ``(key_arrays, mats)`` where ``key_arrays`` maps each key
+    column to its union array (ascending tuple order — deterministic
+    regardless of which tables are present) and ``mats`` maps each
+    state column to a ``(len(tables), n_keys)`` matrix whose row i is
+    table i's values scattered onto the union (missing keys are the
+    additive identity 0 — the linearity contract).  Integer/bool state
+    columns accumulate in exact Python ints (object dtype) so the
+    coded decode can stay bit-exact; floats accumulate in float64.
+    """
+    import numpy as np
+
+    keysets = []
+    for t in tables:
+        if key_cols:
+            ks = list(zip(*[np.asarray(t[k]).tolist() for k in key_cols]))
+        else:
+            n = len(np.asarray(t[state_cols[0]])) if state_cols else 0
+            ks = [()] * n
+        keysets.append(ks)
+    union = sorted(set().union(*keysets)) if keysets else []
+    index = {key: i for i, key in enumerate(union)}
+    key_arrays = {}
+    for pos, kname in enumerate(key_cols):
+        dt = np.asarray(tables[0][kname]).dtype if tables else None
+        key_arrays[kname] = np.asarray([u[pos] for u in union], dtype=dt)
+    mats = {}
+    for c in state_cols:
+        dt = np.asarray(tables[0][c]).dtype if tables else np.dtype(float)
+        exact = dt.kind in "iub"
+        acc_dt = object if exact else np.float64
+        mat = np.zeros((len(tables), len(union)), dtype=acc_dt)
+        for ti, (t, ks) in enumerate(zip(tables, keysets)):
+            vals = np.asarray(t[c])
+            idx = [index[key] for key in ks]
+            if exact:
+                for p, v in zip(idx, vals.tolist()):
+                    mat[ti, p] += v  # duplicate keys merge additively
+            else:
+                np.add.at(mat[ti], idx, vals.astype(np.float64))
+        mats[c] = mat
+    return key_arrays, mats
+
+
+def coded_combine(tables, coeffs, key_cols, state_cols):
+    """The worker-side ENCODE step: one coded partial table as the
+    integer-weighted sum of its support partials, keyed on the sorted
+    union of their keys.  Integer states come back exact int64; float
+    states come back float64 (narrowing happens only at finalize).
+    """
+    import numpy as np
+
+    key_arrays, mats = align_partials(tables, key_cols, state_cols)
+    out = dict(key_arrays)
+    for c, mat in mats.items():
+        if mat.dtype == object:
+            w = np.asarray([int(x) for x in coeffs], dtype=object)
+            comb = (w[:, None] * mat).sum(axis=0) if len(mat) else mat.sum(0)
+            out[c] = np.asarray([int(v) for v in comb], dtype=np.int64)
+        else:
+            w = np.asarray(coeffs, np.float64)
+            out[c] = w @ mat
+    return out
 
 
 _PHYS_SUFFIXES = ("#h0", "#h1", "#r0", "#r1")

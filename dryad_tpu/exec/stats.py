@@ -18,14 +18,26 @@ from typing import List, Optional
 TRIM_FRACTION = 0.2  # reference: top 20% trimmed before fitting
 DEFAULT_SIGMAS = 3.0  # reference: 3-sigma outlier threshold
 MIN_SAMPLES = 3
+# Multiplicative floor on the outlier threshold: with fewer than 4
+# samples the trimmed fit keeps 1-3 points and the variance
+# degenerates toward 0, so mean + 3*sigma collapses onto the mean and
+# flags EVERY subsequent attempt a straggler.  Clamping to
+# floor_ratio x the trimmed mean keeps the model usable from the very
+# first completions (seeded test: tests/test_quarantine.py).
+FLOOR_RATIO = 1.5
 
 
 class StageStatistics:
     """Robust duration model for one stage's attempts."""
 
-    def __init__(self, outlier_sigmas: float = DEFAULT_SIGMAS):
+    def __init__(
+        self,
+        outlier_sigmas: float = DEFAULT_SIGMAS,
+        floor_ratio: float = FLOOR_RATIO,
+    ):
         self.durations: List[float] = []
         self.outlier_sigmas = outlier_sigmas
+        self.floor_ratio = floor_ratio
 
     def record(self, seconds: float) -> None:
         self.durations.append(float(seconds))
@@ -44,12 +56,29 @@ class StageStatistics:
         return m, math.sqrt(var)
 
     def outlier_threshold(self) -> Optional[float]:
-        """Duration beyond which an attempt counts as a straggler."""
+        """Duration beyond which an attempt counts as a straggler,
+        clamped to ``floor_ratio`` x the trimmed mean (see FLOOR_RATIO:
+        the fit degenerates with < 4 samples)."""
         ms = self.mean_std()
         if ms is None:
             return None
         m, s = ms
-        return m + self.outlier_sigmas * s
+        return max(m + self.outlier_sigmas * s, m * self.floor_ratio)
+
+    def spare_threshold(self) -> Optional[float]:
+        """Coarse spare-launch trigger for coded redundancy.
+
+        Duplication must IDENTIFY the straggling attempt, so it waits
+        for the full robust model (>= MIN_SAMPLES completions).  Coded
+        parity covers whichever r vertices are slow — any k completions
+        reconstruct — so it may act on a much weaker signal: from the
+        FIRST completed sample, ``floor_ratio x max(completed)``."""
+        thr = self.outlier_threshold()
+        if thr is not None:
+            return thr
+        if not self.durations:
+            return None
+        return max(self.durations) * self.floor_ratio
 
     def is_outlier(self, seconds: float) -> bool:
         thr = self.outlier_threshold()

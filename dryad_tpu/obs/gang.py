@@ -32,7 +32,7 @@ import json
 import time
 from typing import Any, Dict, List
 
-__all__ = ["ship_telemetry", "drain_telemetry"]
+__all__ = ["ship_telemetry", "drain_telemetry", "ship_failure_deltas"]
 
 
 def ship_telemetry(cp, batch: List[Dict[str, Any]]) -> None:
@@ -46,14 +46,46 @@ def ship_telemetry(cp, batch: List[Dict[str, Any]]) -> None:
     cp._set(f"telemetry/{cp.process_id}/{seq}", body)
 
 
+def ship_failure_deltas(cp, scheduler, events=None) -> int:
+    """The multihost shared-quarantine export (ROADMAP open item):
+    drain the local scheduler's unshipped failure counts and publish
+    them as ``quarantine_delta`` events through the SAME numbered
+    telemetry channel the span batches ride.  Every peer driver that
+    drains the channel folds the deltas into its own scheduler
+    (``drain_telemetry(..., scheduler=)``), so the whole gang converges
+    on one blacklist.  Returns the number of deltas shipped."""
+    deltas = scheduler.failure_delta()
+    if not deltas:
+        return 0
+    now = time.time()
+    batch = []
+    for comp, count in sorted(deltas.items()):
+        if events is not None:
+            events.emit(
+                "quarantine_delta", computer=comp, count=count,
+                src=cp.process_id,
+            )
+        batch.append({
+            "ts": now, "kind": "quarantine_delta", "computer": comp,
+            "count": int(count), "src": cp.process_id,
+        })
+    ship_telemetry(cp, batch)
+    return len(batch)
+
+
 def drain_telemetry(
     cp, n: int, state: Dict[int, Dict[str, Any]], events,
+    scheduler=None,
 ) -> int:
     """Driver side: drain every worker's unread telemetry batches into
     ``events`` (the driver's EventLog) with clock-offset-corrected
     timestamps and a ``worker`` field.  ``state`` persists the
     per-worker read cursor + best offset across calls (the caller owns
-    it).  Returns the number of absorbed events."""
+    it).  ``scheduler``: when given, ``quarantine_delta`` events from
+    OTHER processes fold into its failure accounting (the absorb half
+    of the multihost shared blacklist; own-pid deltas are skipped so a
+    driver never double-counts what it already recorded locally).
+    Returns the number of absorbed events."""
     absorbed = 0
     for i in range(n):
         st = state.setdefault(i, {"seq": 0, "off": None})
@@ -68,6 +100,15 @@ def drain_telemetry(
                 st["off"] = est
             off = st["off"]
             for ev in payload.get("batch", []):
+                if (
+                    scheduler is not None
+                    and ev.get("kind") == "quarantine_delta"
+                    and ev.get("src") != cp.process_id
+                ):
+                    scheduler.absorb_remote_failures(
+                        {ev["computer"]: int(ev.get("count", 1))},
+                        source=ev.get("src"),
+                    )
                 ev = dict(ev, worker=i, clock_offset=round(off, 6))
                 if "ts" in ev:
                     ev["ts"] = ev["ts"] + off

@@ -173,6 +173,11 @@ class JobMetrics:
     quarantines: int = 0
     workers: int = 0  # distinct workers whose telemetry was merged
     spans: int = 0
+    # coded stage redundancy (redundancy/): spare launches, decode
+    # rounds, and completed-but-unused coded output bytes
+    coded_launches: int = 0
+    coded_reconstructs: int = 0
+    coded_waste_bytes: int = 0
 
     @property
     def padding_waste(self) -> float:
@@ -199,6 +204,8 @@ class JobMetrics:
             "padding_waste": round(self.padding_waste, 4),
             "retries": self.retries,
             "quarantines": self.quarantines,
+            "coded_launches": self.coded_launches,
+            "coded_waste_bytes": self.coded_waste_bytes,
         }
 
     # counter names folded from ``metrics`` snapshot events into the
@@ -246,10 +253,16 @@ class JobMetrics:
                 m.spill_rows += int(ev.get("rows", 0) or 0)
             elif kind == "stream_chunk":
                 m.rows_in += int(ev.get("rows", 0) or 0)
-            elif kind in ("stage_failed", "vertex_retry"):
+            elif kind in ("stage_failed", "vertex_retry", "coded_retry"):
                 m.retries += 1
             elif kind == "computer_quarantined":
                 m.quarantines += 1
+            elif kind == "coded_launch":
+                m.coded_launches += 1
+            elif kind == "coded_reconstruct":
+                m.coded_reconstructs += 1
+            elif kind == "coded_waste_bytes":
+                m.coded_waste_bytes += int(ev.get("bytes", 0) or 0)
             elif kind == "metrics":
                 src = ev.get("worker", "driver")
                 for c in ev.get("counters", []):
@@ -286,6 +299,12 @@ def format_attribution(m: JobMetrics) -> List[str]:
         parts.append(f"padding_waste={m.padding_waste:.1%}")
     if m.retries or m.quarantines:
         parts.append(f"retries={m.retries} quarantines={m.quarantines}")
+    if m.coded_launches or m.coded_reconstructs:
+        parts.append(
+            f"coded: launches={m.coded_launches} "
+            f"reconstructs={m.coded_reconstructs} "
+            f"waste={m.coded_waste_bytes}B"
+        )
     if m.workers:
         parts.append(f"worker_telemetry={m.workers} workers")
     if parts:

@@ -219,13 +219,17 @@ class ControlPlane:
 
         ship_telemetry(self, batch)
 
-    def drain_telemetry(self, n: int, state: Dict, events) -> int:
+    def drain_telemetry(
+        self, n: int, state: Dict, events, scheduler=None
+    ) -> int:
         """Driver side: absorb every worker's unread telemetry batches
-        into ``events`` with clock-offset correction; returns the
-        number of absorbed events (see ``obs.gang``)."""
+        into ``events`` with clock-offset correction; ``scheduler``
+        additionally folds peer ``quarantine_delta`` events into the
+        local blacklist; returns the number of absorbed events (see
+        ``obs.gang``)."""
         from dryad_tpu.obs.gang import drain_telemetry
 
-        return drain_telemetry(self, n, state, events)
+        return drain_telemetry(self, n, state, events, scheduler=scheduler)
 
     # -- failures -----------------------------------------------------------
     def report_failure(self, info: Dict) -> None:

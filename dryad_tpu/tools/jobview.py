@@ -527,6 +527,126 @@ def render_vertex_job(j: VertexJobInfo) -> str:
     return "\n".join(lines)
 
 
+# -- coded k-of-n stage panel (dryad_tpu.redundancy) ------------------------
+
+@dataclasses.dataclass
+class CodedJobInfo:
+    """Model of one coded k-of-n stage (``submit_partitioned`` with a
+    linear combiner): which coded vertices ran, which r-spare launches
+    fired, which k-subset reconstructed the output, and how much coded
+    work was wasted."""
+
+    seq: int
+    k: int
+    n: int
+    r: int
+    agg_kind: str = ""
+    seconds: Dict[int, float] = dataclasses.field(default_factory=dict)
+    parity: Dict[int, bool] = dataclasses.field(default_factory=dict)
+    computers: Dict[int, str] = dataclasses.field(default_factory=dict)
+    failed: List[int] = dataclasses.field(default_factory=list)
+    retries: List[int] = dataclasses.field(default_factory=list)
+    launch_trigger: Optional[str] = None
+    launch_threshold: Optional[float] = None
+    used: List[int] = dataclasses.field(default_factory=list)
+    parity_used: int = 0
+    exact: Optional[bool] = None
+    waste_bytes: int = 0
+    canceled: int = 0
+    completed: bool = False
+    total_seconds: float = 0.0
+
+
+def build_coded_jobs(events: List[Dict[str, Any]]) -> List[CodedJobInfo]:
+    """Fold coded_* events into per-stage k-of-n models."""
+    jobs: List[CodedJobInfo] = []
+    cur: Optional[CodedJobInfo] = None
+    for ev in events:
+        kind = ev["kind"]
+        if kind == "coded_job_start":
+            cur = CodedJobInfo(
+                ev.get("seq", 0), ev.get("k", 0), ev.get("n", 0),
+                ev.get("r", 0), agg_kind=ev.get("agg", ""),
+            )
+            jobs.append(cur)
+        elif cur is None:
+            continue
+        elif kind == "coded_task_complete":
+            j = ev["coded"]
+            cur.seconds[j] = ev.get("seconds", 0.0)
+            cur.parity[j] = bool(ev.get("parity"))
+            cur.computers[j] = ev.get("computer", "?")
+        elif kind == "coded_task_failed":
+            cur.failed.append(ev["coded"])
+        elif kind == "coded_retry":
+            cur.retries.append(ev["coded"])
+        elif kind == "coded_launch":
+            cur.launch_trigger = ev.get("trigger")
+            cur.launch_threshold = ev.get("threshold")
+        elif kind == "coded_reconstruct":
+            cur.used = list(ev.get("used", []))
+            cur.parity_used = ev.get("parity_used", 0)
+            cur.exact = ev.get("exact")
+        elif kind == "coded_waste_bytes":
+            cur.waste_bytes += ev.get("bytes", 0)
+        elif kind == "coded_cancel":
+            cur.canceled += ev.get("canceled", 0)
+        elif kind == "coded_job_complete":
+            cur.completed = True
+            cur.total_seconds = ev.get("seconds", 0.0)
+    return jobs
+
+
+def render_coded_job(c: CodedJobInfo) -> str:
+    """The per-stage k-of-n panel: coded roles, spare launch, decode."""
+    head = (
+        f"coded stage r{c.seq}: "
+        + ("OK" if c.completed else "FAILED/INCOMPLETE")
+        + f"  k={c.k} of n={c.n} ({c.r} parity)"
+        + (f"  {c.total_seconds:.3f}s" if c.completed else "")
+    )
+    lines = [head]
+    if c.launch_trigger:
+        thr = (
+            f" at threshold {c.launch_threshold:.3f}s"
+            if c.launch_threshold else ""
+        )
+        lines.append(f"  spares launched on {c.launch_trigger}{thr}")
+    lines.append(
+        f"  {'coded':>6} {'role':<6} {'secs':>8} {'computer':<12} notes"
+    )
+    ids = sorted(
+        set(c.seconds) | set(c.failed) | set(range(c.k))
+    )
+    for j in ids:
+        role = "parity" if (c.parity.get(j) or j >= c.k) else "data"
+        notes = []
+        if j in c.used:
+            notes.append("used")
+        elif j in c.seconds:
+            notes.append("unused")
+        if j in c.failed:
+            notes.append("failed")
+        if j in c.retries:
+            notes.append("re-executed")
+        secs = c.seconds.get(j)
+        lines.append(
+            f"  {j:>6} {role:<6} "
+            + (f"{secs:>8.3f}" if secs is not None else f"{'—':>8}")
+            + f" {c.computers.get(j, '—'):<12} {', '.join(notes) or '—'}"
+        )
+    if c.used:
+        lines.append(
+            f"  reconstructed from {c.used} "
+            f"(parity_used={c.parity_used}, "
+            + ("exact" if c.exact else "float64")
+            + (f", waste={c.waste_bytes}B" if c.waste_bytes else "")
+            + (f", canceled={c.canceled}" if c.canceled else "")
+            + ")"
+        )
+    return "\n".join(lines)
+
+
 # -- per-computer failure / quarantine summary ------------------------------
 
 @dataclasses.dataclass
@@ -781,15 +901,19 @@ def fold_submission(
     ONE fold shared by rendering and the exit code."""
     gang = build_gang_runs(events)
     vjobs = build_vertex_jobs(events)
+    cjobs = build_coded_jobs(events)
     parts = []
     if gang:
         parts.append("\n".join(_render_gang_run(r) for r in gang))
     parts.extend(render_vertex_job(vj) for vj in vjobs)
+    parts.extend(render_coded_job(cj) for cj in cjobs)
     health = build_computer_health(events)
     if health:
         parts.append(render_computer_health(health))
-    ok = all(r["completed"] for r in gang) and all(
-        vj.completed for vj in vjobs
+    ok = (
+        all(r["completed"] for r in gang)
+        and all(vj.completed for vj in vjobs)
+        and all(cj.completed for cj in cjobs)
     )
     return "\n\n".join(parts), ok
 
@@ -826,7 +950,7 @@ def render_attribution(events: List[Dict[str, Any]]) -> str:
 def _render_stream(events: List[Dict[str, Any]]) -> str:
     """Render whichever job model the stream holds."""
     kinds = {e["kind"] for e in events}
-    if kinds & {"vertex_job_start", "gang_run_start"}:
+    if kinds & {"vertex_job_start", "gang_run_start", "coded_job_start"}:
         text = fold_submission(events)[0]
     else:
         text = render(build_job(events))
@@ -916,7 +1040,7 @@ def follow_html(
     refresh = f'<meta http-equiv="refresh" content="{max(1, int(interval))}">'
     for events in _watch_events(path, interval, max_rounds):
         if {e["kind"] for e in events} & {
-            "vertex_job_start", "gang_run_start"
+            "vertex_job_start", "gang_run_start", "coded_job_start"
         }:
             text, _ok = fold_submission(events)
             page = _submission_html(text, extra_head=refresh)
@@ -978,7 +1102,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         write_chrome_trace(events, trace_out)
         print(f"wrote {trace_out}")
     attr = render_attribution(events)
-    if {e["kind"] for e in events} & {"vertex_job_start", "gang_run_start"}:
+    if {e["kind"] for e in events} & {"vertex_job_start", "gang_run_start", "coded_job_start"}:
         text, ok = fold_submission(events)
         if attr:
             text = text + "\n" + attr

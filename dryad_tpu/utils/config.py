@@ -90,6 +90,27 @@ class DryadConfig:
     # Outlier threshold in sigmas for speculative duplication
     # (reference DrStageStatistics.cpp:24-25: 3 sigma).
     outlier_sigmas: float = 3.0
+    # Straggler-threshold floor (exec.stats.StageStatistics): with few
+    # completed samples the trimmed-sigma fit degenerates (variance ~0
+    # flags EVERY later attempt an outlier); the threshold is clamped
+    # to floor_ratio x the trimmed mean.
+    straggler_floor_ratio: float = _env_float(
+        "DRYAD_TPU_STRAGGLER_FLOOR", 1.5
+    )
+    # Coded stage redundancy (dryad_tpu.redundancy): a partitioned
+    # aggregation whose combiner is LINEAR (sum/count/mean partials, or
+    # Decomposable(linear=True)) runs as k systematic + up to r parity
+    # coded vertices — ANY k of the k+r completions reconstruct the
+    # stage output (exactly for integer accumulators), so stragglers
+    # need no identification and killed vertices no re-execution.
+    # Non-linear combiners keep the duplicate-on-straggle path.
+    coded_redundancy: bool = _env_bool("DRYAD_TPU_CODED_REDUNDANCY", True)
+    coded_parity_tasks: int = _env_int("DRYAD_TPU_CODED_PARITY", 2)
+    # Float decode guard: refuse coded subsets whose combination-weight
+    # L1 norm would amplify rounding noise beyond this factor.
+    coded_max_amplification: float = _env_float(
+        "DRYAD_TPU_CODED_MAX_AMP", 1e6
+    )
     # Retry backoff (exec.failure.RetryPolicy): transient stage/vertex
     # failures wait base * 2^(failures-1) seconds (capped at max) plus
     # seeded jitter before re-executing — a crashing dependency gets
@@ -219,6 +240,12 @@ class DryadConfig:
             raise ValueError("max_stage_failures must be >= 1")
         if self.outlier_sigmas <= 0:
             raise ValueError("outlier_sigmas must be > 0")
+        if self.straggler_floor_ratio < 1.0:
+            raise ValueError("straggler_floor_ratio must be >= 1.0")
+        if self.coded_parity_tasks < 1:
+            raise ValueError("coded_parity_tasks must be >= 1")
+        if self.coded_max_amplification <= 0:
+            raise ValueError("coded_max_amplification must be > 0")
         if self.retry_backoff_base < 0:
             raise ValueError("retry_backoff_base must be >= 0")
         if self.retry_backoff_max < self.retry_backoff_base:

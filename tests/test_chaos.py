@@ -241,6 +241,45 @@ def test_chaos_worker_kill_reexecutes_on_survivor():
         assert "vertex_job_complete" in kinds
 
 
+def test_chaos_gang_kill_mid_collective_auto_recovers():
+    """FaultPlan extended to GANG runs (ROADMAP open item): a seeded
+    plan with ``worker_kill_prob`` installed on ONE gang member via the
+    ``set_fault`` mailbox command kills that worker process inside its
+    group_by stage — its peer is left stranded in the stage's
+    collectives (mid-collective death) — and ``submit()``'s
+    auto-recovery rebuilds the gang and still returns the oracle
+    answer."""
+    from dryad_tpu.cluster.localjob import LocalJobSubmission
+
+    rng = np.random.default_rng(3)
+    tbl = {
+        "k": rng.integers(0, 13, 800).astype(np.int32),
+        "v": rng.standard_normal(800).astype(np.float32),
+    }
+    with LocalJobSubmission(num_workers=2, devices_per_worker=1) as sub:
+        ctx = DryadContext(num_partitions_=2)
+        q = ctx.from_arrays(tbl).group_by(
+            "k", {"s": ("sum", "v"), "n": ("count", None)}
+        )
+        sub.inject_fault(
+            None,
+            plan={"seed": 3, "worker_kill_prob": 1.0,
+                  "max_worker_kills": 1, "stages": ["group_by"]},
+            workers=[1],
+        )
+        out = sub.submit(q)
+        ks = np.unique(tbl["k"])
+        exp_s = np.array(
+            [tbl["v"][tbl["k"] == kk].sum() for kk in ks], np.float32
+        )
+        assert sorted(out["k"].tolist()) == ks.tolist()
+        order = np.argsort(out["k"])
+        np.testing.assert_allclose(out["s"][order], exp_s, rtol=1e-4)
+        kinds = [e["kind"] for e in sub.events.events()]
+        assert "gang_member_lost_mid_job" in kinds
+        assert "gang_rebuild" in kinds
+
+
 def test_chaos_deterministic_stage_fails_fast_with_history(mesh8):
     """An always-failing stage (stable error) is classified
     deterministic on its second identical failure and fails the job

@@ -1,0 +1,497 @@
+"""Coded stage redundancy (dryad_tpu.redundancy): k-of-n reconstruction
+of partial aggregates.
+
+Three layers:
+
+- **coding math** — the systematic scaled-Cauchy generator is MDS
+  (every k-subset of rows decodes), and every k-subset of n coded
+  partial tables reconstructs the merged stage output BYTE-IDENTICALLY
+  for integer states / within tolerance for float states, swept over
+  seeds and over every registered linear ``Decomposable``;
+- **policy** — only linear combiners qualify; non-linear aggregates,
+  STRING columns, and undeclared Decomposables fall back loudly;
+- **end to end** — real 2/3-process LocalJobSubmissions: a straggling
+  coded vertex is masked by parity at fast-worker speed, and the
+  acceptance chaos scenario: r of the n coded vertices are KILLED
+  mid-stage (seeded FaultPlan kills via the gang ``set_fault``
+  command) and the stage output is byte-identical to the unfailed run
+  with ZERO re-executions in the event stream.
+"""
+
+import time
+from fractions import Fraction
+from itertools import combinations
+
+import numpy as np
+import pytest
+
+from dryad_tpu import DryadConfig, DryadContext
+from dryad_tpu.api.decomposable import LINEAR_DECOMPOSABLES
+from dryad_tpu.exec.partial import align_partials, coded_combine, partial_plan
+from dryad_tpu.redundancy.coding import CodedSpec, generator_rows
+from dryad_tpu.redundancy.policy import decide
+from dryad_tpu.redundancy.reconstruct import (
+    merge_coded,
+    reconstruct_partials,
+    solve_merge_weights,
+)
+
+SEEDS = [0, 1, 2]
+
+
+# -- coding math -------------------------------------------------------------
+
+@pytest.mark.parametrize("k,r", [(2, 1), (3, 2), (4, 2), (5, 3), (6, 2)])
+def test_generator_every_k_subset_decodes(k, r):
+    """MDS property: every k-subset of generator rows solves for the
+    all-ones functional (singular subsets would raise)."""
+    rows = generator_rows(k, r)
+    for subset in combinations(range(k + r), k):
+        w = solve_merge_weights([rows[j] for j in subset])
+        for i in range(k):
+            got = sum(
+                w[jj] * rows[j][i] for jj, j in enumerate(subset)
+            )
+            assert got == Fraction(1), (subset, i)
+
+
+def _partial_tables(seed: int, k: int, float_states: bool):
+    """k per-partition partial tables: int32 group keys, one int64 and
+    (optionally) one float64 state column, with DIFFERENT key subsets
+    per partition (the real shape: a partition only sees its keys)."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(k):
+        keys = np.sort(rng.choice(
+            np.arange(11, dtype=np.int32),
+            size=int(rng.integers(3, 9)), replace=False,
+        ))
+        t = {
+            "g": keys,
+            "a": rng.integers(-10 ** 6, 10 ** 6, len(keys)).astype(
+                np.int64
+            ),
+        }
+        if float_states:
+            t["f"] = rng.standard_normal(len(keys)).astype(np.float64)
+        out.append(t)
+    return out
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_every_k_subset_reconstructs_ints_byte_identical(seed):
+    k, r = 4, 2
+    spec = CodedSpec(k, r)
+    partials = _partial_tables(seed, k, float_states=False)
+    coded = [
+        coded_combine(
+            [partials[i] for i in spec.support(j)], spec.coeffs(j),
+            ["g"], ["a"],
+        )
+        for j in range(spec.n)
+    ]
+    truth = coded_combine(partials, [1] * k, ["g"], ["a"])
+    for subset in combinations(range(spec.n), k):
+        merged, info = merge_coded(
+            [spec.row(j) for j in subset],
+            [coded[j] for j in subset], ["g"], ["a"],
+        )
+        assert info["exact"], subset
+        assert merged["g"].tobytes() == truth["g"].tobytes(), subset
+        assert merged["a"].tobytes() == truth["a"].tobytes(), subset
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_every_k_subset_reconstructs_floats_within_tolerance(seed):
+    k, r = 3, 2
+    spec = CodedSpec(k, r)
+    partials = _partial_tables(seed, k, float_states=True)
+    coded = [
+        coded_combine(
+            [partials[i] for i in spec.support(j)], spec.coeffs(j),
+            ["g"], ["a", "f"],
+        )
+        for j in range(spec.n)
+    ]
+    truth = coded_combine(partials, [1] * k, ["g"], ["a", "f"])
+    for subset in combinations(range(spec.n), k):
+        merged, info = merge_coded(
+            [spec.row(j) for j in subset],
+            [coded[j] for j in subset], ["g"], ["a", "f"],
+        )
+        # int column stays exact even when floats ride along
+        assert merged["a"].tobytes() == truth["a"].tobytes(), subset
+        np.testing.assert_allclose(
+            merged["f"], truth["f"], rtol=1e-9, atol=1e-9,
+        )
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_reconstruct_individual_partials_roundtrip(seed):
+    """reconstruct_partials recovers EVERY systematic partial (over the
+    full key union, zeros for absent keys) from any k-subset."""
+    k, r = 4, 2
+    spec = CodedSpec(k, r)
+    partials = _partial_tables(seed, k, float_states=True)
+    coded = [
+        coded_combine(
+            [partials[i] for i in spec.support(j)], spec.coeffs(j),
+            ["g"], ["a", "f"],
+        )
+        for j in range(spec.n)
+    ]
+    _keys, mats = align_partials(partials, ["g"], ["a", "f"])
+    for subset in ((0, 2, 4, 5), (2, 3, 4, 5), (0, 1, 2, 3)):
+        rec = reconstruct_partials(
+            [spec.row(j) for j in subset],
+            [coded[j] for j in subset], ["g"], ["a", "f"],
+        )
+        for i in range(k):
+            assert rec[i]["a"].tolist() == [int(x) for x in mats["a"][i]]
+            np.testing.assert_allclose(
+                rec[i]["f"], np.asarray(mats["f"][i], np.float64),
+                rtol=1e-9, atol=1e-9,
+            )
+
+
+# -- registered linear Decomposables (satellite: property sweep) ------------
+
+def _dec_state_tables(dec, seed: int, k: int):
+    """Per-partition STATE tables for one linear Decomposable: seed()
+    per row, group-summed per key (valid because linear == additive
+    merge — asserted numerically below)."""
+    rng = np.random.default_rng(seed)
+    tables = []
+    for _ in range(k):
+        n = int(rng.integers(20, 60))
+        cols = {"v": rng.integers(-50, 50, n).astype(np.int32)}
+        if any(
+            ct.numpy_dtype.kind == "f" for _n, ct in dec.state_fields
+        ):
+            cols["v"] = cols["v"].astype(np.float32)
+        keys = rng.integers(0, 7, n).astype(np.int32)
+        seeded = {c: np.asarray(a) for c, a in dec.seed(cols).items()}
+        t = {"g": np.unique(keys)}
+        for name, ct in dec.state_fields:
+            acc = np.zeros(len(t["g"]), ct.numpy_dtype)
+            idx = np.searchsorted(t["g"], keys)
+            np.add.at(acc, idx, seeded[name].astype(ct.numpy_dtype))
+            t[name] = acc
+        tables.append(t)
+    return tables
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+@pytest.mark.parametrize("name", sorted(LINEAR_DECOMPOSABLES))
+def test_registered_linear_decomposables_reconstruct(name, seed):
+    dec = LINEAR_DECOMPOSABLES[name]
+    state = [n for n, _ct in dec.state_fields]
+    k, r = 3, 2
+    spec = CodedSpec(k, r)
+    tables = _dec_state_tables(dec, seed, k)
+    # the linearity contract itself: merge IS elementwise addition
+    a, b = tables[0], tables[1]
+    keys, mats = align_partials([a, b], ["g"], state)
+    added = dec.merge(
+        {c: np.asarray(mats[c][0], np.float64) for c in state},
+        {c: np.asarray(mats[c][1], np.float64) for c in state},
+    )
+    for c in state:
+        np.testing.assert_allclose(
+            np.asarray(added[c], np.float64),
+            np.asarray(mats[c][0], np.float64)
+            + np.asarray(mats[c][1], np.float64),
+            rtol=1e-6,
+        )
+    # and every k-subset of coded states reconstructs the merged state
+    coded = [
+        coded_combine(
+            [tables[i] for i in spec.support(j)], spec.coeffs(j),
+            ["g"], state,
+        )
+        for j in range(spec.n)
+    ]
+    truth = coded_combine(tables, [1] * k, ["g"], state)
+    exact = all(
+        ct.numpy_dtype.kind in "iub" for _n, ct in dec.state_fields
+    )
+    for subset in combinations(range(spec.n), k):
+        merged, info = merge_coded(
+            [spec.row(j) for j in subset],
+            [coded[j] for j in subset], ["g"], state,
+        )
+        for c in state:
+            if exact:
+                assert merged[c].tobytes() == truth[c].tobytes(), (
+                    name, subset, c,
+                )
+            else:
+                np.testing.assert_allclose(
+                    merged[c], truth[c], rtol=1e-9, atol=1e-9,
+                )
+
+
+# -- policy ------------------------------------------------------------------
+
+def _group_merge_spec(ctx, aggs):
+    q = ctx.from_arrays({
+        "k": np.arange(10, dtype=np.int32),
+        "v": np.ones(10, np.float32),
+    }).group_by("k", aggs)
+    partial, plan = partial_plan(
+        [(op, col, out) for out, (op, col) in aggs.items()]
+    )
+    from dryad_tpu.api.query import Query
+
+    pq = Query(ctx, q.node.inputs[0]).group_by("k", partial)
+    return pq, ("group", ["k"], plan, q.schema)
+
+
+def test_policy_linear_group_qualifies():
+    ctx = DryadContext(num_partitions_=1)
+    pq, spec = _group_merge_spec(
+        ctx, {"s": ("sum", "v"), "c": ("count", None), "m": ("mean", "v")}
+    )
+    d = decide(pq, spec, DryadConfig(), nparts=4)
+    assert d.apply, d.reason
+    assert d.k == 4 and d.r == DryadConfig().coded_parity_tasks
+    assert set(d.key_cols) == {"k"}
+
+
+def test_policy_non_linear_falls_back():
+    ctx = DryadContext(num_partitions_=1)
+    pq, spec = _group_merge_spec(
+        ctx, {"s": ("sum", "v"), "lo": ("min", "v")}
+    )
+    d = decide(pq, spec, DryadConfig(), nparts=4)
+    assert not d.apply
+    assert "min" in d.reason
+
+
+def test_policy_disabled_and_single_shard_fall_back():
+    ctx = DryadContext(num_partitions_=1)
+    pq, spec = _group_merge_spec(ctx, {"s": ("sum", "v")})
+    assert not decide(
+        pq, spec, DryadConfig(coded_redundancy=False), nparts=4
+    ).apply
+    assert decide(
+        pq, spec, DryadConfig(coded_redundancy=False), nparts=4,
+        requested=True,
+    ).apply
+    assert not decide(pq, spec, DryadConfig(), nparts=1).apply
+
+
+def test_policy_string_key_falls_back():
+    ctx = DryadContext(num_partitions_=1)
+    words = np.array(["a", "b", "c", "a"], object)
+    q = ctx.from_arrays({"w": words}).group_by(
+        "w", {"c": ("count", None)}
+    )
+    partial, plan = partial_plan([("count", None, "c")])
+    from dryad_tpu.api.query import Query
+
+    pq = Query(ctx, q.node.inputs[0]).group_by("w", partial)
+    d = decide(pq, ("group", ["w"], plan, q.schema), DryadConfig(), 4)
+    assert not d.apply
+    assert "STRING" in d.reason
+
+
+def test_policy_undeclared_decomposable_falls_back():
+    import jax.numpy as jnp
+
+    from dryad_tpu import ColumnType, Decomposable
+
+    dec = Decomposable(
+        seed=lambda c: {"m": c["v"]},
+        merge=lambda a, b: {"m": jnp.maximum(a["m"], b["m"])},
+        state_cols=["m"],
+        out_fields=[("m", ColumnType.FLOAT32)],
+        state_fields=[("m", ColumnType.FLOAT32)],
+    )
+    ctx = DryadContext(num_partitions_=1)
+    q = ctx.from_arrays({
+        "k": np.arange(4, dtype=np.int32),
+        "v": np.ones(4, np.float32),
+    }).group_by("k", decomposable=dec)
+    d = decide(
+        q, ("group_dec", ["k"], dec, q.schema), DryadConfig(), 4
+    )
+    assert not d.apply
+    assert "linear" in d.reason
+
+
+def test_linear_decomposable_requires_identity():
+    from dryad_tpu import ColumnType, Decomposable
+
+    with pytest.raises(ValueError, match="identity"):
+        Decomposable(
+            seed=lambda c: {"s": c["v"]},
+            merge=lambda a, b: {"s": a["s"] + b["s"]},
+            state_cols=["s"],
+            out_fields=[("s", ColumnType.FLOAT32)],
+            linear=True,
+        )
+    with pytest.raises(ValueError, match="additive zero"):
+        Decomposable(
+            seed=lambda c: {"s": c["v"]},
+            merge=lambda a, b: {"s": a["s"] + b["s"]},
+            state_cols=["s"],
+            out_fields=[("s", ColumnType.FLOAT32)],
+            linear=True, identity={"s": 1},
+        )
+
+
+# -- end to end over real worker processes ----------------------------------
+
+DELAY = 8.0
+
+
+@pytest.fixture(scope="module")
+def submission():
+    from dryad_tpu.cluster.localjob import LocalJobSubmission
+
+    with LocalJobSubmission(num_workers=2, devices_per_worker=1) as sub:
+        yield sub
+
+
+def _int_group_query(n=3000):
+    rng = np.random.default_rng(5)
+    tbl = {
+        "k": rng.integers(0, 20, n).astype(np.int32),
+        "v": rng.integers(-100, 100, n).astype(np.int32),
+    }
+    ctx = DryadContext(num_partitions_=1)
+    q = ctx.from_arrays(tbl).group_by(
+        "k", {"c": ("count", None), "s": ("sum", "v")}
+    )
+    exp = {
+        int(kk): (int((tbl["k"] == kk).sum()),
+                  int(tbl["v"][tbl["k"] == kk].sum()))
+        for kk in np.unique(tbl["k"])
+    }
+    return q, exp
+
+
+def test_coded_group_by_matches_oracle(submission):
+    q, exp = _int_group_query()
+    out = submission.submit_partitioned(q, nparts=4, coded=True)
+    got = {
+        int(kk): (int(c), int(s))
+        for kk, c, s in zip(out["k"], out["c"], out["s"])
+    }
+    assert got == exp
+    kinds = [e["kind"] for e in submission.events.events()]
+    assert "coded_job_start" in kinds
+    assert "coded_job_complete" in kinds
+    assert "coded_reconstruct" in kinds
+
+
+def test_coded_straggler_masked_at_fast_worker_speed(submission):
+    """A stalled coded vertex: the coarse spare trigger launches the r
+    parity vertices (no straggler identification — with k=2 shards the
+    duplicate path's outlier model could never even converge) and the
+    stage finishes at fast-worker speed, byte-identical to the
+    unstalled run."""
+    q, _exp = _int_group_query()
+    out0 = submission.submit_partitioned(q, nparts=2, coded=True)  # warm
+    submission.inject_delay(worker=1, seconds=DELAY, count=1)
+    t0 = time.monotonic()
+    out = submission.submit_partitioned(q, nparts=2, coded=True)
+    dt = time.monotonic() - t0
+    assert dt < DELAY - 1.0, f"coded job took {dt:.1f}s; not masked"
+    for c in out0:
+        assert out0[c].tobytes() == out[c].tobytes(), c
+    evs = submission.events.events()
+    rec = [e for e in evs if e["kind"] == "coded_reconstruct"][-1]
+    assert rec["parity_used"] >= 1
+    assert rec["exact"] is True
+    launches = [e for e in evs if e["kind"] == "coded_launch"]
+    assert launches and launches[-1]["trigger"] in (
+        "straggler", "failure",
+    )
+
+
+def test_coded_scalar_aggregate(submission):
+    rng = np.random.default_rng(13)
+    tbl = {"v": rng.integers(0, 1000, 3000).astype(np.int32)}
+    ctx = DryadContext(num_partitions_=1)
+    q = ctx.from_arrays(tbl).aggregate_as_query(
+        {"s": ("sum", "v"), "n": ("count", None)}
+    )
+    out = submission.submit_partitioned(q, nparts=5, coded=True)
+    assert int(out["s"][0]) == int(tbl["v"].sum())
+    assert int(out["n"][0]) == 3000
+
+
+def test_coded_linear_decomposable(submission):
+    """A Decomposable(linear=True) runs coded end to end."""
+    import dataclasses as _dc
+
+    dec = _dc.replace(LINEAR_DECOMPOSABLES["moments"])
+    rng = np.random.default_rng(23)
+    tbl = {
+        "k": rng.integers(0, 9, 2500).astype(np.int32),
+        "v": rng.standard_normal(2500).astype(np.float32),
+    }
+    ctx = DryadContext(num_partitions_=1)
+    q = ctx.from_arrays(tbl).group_by("k", decomposable=dec)
+    out = submission.submit_partitioned(q, nparts=4, coded=True)
+    for kk, var in zip(out["k"], out["var"]):
+        vs = tbl["v"][tbl["k"] == kk]
+        np.testing.assert_allclose(var, vs.var(), rtol=1e-3, atol=1e-4)
+
+
+def test_coded_forced_on_ineligible_plan_raises(submission):
+    rng = np.random.default_rng(2)
+    tbl = {
+        "k": rng.integers(0, 9, 200).astype(np.int32),
+        "v": rng.standard_normal(200).astype(np.float32),
+    }
+    ctx = DryadContext(num_partitions_=1)
+    q = ctx.from_arrays(tbl).group_by("k", {"lo": ("min", "v")})
+    with pytest.raises(ValueError, match="ineligible"):
+        submission.submit_partitioned(q, nparts=4, coded=True)
+
+
+@pytest.mark.chaos
+def test_coded_kill_r_of_n_byte_identical_zero_reexecution():
+    """ACCEPTANCE: seeded FaultPlan kills (via the gang ``set_fault``
+    mailbox command) take down r=2 of the n=5 coded vertices mid-stage
+    — the worker processes hosting them die inside the stage — and the
+    stage output is BYTE-IDENTICAL to the unfailed run, with zero full
+    vertex re-executions recorded in the event stream."""
+    from dryad_tpu.cluster.localjob import LocalJobSubmission
+
+    rng = np.random.default_rng(9)
+    tbl = {
+        "k": rng.integers(0, 16, 4000).astype(np.int32),
+        "v": rng.integers(-1000, 1000, 4000).astype(np.int32),
+    }
+    with LocalJobSubmission(num_workers=3, devices_per_worker=1) as sub:
+        ctx = DryadContext(num_partitions_=1)
+        q = ctx.from_arrays(tbl).group_by(
+            "k", {"c": ("count", None), "s": ("sum", "v")}
+        )
+        out_a = sub.submit_partitioned(q, nparts=3, coded=True)
+        # seeded kills on workers 1 and 2: each dies on its next coded
+        # stage attempt (coded vertices c1 and c2 — r of the n)
+        sub.inject_fault(
+            None,
+            plan={"seed": 7, "worker_kill_prob": 1.0,
+                  "max_worker_kills": 1},
+            workers=[1, 2],
+        )
+        out_b = sub.submit_partitioned(q, nparts=3, coded=True)
+        assert sorted(out_a) == sorted(out_b)
+        for c in out_a:
+            assert out_a[c].tobytes() == out_b[c].tobytes(), c
+        evs = sub.events.events()
+        kinds = [e["kind"] for e in evs]
+        # zero full vertex re-executions: the killed vertices were
+        # never relaunched — parity covered them
+        assert kinds.count("coded_retry") == 0
+        assert kinds.count("vertex_retry") == 0
+        rec = [e for e in evs if e["kind"] == "coded_reconstruct"][-1]
+        assert rec["exact"] is True
+        assert rec["parity_used"] == 2
+        assert kinds.count("worker_dead") == 2

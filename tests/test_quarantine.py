@@ -336,3 +336,104 @@ class TestExecutorBackoff:
             round(s, 4) for s in slept
         ]
         assert all(e["failure_kind"] == "transient" for e in evs)
+
+
+# -- multihost shared quarantine (obs.gang over the telemetry channel) ------
+
+def test_shared_quarantine_converges_across_drivers():
+    """Two fake drivers exchanging through one in-memory mailbox: driver
+    A's local failure deltas ship as ``quarantine_delta`` telemetry and
+    fold into driver B's scheduler, so both converge on the same
+    blacklist; remote absorptions never re-export (no echo), and a
+    driver draining its OWN batch does not double-count."""
+    from dryad_tpu.cluster.service import Mailbox
+    from dryad_tpu.obs.gang import drain_telemetry, ship_failure_deltas
+    from dryad_tpu.parallel.multihost import ControlPlane
+
+    mb = Mailbox()
+    cp_a = ControlPlane("job", 0, mailbox=mb)
+    cp_b = ControlPlane("job", 1, mailbox=mb)
+    clock = FakeClock()
+    sched_a = LocalScheduler([], clock=clock)
+    sched_b = LocalScheduler([], clock=clock, events=EventLog(None))
+    try:
+        for _ in range(3):
+            sched_a.record_failure("worker7")
+        assert sched_a.quarantined() == ["worker7"]
+        assert sched_b.quarantined() == []
+
+        assert ship_failure_deltas(cp_a, sched_a, EventLog(None)) == 1
+        assert sched_a.failure_delta() == {}  # drained exactly once
+
+        ev_b = EventLog(None)
+        absorbed = drain_telemetry(cp_b, 2, {}, ev_b, scheduler=sched_b)
+        assert absorbed == 1
+        assert sched_b.quarantined() == ["worker7"]
+        # remote absorption must not echo back out of B
+        assert sched_b.failure_delta() == {}
+        kinds = [e["kind"] for e in ev_b.events()]
+        assert "quarantine_delta" in kinds
+        evs = sched_b._events.filter("quarantine_absorbed")
+        assert evs and evs[-1]["deltas"] == {"worker7": 3}
+
+        # A re-reading its own shipped batch is a no-op (src == pid)
+        win = sched_a._failures["worker7"]
+        before = win.count(clock())
+        drain_telemetry(cp_a, 2, {}, EventLog(None), scheduler=sched_a)
+        assert win.count(clock()) == before
+    finally:
+        sched_a.shutdown()
+        sched_b.shutdown()
+
+
+def test_remote_failures_combine_with_local_for_quarantine():
+    """Blacklist convergence uses ONE window per computer: 2 local + 1
+    remote failures cross the threshold together."""
+    clock = FakeClock()
+    sched = LocalScheduler([], clock=clock)
+    try:
+        sched.record_failure("w3")
+        sched.record_failure("w3")
+        assert sched.quarantined() == []
+        sched.absorb_remote_failures({"w3": 1}, source=5)
+        assert sched.quarantined() == ["w3"]
+        # only the LOCAL share ships onward
+        assert sched.failure_delta() == {"w3": 2}
+    finally:
+        sched.shutdown()
+
+
+# -- straggler-threshold floor (exec.stats robustness) ----------------------
+
+def test_straggler_threshold_floor_with_few_samples():
+    """With 3 near-identical samples the trimmed fit keeps 2 points and
+    the variance degenerates toward 0 — unfloored, mean + 3*sigma would
+    flag EVERY later attempt.  The floor clamps to floor_ratio x the
+    trimmed mean (seeded)."""
+    import numpy as np
+
+    from dryad_tpu.exec.stats import StageStatistics
+
+    rng = np.random.default_rng(0)
+    st = StageStatistics()
+    for _ in range(3):
+        st.record(1.0 + float(rng.normal(0.0, 1e-6)))
+    thr = st.outlier_threshold()
+    assert thr is not None and thr >= 1.49
+    assert not st.is_outlier(1.2)
+    assert st.is_outlier(2.0)
+
+
+def test_spare_threshold_acts_from_first_sample():
+    """The coded spare trigger needs no converged model: None with no
+    samples, floor_ratio x max(completed) from the first one, and the
+    full robust threshold once it exists."""
+    from dryad_tpu.exec.stats import StageStatistics
+
+    st = StageStatistics()
+    assert st.spare_threshold() is None
+    st.record(0.2)
+    assert st.spare_threshold() == pytest.approx(0.3)
+    st.record(0.25)
+    st.record(0.22)
+    assert st.spare_threshold() == st.outlier_threshold()

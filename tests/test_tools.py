@@ -234,6 +234,54 @@ def test_vertex_jobview_drilldown():
     assert "9.0x compression" in text
 
 
+def test_coded_jobview_k_of_n_panel():
+    """Coded-stage model + render (the per-stage k-of-n panel)."""
+    from dryad_tpu.tools.jobview import (
+        build_coded_jobs,
+        fold_submission,
+        render_coded_job,
+    )
+
+    events = [
+        {"ts": 1.0, "kind": "coded_job_start", "seq": 3, "k": 3, "n": 5,
+         "r": 2, "agg": "group"},
+        {"ts": 1.2, "kind": "coded_task_complete", "coded": 0,
+         "parity": False, "seconds": 0.2, "computer": "worker0"},
+        {"ts": 1.3, "kind": "coded_task_failed", "coded": 1,
+         "parity": False, "error": "boom", "failure_kind": "transient"},
+        {"ts": 1.3, "kind": "coded_launch", "seq": 3, "k": 3, "n": 5,
+         "r": 2, "trigger": "failure", "threshold": None},
+        {"ts": 1.9, "kind": "coded_task_complete", "coded": 3,
+         "parity": True, "seconds": 0.7, "computer": "worker0"},
+        {"ts": 2.1, "kind": "coded_task_complete", "coded": 4,
+         "parity": True, "seconds": 0.9, "computer": "worker2"},
+        {"ts": 2.1, "kind": "coded_cancel", "seq": 3, "canceled": 1},
+        {"ts": 2.1, "kind": "coded_waste_bytes", "seq": 3, "bytes": 1234,
+         "unused": []},
+        {"ts": 2.2, "kind": "coded_reconstruct", "seq": 3,
+         "used": [0, 3, 4], "parity_used": 2, "exact": True,
+         "amplification": 1.7, "seconds": 0.004},
+        {"ts": 2.3, "kind": "coded_job_complete", "seq": 3,
+         "seconds": 1.5},
+    ]
+    jobs = build_coded_jobs(events)
+    assert len(jobs) == 1
+    c = jobs[0]
+    assert c.completed and c.k == 3 and c.n == 5
+    assert c.used == [0, 3, 4] and c.parity_used == 2 and c.exact
+    assert c.failed == [1] and c.launch_trigger == "failure"
+    text = render_coded_job(c)
+    assert "k=3 of n=5" in text
+    assert "spares launched on failure" in text
+    assert "parity" in text and "failed" in text
+    assert "reconstructed from [0, 3, 4]" in text and "exact" in text
+    folded, ok = fold_submission(events)
+    assert ok and "coded stage r3" in folded
+    # an incomplete coded stage folds NOT-ok (the exit-code path)
+    _t, bad = fold_submission(events[:-1])
+    assert not bad
+
+
 def test_vertex_jobview_membership_attribution():
     """A worker death AFTER a job completed must not be attributed to
     that job; the next job sees it."""
