@@ -641,6 +641,84 @@ def ooc_vocab_metric(
     )
 
 
+def fusedpipe_metric(n: int):
+    """Whole-DAG SPMD fusion (plan/fuse.py): a 4+ stage plan — select
+    -> hash group_by -> join -> join -> range-sort tail — run with
+    ``plan_fuse`` on vs off.  Reports rows/s plus the TPU-relevant
+    control-plane numbers: program DISPATCHES per plan (stage_start
+    events; the per-dispatch tunnel round-trip is ~70ms, BASELINE.md)
+    and XLA compile count (one key per region vs one per stage).
+    ``tail_fanout_rows=0`` disables the observed-volume width adapter
+    on both sides so the comparison isolates fusion itself."""
+    from dryad_tpu import DryadContext
+    from dryad_tpu.utils.config import DryadConfig
+
+    rng = np.random.default_rng(7)
+    tbl = {
+        # wide key domain: keeps the int auto-dense rewrite off so the
+        # group_by pays its hash exchange (a real seam collective)
+        "k": rng.integers(0, 1 << 20, n).astype(np.int32),
+        "v": rng.standard_normal(n).astype(np.float32),
+    }
+    dk = np.unique(tbl["k"][: 1 << 12])
+    dim1 = {"k": dk, "w": np.arange(len(dk), dtype=np.int32)}
+    dim2 = {"k": dk[::2].copy(),
+            "u": np.arange(len(dk[::2]), dtype=np.int32)}
+
+    def build(ctx):
+        a = (
+            ctx.from_arrays(tbl)
+            .select(lambda c: {"k": c["k"], "v": c["v"] * 2.0})
+            .group_by("k", {"s": ("sum", "v"), "c": ("count", None)})
+        )
+        j1 = a.join(ctx.from_arrays(dim1), "k")
+        j2 = j1.join(ctx.from_arrays(dim2), "k")
+        return j2.order_by([("s", True), ("k", False)])
+
+    def run_mode(plan_fuse):
+        ctx = DryadContext(
+            config=DryadConfig(plan_fuse=plan_fuse, tail_fanout_rows=0)
+        )
+        q = build(ctx)
+        out = q.collect()  # warmup: pays every compile
+        rows = len(out["k"])
+        ev = ctx.events.events()
+        compiles = sum(1 for e in ev if e["kind"] == "xla_compile")
+        mark = len(ev)
+        best, times = timed_reps(lambda: q.collect(), reps=3)
+        steady = ctx.events.events()[mark:]
+        reps = 3
+        dispatches = sum(
+            1 for e in steady if e["kind"] == "stage_start"
+        ) / reps
+        regions = sum(
+            1 for e in steady if e["kind"] == "fused_dispatch"
+        ) / reps
+        return dict(
+            rows=rows, times=times, compiles=compiles,
+            dispatches=dispatches, fused_regions=regions,
+        )
+
+    fused = run_mode(True)
+    staged = run_mode(False)
+    rec = rep_record(
+        "fusedpipe_rows_per_sec", n, fused["times"],
+        {
+            "dispatches_fused": fused["dispatches"],
+            "dispatches_staged": staged["dispatches"],
+            "fused_regions": fused["fused_regions"],
+            "compiles_fused": fused["compiles"],
+            "compiles_staged": staged["compiles"],
+            "staged_rows_per_sec": round(n / min(staged["times"]), 1),
+            "speedup_vs_staged": round(
+                min(staged["times"]) / min(fused["times"]), 3
+            ),
+            "out_rows": fused["rows"],
+        },
+    )
+    return rec
+
+
 def codedagg_metric(nrows: int = 60_000, nparts: int = 2, delay: float = 6.0):
     """Coded k-of-n vs duplicate-on-straggle under an injected straggler
     (dryad_tpu.redundancy): one worker stalls its vertex ``delay``
@@ -989,6 +1067,11 @@ def child_main() -> None:
              chunk_rows=1 << 18 if accel else 1 << 15,
              vocab_step=1 << 11 if accel else 1 << 9),
          200 if accel else 75, False),
+        # whole-DAG fusion: one dispatch + one compile key per fused
+        # region vs one per stage (plan_fuse on vs off, same plan)
+        ("fusedpipe_rows_per_sec",
+         lambda: fusedpipe_metric(1 << 21 if accel else 1 << 18),
+         90 if accel else 40, False),
         # coded k-of-n vs duplicate-on-straggle makespan under an
         # injected straggler (2 worker processes; host-bound — the
         # workers pin JAX_PLATFORMS=cpu on any backend)

@@ -89,6 +89,12 @@ def stage_fingerprint(
         "|".join(parts)
         + f"|outs={stage.out_slots}|shapes={shape_key}|ins={input_fps}"
     )
+    # Fused regions (plan.fuse.FusedStage) chain member ops whose slot
+    # numbers overlap; their wiring/exports/member boundaries are part
+    # of the identity or two differently-wired regions could alias.
+    extra = getattr(stage, "fingerprint_extra", None)
+    if extra:
+        blob += "|" + extra
     return hashlib.sha1(blob.encode()).hexdigest()[:16]
 
 

@@ -49,6 +49,8 @@ EVENT_KINDS: Dict[str, str] = {
     "stage_dispatched": "speculative dispatch joined the overflow window",
     "overflow_drain": "batched readback of the speculative window's flags",
     "stage_fanout": "stage lowered at reduced width; nparts/of",
+    "fused_dispatch": "fused region dispatched as ONE program; members",
+    "fuse_break": "plan fusion kept a driver seam; after/before/reason",
     "stage_width_adapt": "observed-volume width adaptation; nparts/of",
     "stage_delay_injected": "fault-injection delay before the attempt",
     "dict_miss": "rows outside the dense key domain; stage_name/rows",

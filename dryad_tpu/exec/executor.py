@@ -44,6 +44,7 @@ from dryad_tpu.exec.failure import (
 from dryad_tpu.exec.kernels import (
     NON_OVERFLOW_OPS,
     OPERAND_PARAMS,
+    build_fused_fn,
     build_stage_fn,
     stage_operand_objs,
 )
@@ -52,7 +53,13 @@ from dryad_tpu.exec.stats import StageStatistics
 from dryad_tpu.obs.metrics import MetricsRegistry
 from dryad_tpu.obs.span import Tracer
 from dryad_tpu.parallel.mesh import mesh_axes, num_partitions
-from dryad_tpu.parallel.stage import compile_stage
+from dryad_tpu.parallel.stage import compile_fused, compile_stage
+from dryad_tpu.plan.fuse import (
+    ADAPT_OK_OPS,
+    SHRINKING_OPS,
+    FusedStage,
+    fuse as fuse_plan,
+)
 from dryad_tpu.plan.lower import Stage, StageGraph, StageOp
 from dryad_tpu.utils.config import DryadConfig
 from dryad_tpu.utils.logging import get_logger
@@ -269,6 +276,20 @@ class GraphExecutor:
         the content key (the do_while device path, which builds its
         loop body without operand plumbing and must not share programs
         across table contents)."""
+        if isinstance(stage, FusedStage):
+            # Member-local slot numbers overlap across members, so the
+            # chained-op key alone would alias differently wired
+            # regions; fold the member keys (each with its own
+            # out_slots) plus the region wiring/exports.
+            return (
+                "fused",
+                tuple(
+                    self._stage_key(m, split_operands)
+                    for m in stage.members
+                ),
+                tuple(stage.wiring),
+                tuple(stage.exports),
+            )
         split = split_operands and self.runtime_operands
         parts = []
         for op in stage.ops:
@@ -325,17 +346,26 @@ class GraphExecutor:
         hit = self._compiled.get(key)
         if hit is None:
             t0 = time.monotonic()
-            fn = build_stage_fn(
-                run_stage, self.P, self.config.shuffle_slack, boost,
-                mesh_axes(self.mesh),
-                tuple(self.mesh.shape[a] for a in mesh_axes(self.mesh)),
-                operand_objs=tuple(
-                    stage_operand_objs(run_stage)
-                    if self.runtime_operands else ()
-                ),
+            objs = tuple(
+                stage_operand_objs(run_stage)
+                if self.runtime_operands else ()
             )
+            axes = mesh_axes(self.mesh)
+            sizes = tuple(self.mesh.shape[a] for a in axes)
+            if isinstance(run_stage, FusedStage):
+                fn = build_fused_fn(
+                    run_stage, self.P, self.config.shuffle_slack, boost,
+                    axes, sizes, operand_objs=objs,
+                )
+                compiled = compile_fused(self.mesh, fn)
+            else:
+                fn = build_stage_fn(
+                    run_stage, self.P, self.config.shuffle_slack, boost,
+                    axes, sizes, operand_objs=objs,
+                )
+                compiled = compile_stage(self.mesh, fn)
             hit = _CompileTimed(
-                compile_stage(self.mesh, fn), self, run_stage.name,
+                compiled, self, run_stage.name,
                 _lowering_key_hash(key), time.monotonic() - t0,
             )
             self._compiled[key] = hit
@@ -368,6 +398,23 @@ class GraphExecutor:
         — saving one ~70 ms tunnel round-trip per job versus the
         synchronous check (BASELINE.md).
         """
+        # Whole-DAG fusion (plan.fuse): maximal runs of device-eligible
+        # stages collapse into FusedStage regions — one compiled
+        # program, one dispatch per region.  Per-execute cost is
+        # O(stages); the compile cache keys regions structurally, so
+        # repeated submissions (and the out-of-core driver's cached
+        # chunk plans) reuse fused programs across calls.  Off = the
+        # legacy per-stage path, kept as the differential baseline.
+        if getattr(self.config, "plan_fuse", True) and len(graph.stages) > 1:
+            graph, fuse_report = fuse_plan(
+                graph, self.config,
+                single_axis=len(mesh_axes(self.mesh)) == 1,
+            )
+            for br in fuse_report.breaks:
+                self.events.emit(
+                    "fuse_break", after=br["after"], before=br["before"],
+                    reason=br["reason"],
+                )
         # Topology rides the event log so jobview can redraw the DAG
         # post-hoc — the reference JobBrowser reconstructs the graph
         # from GM logs the same way (``JobBrowser/JOM/jobinfo.cs:62``).
@@ -462,12 +509,10 @@ class GraphExecutor:
 
     # op kinds proven width-insensitive (everything else blocks
     # adaptation: zip/sliding_window/rank/take-style ops depend on row
-    # placement or engine order across the full mesh width)
-    _ADAPT_OK_OPS = frozenset({
-        "select", "where", "project", "exchange_hash", "exchange_range",
-        "resize", "group_reduce", "group_reduce_dense", "local_sort",
-        "join", "scalar_agg", "string_code",
-    })
+    # placement or engine order across the full mesh width).  ONE
+    # definition shared with the fuse pass, whose adapt-seam rule must
+    # mirror this gate (plan.fuse leaves adaptation candidates unfused).
+    _ADAPT_OK_OPS = ADAPT_OK_OPS
 
     def _prepare_width_adapt(self, graph: StageGraph) -> None:
         self._observed_rows: Dict[Tuple[int, int], int] = {}
@@ -507,6 +552,10 @@ class GraphExecutor:
     def _slot_reroutes(stage: Stage, slot: int) -> bool:
         """True when the first op touching ``slot`` is an exchange —
         rows re-route by key, so upstream placement is irrelevant."""
+        if isinstance(stage, FusedStage):
+            # member-local slot numbers make the scan meaningless for a
+            # region; be strict (pins the producer to full width)
+            return False
         for op in stage.ops:
             touched = [
                 op.params.get(k)
@@ -518,6 +567,10 @@ class GraphExecutor:
         return False  # pass-through or unknown: be strict
 
     def _adaptable(self, stage: Stage) -> bool:
+        if isinstance(stage, FusedStage):
+            # a region compiles at its static widths; the fuse pass
+            # leaves genuine adaptation candidates unfused instead
+            return False
         return all(
             op.kind in self._ADAPT_OK_OPS for op in stage.ops
         ) and any(
@@ -561,10 +614,9 @@ class GraphExecutor:
             out_slots=list(stage.out_slots), growth=stage.growth,
         )
 
-    _SHRINKING_OPS = frozenset(
-        {"group_reduce", "group_reduce_dense", "distinct", "scalar_agg",
-         "topk"}
-    )
+    # aggregation-shaped ops that shrink data by orders of magnitude;
+    # shared with plan.fuse (the adapt-seam rule keys on the same set)
+    _SHRINKING_OPS = SHRINKING_OPS
 
     def _drain_for_adapt(self, stage: Stage, window) -> bool:
         """Worth syncing the window early: this stage could adapt its
@@ -825,6 +877,19 @@ class GraphExecutor:
                 "checkpoint save failed for %s: %s", stage.name, e
             )
 
+    @staticmethod
+    def _publish(stage, outs, results) -> None:
+        """Publish a stage's outputs.  Fused regions also alias each
+        export under its ORIGINAL (member stage id, out idx) — callers
+        (context/worker/out-of-core) resolve plan outputs against the
+        PRE-fusion graph they lowered, and fusion must stay invisible
+        to them."""
+        for i in range(len(stage.out_slots)):
+            results[(stage.id, i)] = outs[i]
+        if isinstance(stage, FusedStage):
+            for pos, (mi, oi) in enumerate(stage.exports):
+                results[(stage.members[mi].id, oi)] = outs[pos]
+
     def _resolve_inputs(
         self,
         stage: Stage,
@@ -874,8 +939,7 @@ class GraphExecutor:
                     self.events.emit(
                         "stage_checkpoint_hit", stage=stage.id, name=stage.name
                     )
-                    for i in range(len(stage.out_slots)):
-                        results[(stage.id, i)] = hit[i]
+                    self._publish(stage, hit, results)
                     return
         st = self.stats.setdefault(stage.name, StageStatistics(self.config.outlier_sigmas))
 
@@ -921,6 +985,14 @@ class GraphExecutor:
             self.events.emit(
                 "stage_start", stage=stage.id, name=stage.name, version=version, boost=boost
             )
+            if isinstance(stage, FusedStage):
+                # one dispatch covering the whole region (the
+                # dispatches-per-plan signal jobview/JobMetrics fold)
+                self.events.emit(
+                    "fused_dispatch", stage=stage.id, name=stage.name,
+                    members=len(stage.members), version=version,
+                    boost=boost,
+                )
             t0 = time.time()
             try:
                 faults.registry.maybe_fail(stage.name)
@@ -982,8 +1054,7 @@ class GraphExecutor:
                         # it may have consumed speculative inputs, so a
                         # redo must recompute it (flag None = never the
                         # overflow pivot).
-                        for i in range(len(stage.out_slots)):
-                            results[(stage.id, i)] = outs[i]
+                        self._publish(stage, outs, results)
                         window.append(dict(
                             stage=stage, version=version, boost=boost,
                             fp=fp, flag=overflow if can_overflow else None,
@@ -1101,8 +1172,7 @@ class GraphExecutor:
                 # Deferred readback: checked after the job drains so the
                 # dense fast path keeps its async dispatch.
                 self._pending_miss.append((stage.name, dict_miss))
-            for i, out_idx in enumerate(range(len(stage.out_slots))):
-                results[(stage.id, out_idx)] = outs[i]
+            self._publish(stage, outs, results)
             # a fan-adapted run's outputs sit in a reduced-width layout
             # the fingerprint doesn't describe — never persist them
             # under the full-width identity

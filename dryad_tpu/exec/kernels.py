@@ -1025,6 +1025,81 @@ _KERNELS = {
 }
 
 
+def build_fused_fn(fused, P: int, slack: float, boost: int,
+                   axes: "Tuple[str, ...]" = (AXIS,),
+                   axis_sizes: "Tuple[int, ...]" = (),
+                   operand_objs: "Tuple[Any, ...]" = ()):
+    """Compose a whole fused REGION (``plan.fuse.FusedStage``) into one
+    per-partition function: the member stage fns chain device-resident
+    — member i's output batches feed member j's slots directly in HBM,
+    exchanges at the seams stay ``ops/shuffle`` collectives inside the
+    one ``shard_map`` region, and the driver never touches the
+    boundary.  This body must stay free of host-transfer APIs
+    (``np.asarray`` / ``.item()`` / ``jax.device_get``) — enforced
+    statically by ``tests/test_fuse_lint.py``.
+
+    Overflow/miss contract: the region's overflow flag is the OR over
+    every member's (already mesh-reduced) flag and the dict-miss count
+    is the sum — one seam overflowing retries the WHOLE region at the
+    next palette boost, the same bounded-palette contract as the
+    single-stage path.
+
+    ``operand_objs``: the region's deduplicated OPERAND-registered
+    param objects in ``stage_operand_objs(fused)`` order (the chained
+    member enumeration); each member fn receives exactly its own
+    objects' arrays, so one table shared by two members uploads once.
+    """
+    members = fused.members
+    member_objs = [
+        tuple(stage_operand_objs(m)) if operand_objs else ()
+        for m in members
+    ]
+    member_fns = [
+        build_stage_fn(
+            m, P, slack, boost, axes, axis_sizes,
+            operand_objs=member_objs[i],
+        )
+        for i, m in enumerate(members)
+    ]
+
+    def fn(sharded_inputs, replicated):
+        rep = tuple(replicated)
+        rep_map = {}
+        pos = 0
+        for obj in operand_objs:
+            n = obj.operand_arity
+            rep_map[id(obj)] = rep[pos:pos + n]
+            pos += n
+        if pos != len(rep):
+            raise ValueError(
+                f"fused region {fused.name!r}: {len(rep)} replicated "
+                f"operand arrays for {pos} registered operand slots"
+            )
+        ext = tuple(sharded_inputs)
+        member_outs: List[Tuple] = []
+        overflow = None
+        miss = None
+        for i, mfn in enumerate(member_fns):
+            ins = tuple(
+                ext[src[1]] if src[0] == "ext"
+                else member_outs[src[1]][src[2]]
+                for src in fused.wiring[i]
+            )
+            mrep = tuple(
+                a for obj in member_objs[i] for a in rep_map[id(obj)]
+            )
+            outs, (m_ovf, m_miss) = mfn(ins, mrep)
+            member_outs.append(outs)
+            overflow = m_ovf if overflow is None else (overflow | m_ovf)
+            miss = m_miss if miss is None else (miss + m_miss)
+        region_outs = tuple(
+            member_outs[mi][oi] for mi, oi in fused.exports
+        )
+        return region_outs, (overflow, miss)
+
+    return fn
+
+
 def build_stage_fn(stage, P: int, slack: float, boost: int,
                    axes: "Tuple[str, ...]" = (AXIS,),
                    axis_sizes: "Tuple[int, ...]" = (),

@@ -173,6 +173,12 @@ class JobMetrics:
     quarantines: int = 0
     workers: int = 0  # distinct workers whose telemetry was merged
     spans: int = 0
+    # whole-DAG fusion (plan.fuse): program dispatches per plan
+    # (stage_start attempts), how many covered a fused region, and the
+    # total member stages those regions folded into one program
+    dispatch_count: int = 0
+    fused_dispatches: int = 0
+    fused_member_stages: int = 0
     # coded stage redundancy (redundancy/): spare launches, decode
     # rounds, and completed-but-unused coded output bytes
     coded_launches: int = 0
@@ -204,6 +210,8 @@ class JobMetrics:
             "padding_waste": round(self.padding_waste, 4),
             "retries": self.retries,
             "quarantines": self.quarantines,
+            "dispatch_count": self.dispatch_count,
+            "fused_dispatches": self.fused_dispatches,
             "coded_launches": self.coded_launches,
             "coded_waste_bytes": self.coded_waste_bytes,
         }
@@ -246,6 +254,11 @@ class JobMetrics:
             elif kind == "xla_compile":
                 m.compile_count += 1
                 m.compile_s += ev.get("compile_s", 0.0)
+            elif kind == "stage_start":
+                m.dispatch_count += 1
+            elif kind == "fused_dispatch":
+                m.fused_dispatches += 1
+                m.fused_member_stages += int(ev.get("members", 0) or 0)
             elif kind == "stream_pipeline":
                 m.ingest_stall_s += ev.get("consumer_wait_s", 0.0)
                 m.compute_stall_s += ev.get("producer_wait_s", 0.0)
@@ -290,6 +303,17 @@ def format_attribution(m: JobMetrics) -> List[str]:
         f"spill={m.spill_write_s:.3f}s"
         + (f"  checkpoint={m.checkpoint_s:.3f}s" if m.checkpoint_s else "")
     ]
+    if m.dispatch_count:
+        # dispatch count alongside compile count: the whole-DAG fusion
+        # win is fewer programs launched per plan, not just fewer built
+        lines.append(
+            f"dispatches: {m.dispatch_count}"
+            + (
+                f" ({m.fused_dispatches} fused regions covering "
+                f"{m.fused_member_stages} stages)"
+                if m.fused_dispatches else ""
+            )
+        )
     parts = []
     if m.spill_bytes:
         parts.append(f"spill_bytes={m.spill_bytes}")

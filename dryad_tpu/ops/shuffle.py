@@ -15,6 +15,16 @@ factor — with a row-drop *overflow* flag when a bucket fills.  The
 executor treats overflow as a retryable fault and re-runs the stage with
 a larger ``B`` from a bounded shape palette (the adaptive analog of
 ``DrDynamicDistributor.h:26``'s data-size-driven fan-out).
+
+Under whole-DAG fusion (``plan/fuse.py``) these exchanges also serve as
+the SEAMS between fused member stages: the whole multi-stage region
+compiles as one ``shard_map`` program, so an inter-stage repartition is
+just another ``exchange`` call inside the region — device-resident on
+both sides, no driver boundary — and a seam overflow retries the whole
+region on the same palette.  Placement within a destination partition
+is (source, bucket-position) ordered independent of ``B``, which is
+what keeps results byte-identical across overflow boosts and across
+the fused/staged split.
 """
 
 from __future__ import annotations

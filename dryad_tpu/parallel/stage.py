@@ -45,3 +45,17 @@ def compile_stage(mesh: Mesh, fn: Callable[[Any, Any], Tuple[Any, Any]]):
         **{_CHECK_KW: False},
     )
     return jax.jit(mapped)
+
+
+def compile_fused(mesh: Mesh, fn: Callable[[Any, Any], Tuple[Any, Any]]):
+    """Compile a whole fused multi-stage REGION as one SPMD program.
+
+    The region fn (``exec.kernels.build_fused_fn``) chains member stage
+    bodies with their seam exchanges inside a single ``shard_map``, so
+    the sharded inputs are the region's EXTERNAL inputs and the sharded
+    outputs its exports — the same (sharded, replicated) calling
+    convention as a single stage, which is what lets the executor's
+    dispatch, overflow-window, and operand-pool machinery treat a
+    region exactly like a stage.  One ``jit`` entry here = one compile
+    key and one dispatch per region instead of per stage."""
+    return compile_stage(mesh, fn)

@@ -76,6 +76,50 @@ def explain_stages(graph: StageGraph) -> str:
     return "\n".join(lines)
 
 
+def explain_fusion(graph: StageGraph, config) -> str:
+    """Render the whole-DAG fusion decision (``plan.fuse``): which
+    stages fuse into one dispatched program, and — per broken seam —
+    the ``fuse_break_reason``, so fusion decisions are debuggable
+    without reading the pass."""
+    lines = ["== fusion =="]
+    if not getattr(config, "plan_fuse", True):
+        lines.append(
+            "plan_fuse=off: every stage dispatches as its own program "
+            f"({len(graph.stages)} dispatches)"
+        )
+        return "\n".join(lines)
+    from dryad_tpu.plan.fuse import fuse
+
+    _g, report = fuse(graph, config)
+    names = {s.id: s.name for s in graph.stages}
+    for r in report.regions:
+        if r["fused"]:
+            members = ", ".join(
+                f"stage{sid} ({names.get(sid, '?')[:24]})"
+                for sid in r["members"]
+            )
+            lines.append(
+                f"region f{r['id']}: {len(r['members'])} stages -> ONE "
+                f"dispatch  [{members}]"
+            )
+        else:
+            why = f"  [{r['reason']}]" if r["reason"] else ""
+            lines.append(
+                f"stage {r['members'][0]:<4} "
+                f"{names.get(r['members'][0], '?')[:40]:<40} unfused{why}"
+            )
+    for b in report.breaks:
+        lines.append(
+            f"  seam stage{b['after']} -> stage{b['before']}: "
+            f"{b['reason']}"
+        )
+    lines.append(
+        f"-- {report.n_stages} stages -> {report.n_dispatch_units} "
+        "dispatches"
+    )
+    return "\n".join(lines)
+
+
 def _ref_key(ref, idx) -> str:
     """Stage-graph node key for an input ref: plan inputs are in<idx>,
     producer stages s<id> (shared by the DOT and SVG renderers)."""
@@ -118,11 +162,16 @@ def explain_dot(query) -> str:
 
 
 def explain(query) -> str:
-    """Full explain text for an API ``Query`` (logical + fused stages)."""
+    """Full explain text for an API ``Query`` (logical + fused stages
+    + the whole-DAG fusion regions the executor will dispatch)."""
     from dryad_tpu.plan.lower import lower
 
     graph = lower([query.node], query.ctx.config, query.ctx.dictionary)
-    return explain_logical([query.node]) + "\n\n" + explain_stages(graph)
+    return (
+        explain_logical([query.node])
+        + "\n\n" + explain_stages(graph)
+        + "\n\n" + explain_fusion(graph, query.ctx.config)
+    )
 
 
 def _layered_layout(graph: StageGraph):

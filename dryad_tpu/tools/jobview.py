@@ -47,6 +47,9 @@ class StageInfo:
     # async dispatch (overflow-free stage): seconds is DISPATCH time;
     # device time overlapped downstream stages
     async_dispatch: bool = False
+    # whole-DAG fusion (plan.fuse): >0 when this "stage" is a fused
+    # region covering that many member stages in ONE dispatch
+    fused_members: int = 0
     # per-attempt failure records ({version, kind, backoff, error})
     # folded from stage_failed events — the DrVertexRecord version
     # history, post-mortem
@@ -151,6 +154,9 @@ def _fold_job(events: List[Dict[str, Any]]) -> JobInfo:
             s.completed = True
             s.seconds += ev.get("seconds", 0.0)
             s.async_dispatch = bool(ev.get("async", s.async_dispatch))
+        elif kind == "fused_dispatch":
+            s = stage(ev)
+            s.fused_members = max(s.fused_members, ev.get("members", 0))
         elif kind == "stage_checkpoint_hit":
             s = stage(ev)
             s.completed = True
@@ -345,6 +351,8 @@ def render(job: JobInfo) -> str:
             state = "ckpt" if s.from_checkpoint else "done"
             if s.async_dispatch:
                 state += " (async)"
+        if s.fused_members:
+            state += f" fused[{s.fused_members}]"
         lines.append(
             f"{s.id:>4} {s.name[:40]:<40} {s.versions:>4} {s.failures:>4} "
             f"{s.overflows:>4} {s.stragglers:>4} {s.seconds:>8.3f}  {state}"

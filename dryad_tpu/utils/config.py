@@ -167,6 +167,15 @@ class DryadConfig:
     # recomputation of DrDynamicRangeDistributor.cpp:54-110:
     # copies = sampledSize / dataPerVertex).
     rows_per_vertex: int = _env_int("DRYAD_TPU_ROWS_PER_VERTEX", 1 << 18)
+    # Whole-DAG SPMD fusion (plan.fuse): maximal runs of consecutive
+    # device-eligible stages — including their hash/range exchanges —
+    # compile and dispatch as ONE shard_map region, dropping dispatches
+    # per plan from O(stages) to O(fused regions) and keeping every
+    # inter-stage intermediate in HBM.  Any seam's bucket-overflow flag
+    # retries the WHOLE region at the next palette capacity (same
+    # bounded-palette contract as single-stage overflow).  Off = the
+    # driver-mediated per-stage path, kept as the differential baseline.
+    plan_fuse: bool = _env_bool("DRYAD_TPU_PLAN_FUSE", True)
     # How many overflow-capable stages may be DISPATCHED speculatively
     # before the driver syncs their overflow flags in one batched
     # readback (the GM pump's concurrent vertex management,

@@ -56,8 +56,16 @@ def _expected(tbl):
 
 def test_multiple_shuffles_in_flight(mesh8, tbl):
     """The event log must show k>1 overflow-capable stages DISPATCHED
-    before any drain, and exactly one drain for the window."""
-    ctx = DryadContext(num_partitions_=8)
+    before any drain, and exactly one drain for the window.
+
+    plan_fuse=False: whole-DAG fusion (plan/fuse.py) would collapse
+    this plan into ONE dispatched region — exactly the seam removal it
+    exists for — but this test exercises the speculative window that
+    the per-stage baseline (and any unfused seam: host boundaries,
+    width-adaptation candidates) still relies on."""
+    ctx = DryadContext(
+        num_partitions_=8, config=DryadConfig(plan_fuse=False)
+    )
     ev = _wire(ctx)
     out = _multi_shuffle_query(ctx, tbl).collect()
 

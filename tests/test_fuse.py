@@ -1,0 +1,348 @@
+"""Whole-DAG SPMD fusion (``plan/fuse.py``): the pass itself, the
+fused executor path, and the staged/fused differential.
+
+The pass stitches maximal runs of consecutive device-eligible stages
+into one ``shard_map`` region dispatched once; the driver-mediated
+per-stage path (``plan_fuse=False``) is the baseline every fused run
+must match byte-for-byte — including seam-overflow retries, which
+widen the WHOLE region on the same bounded palette as single-stage
+overflow.
+"""
+
+import numpy as np
+import pytest
+
+from dryad_tpu import DryadConfig, DryadContext
+from dryad_tpu.plan.fuse import FusedStage, fuse
+from dryad_tpu.plan.lower import lower
+
+
+def _assert_tables_byte_identical(a, b):
+    assert sorted(a) == sorted(b), (sorted(a), sorted(b))
+    for col in a:
+        x, y = np.asarray(a[col]), np.asarray(b[col])
+        assert x.dtype == y.dtype, (col, x.dtype, y.dtype)
+        assert x.shape == y.shape, (col, x.shape, y.shape)
+        if x.dtype == object:
+            assert x.tolist() == y.tolist(), col
+        else:
+            assert x.tobytes() == y.tobytes(), f"column {col!r} differs"
+
+
+def _fact(rng, n=3000):
+    return {
+        # wide key domain keeps the int auto-dense rewrite off, so the
+        # group_by emits its hash exchange (a real seam collective)
+        "k": rng.integers(0, 1 << 20, n).astype(np.int32),
+        "v": rng.standard_normal(n).astype(np.float32),
+    }
+
+
+def _pipeline(ctx, fact, dim):
+    a = (
+        ctx.from_arrays(fact)
+        .select(lambda c: {"k": c["k"], "v": c["v"] * 2.0})
+        .group_by("k", {"s": ("sum", "v"), "c": ("count", None)})
+    )
+    d = ctx.from_arrays(dim)
+    return a.join(d, "k").order_by([("s", True), ("k", False)])
+
+
+def _cfg(**kw):
+    # tail_fanout_rows=0 turns the observed-volume width adapter off on
+    # BOTH paths, so the comparison is strictly positional (the adapter
+    # permutes partition placement, never values — covered by the
+    # sorted-bit-exact sweep in test_fuzz_differential)
+    kw.setdefault("tail_fanout_rows", 0)
+    return DryadConfig(**kw)
+
+
+# -- the pass ----------------------------------------------------------------
+
+def test_fusable_run_detection(mesh8):
+    ctx = DryadContext(num_partitions_=8, config=_cfg())
+    rng = np.random.default_rng(0)
+    fact = _fact(rng)
+    dim = {"k": fact["k"][:64].copy(), "w": np.arange(64, dtype=np.int32)}
+    q = _pipeline(ctx, fact, dim)
+    graph = lower([q.node], ctx.config, ctx.dictionary, P=8)
+    assert len(graph.stages) >= 3  # agg chain, dim ingest, join tail
+    fused_graph, report = fuse(graph, ctx.config)
+    assert len(fused_graph.stages) == 1
+    (region,) = fused_graph.stages
+    assert isinstance(region, FusedStage)
+    assert [m.id for m in region.members] == [s.id for s in graph.stages]
+    # plan outputs remapped onto the region's exports
+    (out_ref,) = set(fused_graph.outputs.values())
+    assert out_ref[0] == region.id
+    assert report.n_stages == len(graph.stages)
+    assert report.n_dispatch_units == 1
+    assert not report.breaks
+    # wiring: every member input resolves to an external input or an
+    # EARLIER member (topological order inside the region)
+    for mi, w in enumerate(region.wiring):
+        for src in w:
+            if src[0] == "mem":
+                assert src[1] < mi
+            else:
+                assert 0 <= src[1] < len(region.input_refs)
+
+
+def test_single_stage_plan_not_fused(mesh8):
+    ctx = DryadContext(num_partitions_=8, config=_cfg())
+    out = ctx.from_arrays(
+        {"k": np.arange(64, dtype=np.int32)}
+    ).group_by("k", {"c": ("count", None)}).collect()
+    assert len(out["k"]) == 64
+    kinds = [e["kind"] for e in ctx.events.events()]
+    assert "fused_dispatch" not in kinds
+
+
+# -- fused execution vs the staged baseline ---------------------------------
+
+def test_fused_matches_staged_byte_identical(mesh8):
+    rng = np.random.default_rng(1)
+    fact = _fact(rng)
+    dim = {"k": fact["k"][:64].copy(), "w": np.arange(64, dtype=np.int32)}
+
+    def run(plan_fuse):
+        ctx = DryadContext(
+            num_partitions_=8, config=_cfg(plan_fuse=plan_fuse)
+        )
+        out = _pipeline(ctx, fact, dim).collect()
+        ev = ctx.events.events()
+        return out, sum(1 for e in ev if e["kind"] == "stage_start")
+
+    out_on, d_on = run(True)
+    out_off, d_off = run(False)
+    _assert_tables_byte_identical(out_on, out_off)
+    assert d_on == 1, f"fused plan should dispatch once, got {d_on}"
+    assert d_off >= 3, f"staged baseline should dispatch per stage, got {d_off}"
+
+
+def test_fused_string_operands_match_staged(mesh8):
+    """Auto-dense STRING group_by inside a fused region: the operand
+    tables must flow through build_fused_fn's replicated slicing (one
+    upload shared by the region), byte-identical to staged."""
+    rng = np.random.default_rng(2)
+    n = 1500
+    tbl = {
+        "s": np.array([f"w{int(i):03d}" for i in rng.integers(0, 97, n)],
+                      object),
+        "v": rng.standard_normal(n).astype(np.float32),
+        "k": rng.integers(0, 1 << 20, n).astype(np.int32),
+    }
+
+    def run(plan_fuse):
+        ctx = DryadContext(
+            num_partitions_=8, config=_cfg(plan_fuse=plan_fuse)
+        )
+        g = ctx.from_arrays(tbl).group_by(
+            "s", {"c": ("count", None), "sv": ("sum", "v")}
+        )
+        # self-zip against a second branch so the string stage closes
+        # and the sort tail is a SEPARATE stage — a real multi-stage
+        # region with the operand-bearing stage inside it
+        out = g.zip_(g.project(["s"])).order_by([("c", True), ("sv", False)])
+        return out.collect(), ctx
+
+    out_on, ctx_on = run(True)
+    out_off, _ = run(False)
+    _assert_tables_byte_identical(out_on, out_off)
+    assert any(
+        e["kind"] == "fused_dispatch" for e in ctx_on.events.events()
+    ), "string pipeline should have fused into a region"
+
+
+# -- seam breaks -------------------------------------------------------------
+
+def test_seam_break_on_apply_host(mesh8):
+    rng = np.random.default_rng(3)
+    fact = _fact(rng, 2000)
+
+    def hostfn(cols, i):
+        return {"k": cols["k"], "s": cols["s"] * 2.0}
+
+    ctx = DryadContext(num_partitions_=8, config=_cfg())
+    q = (
+        ctx.from_arrays(fact)
+        .group_by("k", {"s": ("sum", "v")})
+        .apply_host(hostfn)
+        .order_by([("s", True)])
+        .take(50)
+    )
+    graph = lower([q.node], ctx.config, ctx.dictionary, P=8)
+    _g, report = fuse(graph, ctx.config)
+    reasons = [b["reason"] for b in report.breaks]
+    assert any(r == "host_boundary:apply_host" for r in reasons), reasons
+    out = q.collect()
+    ctx_off = DryadContext(
+        num_partitions_=8, config=_cfg(plan_fuse=False)
+    )
+    q2 = (
+        ctx_off.from_arrays(fact)
+        .group_by("k", {"s": ("sum", "v")})
+        .apply_host(hostfn)
+        .order_by([("s", True)])
+        .take(50)
+    )
+    _assert_tables_byte_identical(out, q2.collect())
+
+
+def test_seam_break_on_do_while(mesh8):
+    ctx = DryadContext(num_partitions_=8, config=_cfg())
+    tbl = {"x": np.array([1.0, 2.0], np.float32)}
+
+    def body(q):
+        return q.select(lambda c: {"x": c["x"] * 2})
+
+    def cond(q):
+        return q.aggregate_as_query({"m": ("max", "x")}).select(
+            lambda c: {"go": c["m"] < 100.0}
+        )
+
+    q = (
+        ctx.from_arrays(tbl)
+        .select(lambda c: {"x": c["x"] + 1})
+        .do_while(body, cond, max_iter=20)
+        .select(lambda c: {"x": c["x"] * 10})
+    )
+    graph = lower([q.node], ctx.config, ctx.dictionary, P=8)
+    _g, report = fuse(graph, ctx.config)
+    reasons = [b["reason"] for b in report.breaks]
+    assert any(r == "host_boundary:do_while" for r in reasons), reasons
+    out = q.collect()
+    assert (np.sort(out["x"]) >= 100.0 * 10 / 2).all()
+
+
+def test_seam_break_on_width_adapt(mesh8):
+    """A stage the runtime width adapter could re-width (adaptable
+    shape + shrinking producer, default tail_fanout config) stays
+    unfused — and the adapter still fires on it."""
+    rng = np.random.default_rng(4)
+    n = 9000
+    fact = {"k": rng.integers(0, 6, n).astype(np.int32),
+            "v": np.ones(n, np.float32)}
+    dim = {"k": np.arange(6, dtype=np.int32),
+           "w": (np.arange(6) * 7).astype(np.int32)}
+    ctx = DryadContext(num_partitions_=8)  # DEFAULT config: adapter on
+    s = (
+        ctx.from_arrays(fact)
+        .select(lambda c: {"k": c["k"] * 1000003, "v": c["v"]})
+        .group_by("k", {"s": ("sum", "v")})
+    )
+    d = ctx.from_arrays(dim).select(
+        lambda c: {"k": c["k"] * 1000003, "w": c["w"]}
+    )
+    q = s.join(d, ["k"], ["k"], strategy="shuffle")
+    graph = lower([q.node], ctx.config, ctx.dictionary, P=8)
+    _g, report = fuse(graph, ctx.config)
+    reasons = [b["reason"] for b in report.breaks]
+    assert any(r.startswith("width_adapt") for r in reasons), reasons
+    out = q.collect()
+    ev = ctx.events.events()
+    assert any(e["kind"] == "stage_width_adapt" for e in ev), (
+        "fusion must not swallow the observed-volume adaptation"
+    )
+    assert sorted(out["w"].tolist()) == sorted(dim["w"].tolist())
+
+
+# -- overflow at a seam ------------------------------------------------------
+
+def test_overflow_at_seam_retries_whole_region(mesh8):
+    """Distinct keys + slack=1.0 force a bucket overflow inside the
+    region; the retry must re-dispatch the WHOLE region at the next
+    palette boost and the final result must match the staged path
+    positionally byte-for-byte (hash exchanges and int aggregates are
+    placement-stable across boosts)."""
+    n = 4096
+    tbl = {
+        "k": np.arange(n, dtype=np.int32) - 1,  # includes -1: no dense
+        "w": np.ones(n, np.int64),
+    }
+    dim = {"k": np.arange(0, n, 7, dtype=np.int32) - 1,
+           "t": np.arange(0, n, 7).astype(np.int32)}
+
+    def run(plan_fuse):
+        ctx = DryadContext(
+            num_partitions_=8,
+            config=_cfg(shuffle_slack=1.0, plan_fuse=plan_fuse),
+        )
+        g = ctx.from_arrays(tbl).group_by(
+            "k", {"c": ("count", None), "ws": ("sum", "w")}
+        )
+        out = g.join(ctx.from_arrays(dim), "k").collect()
+        return out, ctx
+
+    out_on, ctx_on = run(True)
+    out_off, _ctx_off = run(False)
+    _assert_tables_byte_identical(out_on, out_off)
+    ev = ctx_on.events.events()
+    fused = [e for e in ev if e["kind"] == "fused_dispatch"]
+    assert fused, "plan should have fused"
+    overflows = [e for e in ev if e["kind"] == "stage_overflow"]
+    assert overflows, "slack=1.0 should have overflowed the exchange"
+    boosts = {e["boost"] for e in fused}
+    assert max(boosts) >= 2, f"region never re-dispatched boosted: {boosts}"
+    assert len(out_on["k"]) == len(dim["k"])
+
+
+# -- observability -----------------------------------------------------------
+
+def test_dispatch_metrics_and_jobview_fold(mesh8):
+    from dryad_tpu.obs.metrics import JobMetrics, format_attribution
+
+    rng = np.random.default_rng(5)
+    fact = _fact(rng, 2000)
+    dim = {"k": fact["k"][:32].copy(), "w": np.arange(32, dtype=np.int32)}
+
+    def run(plan_fuse):
+        ctx = DryadContext(
+            num_partitions_=8, config=_cfg(plan_fuse=plan_fuse)
+        )
+        _pipeline(ctx, fact, dim).collect()
+        return JobMetrics.from_events(ctx.events.events())
+
+    m_on = run(True)
+    m_off = run(False)
+    assert m_on.dispatch_count < m_off.dispatch_count
+    assert m_on.fused_dispatches >= 1
+    assert m_on.fused_member_stages >= 3
+    assert m_off.fused_dispatches == 0
+    att = m_on.attribution()
+    assert att["dispatch_count"] == m_on.dispatch_count
+    assert att["fused_dispatches"] == m_on.fused_dispatches
+    text = "\n".join(format_attribution(m_on))
+    assert "dispatches:" in text and "fused region" in text
+
+
+def test_explain_renders_fusion_regions(mesh8):
+    rng = np.random.default_rng(6)
+    fact = _fact(rng, 1000)
+    dim = {"k": fact["k"][:16].copy(), "w": np.arange(16, dtype=np.int32)}
+    ctx = DryadContext(num_partitions_=8, config=_cfg())
+    text = _pipeline(ctx, fact, dim).explain()
+    assert "== fusion ==" in text
+    assert "ONE dispatch" in text
+    ctx_off = DryadContext(
+        num_partitions_=8, config=_cfg(plan_fuse=False)
+    )
+    text_off = _pipeline(ctx_off, fact, dim).explain()
+    assert "plan_fuse=off" in text_off
+
+
+def test_fused_checkpoint_roundtrip(mesh8, tmp_path):
+    """A fused region checkpoints under its region identity (wiring +
+    exports folded into the fingerprint) and a second submission loads
+    it instead of re-running."""
+    rng = np.random.default_rng(7)
+    fact = _fact(rng, 1200)
+    dim = {"k": fact["k"][:24].copy(), "w": np.arange(24, dtype=np.int32)}
+    cfg = _cfg(checkpoint_dir=str(tmp_path))
+    ctx = DryadContext(num_partitions_=8, config=cfg)
+    out1 = _pipeline(ctx, fact, dim).collect()
+    ctx2 = DryadContext(num_partitions_=8, config=cfg)
+    out2 = _pipeline(ctx2, fact, dim).collect()
+    _assert_tables_byte_identical(out1, out2)
+    kinds = [e["kind"] for e in ctx2.events.events()]
+    assert "stage_checkpoint_hit" in kinds, kinds

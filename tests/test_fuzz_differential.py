@@ -196,3 +196,129 @@ def test_random_pipeline_device_matches_localdebug(seed):
         check(dev, dbg)
     except AssertionError as e:
         raise AssertionError(f"seed={seed} steps={steps}: {e}") from e
+
+
+# -- fused vs staged oracle sweep (whole-DAG fusion, plan/fuse.py) -----------
+#
+# Both paths run the SAME lowered stages with the SAME kernels at the
+# same boosts; fusion only changes how many compiled programs carry
+# them.  So the comparison is BIT-exact per cell — no float tolerance.
+# Rows are canonicalized by their raw byte key first: the two paths may
+# place rows on different partitions (the observed-volume width adapter
+# is a per-stage mechanism the fused path folds away, and a seam
+# overflow boosts the whole region vs one stage, which re-elects range
+# splitters), but the row SET, every byte of every value, and any
+# order_by-established value order must match exactly.
+
+def _canonical_rows(table):
+    names = sorted(table.keys())
+    cols = [np.asarray(table[n]) for n in names]
+    n = len(cols[0]) if cols else 0
+    rows = []
+    for i in range(n):
+        key = []
+        for c in cols:
+            v = c[i]
+            if c.dtype == object:
+                key.append(str(v).encode())
+            else:
+                key.append(c.dtype.str.encode() + v.tobytes())
+        rows.append(tuple(key))
+    return names, sorted(rows)
+
+
+def _assert_byte_identical_rows(a, b, ctxmsg):
+    na, ra = _canonical_rows(a)
+    nb, rb = _canonical_rows(b)
+    assert na == nb, f"{ctxmsg}: columns {na} != {nb}"
+    assert len(ra) == len(rb), f"{ctxmsg}: {len(ra)} vs {len(rb)} rows"
+    for i, (x, y) in enumerate(zip(ra, rb)):
+        assert x == y, f"{ctxmsg}: row {i} differs byte-wise"
+
+
+_FUSE_SEEDS = (3, 11, 19)
+
+
+@pytest.mark.parametrize("seed", _FUSE_SEEDS)
+def test_random_pipeline_fused_matches_staged(seed):
+    """Whole-DAG fusion differential: plan_fuse on vs off over the same
+    random pipelines (string columns included via the group_str /
+    distinct_str steps when drawn)."""
+    from dryad_tpu import DryadConfig
+
+    rng = np.random.default_rng(seed)
+    tbl = _rand_table(rng, int(rng.integers(50, 400)))
+    steps = _build_pipeline(rng, int(rng.integers(2, 6)))
+
+    def run(plan_fuse):
+        ctx = DryadContext(
+            num_partitions_=8, config=DryadConfig(plan_fuse=plan_fuse)
+        )
+        q = ctx.from_arrays(tbl)
+        for name in steps:
+            q = _STEPS[name](q)
+        return q.collect()
+
+    _assert_byte_identical_rows(
+        run(True), run(False), f"seed={seed} steps={steps}"
+    )
+
+
+@pytest.mark.parametrize("seed", _FUSE_SEEDS)
+def test_string_pipeline_fused_matches_staged(seed):
+    """Dictionary-coded STRING aggregation inside a fused region: the
+    operand tables ride the region's replicated inputs; results must be
+    byte-identical to the staged path."""
+    from dryad_tpu import DryadConfig
+
+    rng = np.random.default_rng(seed)
+    tbl = _rand_table(rng, 300)
+
+    def run(plan_fuse):
+        ctx = DryadContext(
+            num_partitions_=8, config=DryadConfig(plan_fuse=plan_fuse)
+        )
+        q = _STEPS["group_str"](_STEPS["where_pos"](ctx.from_arrays(tbl)))
+        return q.order_by([("c", True), ("sv", False)]).collect()
+
+    _assert_byte_identical_rows(run(True), run(False), f"seed={seed}")
+
+
+@pytest.mark.parametrize("seed", _FUSE_SEEDS)
+def test_overflow_retry_fused_matches_staged(seed):
+    """Seam-overflow coverage: slack=1.0 with near-distinct keys forces
+    bucket overflows; the fused path widens the WHOLE region while the
+    staged path widens one stage — results must still be byte-identical
+    (hash exchange placement is boost-stable)."""
+    from dryad_tpu import DryadConfig
+
+    rng = np.random.default_rng(seed)
+    n = 2048
+    tbl = {
+        "k": (rng.permutation(n).astype(np.int32) - 1),
+        "w": rng.integers(-(2 ** 40), 2 ** 40, n).astype(np.int64),
+        "v": rng.standard_normal(n).astype(np.float32),
+    }
+
+    def run(plan_fuse):
+        ctx = DryadContext(
+            num_partitions_=8,
+            config=DryadConfig(shuffle_slack=1.0, plan_fuse=plan_fuse),
+        )
+        g = ctx.from_arrays(tbl).group_by(
+            "k", {"c": ("count", None), "ws": ("sum", "w"),
+                  "sv": ("sum", "v")}
+        )
+        j = g.semi_join(
+            ctx.from_arrays({"k": tbl["k"][::3].copy()}).distinct(), "k"
+        )
+        out = j.collect()
+        overflowed = any(
+            e["kind"] == "stage_overflow" for e in ctx.events.events()
+        )
+        return out, overflowed
+
+    out_on, ovf_on = run(True)
+    out_off, _ovf_off = run(False)
+    assert ovf_on, "slack=1.0 sweep should exercise the overflow retry"
+    _assert_byte_identical_rows(out_on, out_off, f"seed={seed}")
