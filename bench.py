@@ -531,6 +531,146 @@ def ooc_pipeline_speedup_metric(n: int, chunk_rows: int = 1 << 20):
     }
 
 
+# Child body for aggtree_metric: the hybrid (DCN x ICI) mesh needs 8
+# virtual devices, and the parent process may already have initialized
+# its backend with a different device count (CPU fallback pins 1), so
+# the whole matrix runs in a fresh subprocess that forces the mesh
+# shape FIRST and prints one JSON result line.
+_AGGTREE_CHILD = r"""
+import json, os, sys, time
+import numpy as np
+
+from dryad_tpu.parallel.mesh import force_cpu_backend
+
+force_cpu_backend(8)
+
+import jax
+
+try:  # persistent compile cache: reruns skip the pow2-palette compiles
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get("DRYAD_BENCH_JAX_CACHE", "/tmp/dryad_jax_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+except Exception:
+    pass
+
+from dryad_tpu import DryadConfig, DryadContext
+
+nchunks, chunk_rows = int(sys.argv[1]), int(sys.argv[2])
+
+
+def chunks(skew):
+    rng = np.random.default_rng(3)
+    for _ in range(nchunks):
+        if skew == "uniform":  # high cardinality, ~all-distinct
+            k = rng.integers(0, 50 * chunk_rows, chunk_rows)
+        elif skew == "zipf":  # heavy hitters + high-cardinality tail
+            hot = rng.integers(0, 64, chunk_rows // 2)
+            tail = rng.integers(
+                64, 20 * chunk_rows, chunk_rows - chunk_rows // 2
+            )
+            k = np.concatenate([hot, tail])
+            rng.shuffle(k)
+        else:  # dense: every range collapses on device
+            k = rng.integers(0, 4096, chunk_rows)
+        yield {
+            "k": k.astype(np.int64),
+            "v": rng.integers(-1000, 1000, chunk_rows).astype(np.int64),
+        }
+
+
+def run(skew, tree):
+    # combine threshold sized so BOTH paths must fold accumulated
+    # partials mid-stream — the long-stream regime the tree targets
+    # (the flat path's default threshold would defer everything to one
+    # final merge and the comparison would measure nothing)
+    ctx = DryadContext(
+        dcn_slices=2,
+        config=DryadConfig(
+            combine_tree=tree, stream_combine_rows=chunk_rows
+        ),
+    )
+
+    def once():
+        return (
+            ctx.from_stream(chunks(skew))
+            .group_by("k", {"c": ("count", None), "s": ("sum", "v")})
+            .collect()
+        )
+
+    once()  # warm: pays every compile at this shape palette
+    mark = len(ctx.executor.events.events())
+    t0 = time.perf_counter()
+    out = once()
+    dt = time.perf_counter() - t0
+    ev = ctx.executor.events.events()[mark:]
+    comb = [e for e in ev if e["kind"] == "stream_combine"]
+    lev = [e for e in ev if e["kind"] == "combine_tree_level"]
+    deg = [e for e in ev if e["kind"] == "combine_tree_degrade"]
+    return {
+        "rows_per_sec": round(nchunks * chunk_rows / dt, 1),
+        "seconds": round(dt, 3),
+        "out_rows": int(len(out["k"])),
+        "combines": len(comb) + len(lev),
+        "depth": max((e["level"] for e in lev), default=0),
+        "ici_bytes": int(sum(e.get("ici_bytes", 0) for e in comb + lev)),
+        "dcn_bytes": int(sum(e.get("dcn_bytes", 0) for e in comb + lev)),
+        "degraded_fraction": deg[-1]["fraction"] if deg else 0.0,
+    }
+
+
+res = {}
+for skew in ("dense", "zipf", "uniform"):
+    on, off = run(skew, True), run(skew, False)
+    assert on["out_rows"] == off["out_rows"]
+    res[skew] = {"tree": on, "flat": off}
+print(json.dumps(res))
+"""
+
+
+def aggtree_metric(n: int, chunk_rows: int = 1 << 14):
+    """Topology- and distribution-aware combine tree vs the flat merge
+    (exec/combinetree.py) on a hybrid 2-slice DCN x ICI mesh: one
+    streaming high-cardinality group_by at three key-skew levels, tree
+    on vs off.  Reports rows/s per skew, combine count and tree depth,
+    estimated DCN vs ICI combine bytes (the tree's contract: elided
+    intermediate merges, exactly one DCN-crossing fold at the root),
+    and the host-degraded key-range fraction.  Runs on 8 virtual CPU
+    devices in a subprocess (the hybrid mesh needs a device count the
+    parent's probed backend may not have) — byte accounting and merge
+    structure are platform-independent; rows/s is host-relative."""
+    import subprocess
+
+    nchunks = max(3, n // chunk_rows)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-c", _AGGTREE_CHILD,
+         str(nchunks), str(chunk_rows)],
+        capture_output=True, text=True, timeout=max(remaining(), 120),
+        env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"aggtree child rc={out.returncode}: {out.stderr[-2000:]}"
+        )
+    res = json.loads(out.stdout.strip().splitlines()[-1])
+    uni = res["uniform"]["tree"]
+    rows = nchunks * chunk_rows
+    extra = {"skews": res, "chunks": nchunks, "chunk_rows": chunk_rows,
+             "dcn_slices": 2, "devices": 8}
+    for skew, pair in res.items():
+        t, f = pair["tree"], pair["flat"]
+        extra[f"{skew}_speedup"] = round(
+            t["rows_per_sec"] / max(f["rows_per_sec"], 1e-9), 3
+        )
+        extra[f"{skew}_dcn_bytes_saved"] = f["dcn_bytes"] - t["dcn_bytes"]
+    return rep_record(
+        "aggtree_rows_per_sec", rows, [uni["seconds"]], extra
+    )
+
+
 def ooc_wordcount_metric(
     n_words: int, vocab: int = 1 << 14, chunk_bytes: int = 1 << 22
 ):
@@ -1085,6 +1225,12 @@ def child_main() -> None:
              1 << 24 if accel else 1 << 20,
              chunk_rows=1 << 22 if accel else 1 << 17),
          200 if accel else 75, False),
+        # combine tree vs flat merge over a hybrid DCN x ICI mesh
+        # (8 virtual CPU devices in a subprocess on any backend:
+        # merge structure and byte accounting are platform-free)
+        ("aggtree_rows_per_sec",
+         lambda: aggtree_metric(1 << 16, chunk_rows=1 << 13),
+         300, False),
     ]
     if platform in ("tpu", "axon"):
         # The Pallas kernel only truly runs on TPU; elsewhere the number

@@ -67,6 +67,71 @@ from dryad_tpu.utils.logging import get_logger
 log = get_logger("dryad_tpu.cluster.localjob")
 
 
+_MIX64 = np.uint64(0x9E3779B97F4A7C15)
+
+
+def _driver_key_hash(cols, keys) -> np.ndarray:
+    """Row hash over the key columns for similarity HISTOGRAMS only.
+    Placement is driver-local (no cross-process agreement needed), so
+    strings may hash by value without the engine dictionary."""
+    n = len(cols[keys[0]])
+    h = np.full(n, np.uint64(0x84222325), np.uint64)
+    for k in keys:
+        a = cols[k]
+        if a.dtype == object or a.dtype.kind in ("U", "S"):
+            uniq, inv = np.unique(a.astype(object), return_inverse=True)
+            hs = np.asarray(
+                [hash(str(s)) & 0xFFFFFFFFFFFFFFFF for s in uniq],
+                np.uint64,
+            )
+            w = hs[inv]
+        elif a.dtype.kind == "f":
+            w = np.ascontiguousarray(a.astype(np.float64)).view(np.uint64)
+        elif a.dtype.kind == "b":
+            w = a.astype(np.uint64)
+        else:
+            w = a.astype(np.int64).view(np.uint64)
+        h = (h ^ w) * _MIX64
+        h ^= h >> np.uint64(29)
+    return h
+
+
+def _merge_group_state(cols, keys, red) -> Dict[str, np.ndarray]:
+    """Fold one merge group's partial STATE rows by key with the plan's
+    associative reductions (``exec.partial.state_reductions``) — no
+    finalize, so the result is itself a valid partial table."""
+    n = len(cols[keys[0]]) if keys else 0
+    tups = list(zip(*[cols[k].tolist() for k in keys])) if n else []
+    index: Dict[tuple, list] = {}
+    for i, t in enumerate(tups):
+        index.setdefault(t, []).append(i)
+    out: Dict[str, list] = {k: [] for k in keys}
+    for c in red:
+        out[c] = []
+    for t, idxs in index.items():
+        for k, kv in zip(keys, t):
+            out[k].append(kv)
+        ii = np.asarray(idxs)
+        for c, op in red.items():
+            v = cols[c][ii]
+            if op == "sum":
+                out[c].append(v.sum())
+            elif op == "min":
+                out[c].append(v.min())
+            elif op == "max":
+                out[c].append(v.max())
+            elif op == "any":
+                out[c].append(np.any(v))
+            else:  # all
+                out[c].append(np.all(v))
+    res = {k: np.asarray(out[k], dtype=cols[k].dtype) for k in keys}
+    for c in red:
+        # promoted accumulators (int sums widen) keep their width; the
+        # flat root pass narrows to the output schema at finalize
+        res[c] = np.asarray(out[c])
+    return res
+
+
 def _free_port() -> int:
     """Pick a coordinator port from a pid-derived candidate sequence so
     concurrent LocalJobSubmissions on one machine probe DIFFERENT ports
@@ -979,12 +1044,15 @@ class LocalJobSubmission:
                         self.scheduler.cancel(p)
         self.events.emit("vertex_job_complete", seq=seq)
         self._collect_telemetry()
+        part_rows: List[int] = []
         table = self._assemble(
             query, result_rel, list(range(nparts)),
-            dictionary=query.ctx.dictionary,
+            dictionary=query.ctx.dictionary, part_rows=part_rows,
         )
         if merge is not None:
-            table = self._merge_partials(table, merge)
+            table = self._merge_partials(
+                table, merge, part_rows=part_rows, config=query.ctx.config,
+            )
             self.events.emit(
                 "vertex_partials_merged", seq=seq,
                 rows=len(next(iter(table.values()), [])),
@@ -1506,13 +1574,33 @@ class LocalJobSubmission:
             "group_dec", list(node.params["keys"]), dec, query.schema
         ), inner.node
 
-    def _merge_partials(self, table, merge):
+    def _merge_partials(self, table, merge, part_rows=None, config=None):
         """Final merge of assembled per-vertex partial results on the
         driver (the aggregation tree's root; reference
-        ``DrDynamicAggregateManager`` final vertex)."""
+        ``DrDynamicAggregateManager`` final vertex).
+
+        With ``config.combine_tree`` on and per-vertex row boundaries
+        from assembly, grouped partials reduce HIERARCHICALLY first:
+        vertices place into merge groups by key-histogram similarity
+        (``exec.combinetree.plan_groups``), each group's partial state
+        merges un-finalized (level 0), and the flat pass below
+        finalizes over the much smaller pre-merged rows — the driver-
+        side analog of the device combine tree.  Plans carrying
+        "first" skip the tree (its merge is engine-order-sensitive and
+        similarity grouping reorders rows)."""
         kind, keys, plan, out_schema = merge
         if kind == "group_dec":
             return self._merge_dec_partials(table, keys, plan, out_schema)
+        if (
+            kind == "group"
+            and part_rows
+            and sum(1 for r in part_rows if r) > 2
+            and bool(getattr(config, "combine_tree", False))
+            and not any(op == "first" for _out, op, _p in plan)
+        ):
+            table = self._tree_merge_state(
+                table, keys, plan, part_rows, config
+            )
         cols = {k: np.asarray(v) for k, v in table.items()}
         n = len(next(iter(cols.values()), []))
 
@@ -1567,6 +1655,59 @@ class LocalJobSubmission:
             dt = out_schema.field(o).ctype.numpy_dtype
             result[o] = np.asarray(out[o]).astype(dt)
         return result
+
+    def _tree_merge_state(self, table, keys, plan, part_rows, config):
+        """Level-0 of the driver-side combine tree: slice the assembled
+        table back into per-vertex segments, place segments into merge
+        groups by key-histogram similarity, and fold each group's
+        partial STATE (un-finalized, associative reductions only).
+        Returns the concatenated group results — a valid partial table
+        the flat finalizing pass then reduces as the tree root."""
+        from dryad_tpu.exec.combinetree import plan_groups
+        from dryad_tpu.exec.partial import state_reductions
+        from dryad_tpu.obs.metrics import KeyRangeHistogram
+
+        cols = {k: np.asarray(v) for k, v in table.items()}
+        ranges = int(getattr(config, "combine_tree_ranges", 64))
+        h = _driver_key_hash(cols, keys)
+        bounds = np.cumsum([0] + list(part_rows))
+        snaps = []
+        for i in range(len(part_rows)):
+            kr = KeyRangeHistogram(ranges)
+            kr.observe(h[bounds[i]:bounds[i + 1]])
+            snaps.append(kr.snapshot())
+        g = int(getattr(config, "combine_tree_groups", 0) or 0)
+        n_groups = g if g > 0 else max(2, int(len(part_rows) ** 0.5))
+        groups = plan_groups(snaps, n_groups)
+        red = state_reductions(plan)
+        merged = []
+        for gi, members in enumerate(groups):
+            rows = np.concatenate(
+                [np.arange(bounds[m], bounds[m + 1]) for m in members]
+            )
+            seg = {c: v[rows] for c, v in cols.items()}
+            mseg = _merge_group_state(seg, keys, red)
+            merged.append(mseg)
+            self.events.emit(
+                "combine_tree_level", level=0, group=gi,
+                fan_in=len(members),
+                cap_rows=len(next(iter(mseg.values()), [])),
+                bytes=int(sum(v.nbytes for v in seg.values())),
+                ici_bytes=0, dcn_bytes=0, device=False,
+            )
+        out = {
+            c: np.concatenate([m[c] for m in merged])
+            for c in merged[0]
+        }
+        self.events.emit(
+            "combine_tree_level", level=1, fan_in=len(groups),
+            cap_rows=len(next(iter(out.values()), [])),
+            bytes=int(
+                sum(sum(v.nbytes for v in m.values()) for m in merged)
+            ),
+            ici_bytes=0, dcn_bytes=0, device=False,
+        )
+        return out
 
     def _auto_fanout(self, query) -> int:
         """Data-size-driven task count (``DrDynamicRangeDistributor.cpp:
@@ -1688,6 +1829,7 @@ class LocalJobSubmission:
     def _assemble(
         self, query, result_rel: str, part_ids: List[int],
         dictionary: Optional[StringDictionary] = None,
+        part_rows: Optional[List[int]] = None,
     ) -> Dict[str, np.ndarray]:
         """Fetch result partitions through the file server (HTTP range
         reads via the block cache) and decode to a host table."""
@@ -1733,6 +1875,11 @@ class LocalJobSubmission:
         phys = query.schema.device_names()
         if not cols_parts:
             return {n: np.zeros(0) for n in query.schema.names}
+        if part_rows is not None and phys:
+            # per-part row boundaries of the concatenation — lets the
+            # combine-tree merge slice the decoded table back into
+            # per-vertex segments (decode is row-preserving)
+            part_rows.extend(len(p[phys[0]]) for p in cols_parts)
         cols = {
             c: np.concatenate([p[c] for p in cols_parts]) for c in phys
         }

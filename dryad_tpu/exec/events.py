@@ -79,9 +79,15 @@ EVENT_KINDS: Dict[str, str] = {
     "stream_prefetch": "one chunk prefetched; queued, in_flight sample",
     "stream_pipeline": "pipeline close summary; produced, stall seconds",
     "stream_pipeline_error": "prefetch/spill-thread fault; failure_kind",
-    "stream_combine": "partial compaction; device/fan_in or rows_out",
-    "stream_combine_policy": "device->host combine degrade decision",
+    "stream_combine": "partial compaction; device/fan_in or rows_out, "
+                      "plus level/ici_bytes/dcn_bytes collective split",
+    "stream_combine_policy": "combine degrade/reprobe decision; mode",
     "stream_group_done": "streaming group_by finished; chunks/groups",
+    # -- combine tree (exec.combinetree / outofcore / localjob) -----------
+    "combine_tree_level": "one tree merge; level/group/fan_in/cap_rows/"
+                          "bytes/ici_bytes/dcn_bytes/device",
+    "combine_tree_degrade": "key ranges degraded to host; degraded/"
+                            "fraction/chunks",
     "stream_distinct_spill": "distinct switched to Grace spilling; rows",
     # -- observability (obs.span / obs.metrics / executor) ----------------
     "span": "closed hierarchical span; name/cat/span_id/parent_id/dur",

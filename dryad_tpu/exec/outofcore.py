@@ -33,6 +33,13 @@ from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 import numpy as np
 
 from dryad_tpu.columnar.schema import ColumnType, Schema
+from dryad_tpu.exec.combinetree import (
+    CombineTreePlanner,
+    TreeCombiner,
+    TreeShape,
+    batch_bytes,
+    neutral_snapshot,
+)
 from dryad_tpu.exec.partial import (
     MERGEABLE_AGGS,
     finalize_fn,
@@ -41,7 +48,7 @@ from dryad_tpu.exec.partial import (
 )
 from dryad_tpu.exec.pipeline import prefetched
 from dryad_tpu.exec.spill import SpillDir, SpillWriter
-from dryad_tpu.obs.metrics import MetricsRegistry
+from dryad_tpu.obs.metrics import KeyRangeHistogram, MetricsRegistry
 from dryad_tpu.obs.span import Tracer
 from dryad_tpu.plan.nodes import Node, walk
 from dryad_tpu.utils.logging import get_logger
@@ -351,10 +358,15 @@ class _DeviceCombiner:
 
     MAX_FANIN = 64  # bounds single-program arity (trace/compile cost)
 
-    def __init__(self, merge_many, combine_rows: int, emit):
+    def __init__(self, merge_many, combine_rows: int, emit, split=None):
         self._merge_many = merge_many
         self._combine_rows = combine_rows
         self._emit = emit
+        # optional (in_bytes, out_bytes) -> (ici, dcn) estimator
+        # (combinetree.TreeShape.exchange_split): every flat flush pays
+        # a FULL hash exchange, and tagging its collective byte split on
+        # the event puts tree-on and tree-off runs on one scale
+        self._split = split
         self._pending: List[Any] = []
         self.combines = 0
 
@@ -372,11 +384,17 @@ class _DeviceCombiner:
             return True
         in_cap = self._cap()
         fan = len(self._pending)
+        in_bytes = sum(batch_bytes(b) for b in self._pending)
         merged = self._merge_many(self._pending)
         self.combines += 1
         self._pending = [merged]
+        ici, dcn = (
+            self._split(in_bytes, batch_bytes(merged))
+            if self._split else (0, 0)
+        )
         self._emit("stream_combine", cap_rows=merged.capacity,
-                   device=True, fan_in=fan)
+                   device=True, fan_in=fan, level=0,
+                   ici_bytes=ici, dcn_bytes=dcn)
         return merged.capacity < 0.75 * in_cap
 
     def drain(self) -> List[Any]:
@@ -703,6 +721,7 @@ class StreamExecutor:
                 q = self._finalize_query(q, plan, keys, node.schema)
             return self.ctx.run_to_host(q)
 
+        shape = TreeShape(self.ctx.mesh, self.ctx.config)
         nchunks = 0
         for table in self._iter_base(stream):
             n = _chunk_rows(table)
@@ -718,10 +737,19 @@ class StreamExecutor:
             nchunks += 1
             self._emit("stream_chunk", rows=n, partial_rows=rows)
             if acc_rows > self.combine_rows and len(acc) > 1:
+                in_bytes = sum(
+                    int(np.asarray(v).nbytes)
+                    for t in acc for v in t.values()
+                )
                 merged = combine(acc, final=False)
                 acc = [merged]
                 acc_rows = len(next(iter(merged.values()))) if merged else 0
-                self._emit("stream_combine", rows_out=acc_rows)
+                out_bytes = sum(
+                    int(np.asarray(v).nbytes) for v in merged.values()
+                )
+                ici, dcn = shape.exchange_split(in_bytes, out_bytes)
+                self._emit("stream_combine", rows_out=acc_rows, level=0,
+                           ici_bytes=ici, dcn_bytes=dcn)
         if pschema is None:  # empty stream
             return "small", _empty_table(node.schema)
         out = combine(acc, final=True)
@@ -747,16 +775,53 @@ class StreamExecutor:
         machine->pod tree folded onto the accelerator; DrJAX's
         device-resident MapReduce partials).
 
+        With ``config.combine_tree`` on (default), accumulation runs
+        through the topology/distribution-aware tree of
+        :mod:`exec.combinetree`; the flat N-ary combiner below stays as
+        the differential baseline and covers engine-order-sensitive
+        aggregates (``first``), which the tree's similarity routing
+        would reorder."""
+        if bool(getattr(self.ctx.config, "combine_tree", True)) and not any(
+            op == "first" for op, _c, _o in agg_list
+        ):
+            return self._group_partial_tree(node, stream, keys, agg_list)
+        return self._group_partial_flat(node, stream, keys, agg_list)
+
+    def _first_chunk_irreducible(self, table, stream, keys, batch, n):
+        """Static high-cardinality signal for the first chunk: count the
+        chunk's distinct keys with a HOST-side hash (exact, no device
+        readback).  The partial batch's layout capacity is only trusted
+        as a fallback for physical (device-resident) chunks, and only
+        below the chunk's row count — the pow2 palette can pad capacity
+        past n, which says nothing about the keys."""
+        if n <= 0:
+            return False
+        if not is_physical_chunk(table, stream.base_schema):
+            h = _host_key_hash64(table, keys, dictionary=self.ctx.dictionary)
+            return np.unique(h).size >= 0.75 * n
+        return n > batch.capacity >= 0.75 * n
+
+    def _group_partial_flat(self, node, stream, keys, agg_list):
+        """Flat N-ary device combiner (the tree-off baseline).
+
         High-cardinality streams whose merges show no reduction (static
         capacity check in :class:`_DeviceCombiner`) degrade to the
         serial driver's host-side threshold accumulation — on such
         streams device merging re-processes every row for nothing,
-        while host accumulation pays one cheap transfer per chunk."""
+        while host accumulation pays one cheap transfer per chunk.  The
+        degrade is no longer sticky: after
+        ``config.stream_host_reprobe`` CONSECUTIVE host combines that
+        do reduce below the device capacity check, the device path is
+        retried with the merged accumulator re-ingested."""
         partial, plan = partial_plan(agg_list)
         merge_spec = merge_agg_spec(plan)
         scope = self._scope()
         mscope = self._scope()
         pschema = None
+        shape = TreeShape(self.ctx.mesh, self.ctx.config)
+        reprobe_after = int(
+            getattr(self.ctx.config, "stream_host_reprobe", 0) or 0
+        )
 
         def merge_many(batches):
             qs = [self.ctx._from_device_batch(b, pschema) for b in batches]
@@ -770,9 +835,13 @@ class StreamExecutor:
                 q = self._finalize_query(q, plan, keys, node.schema)
             return self.ctx.run_to_host(q)
 
-        comb = _DeviceCombiner(merge_many, self.combine_rows, self._emit)
+        comb = _DeviceCombiner(
+            merge_many, self.combine_rows, self._emit,
+            split=shape.exchange_split,
+        )
         host_acc: Optional[List[Dict[str, np.ndarray]]] = None
         host_rows = 0
+        reduce_streak = 0  # consecutive host combines that DID reduce
         nchunks = 0
         for table in self._iter_base(stream):
             n = _chunk_rows(table)
@@ -785,10 +854,11 @@ class StreamExecutor:
             nchunks += 1
             self._emit("stream_chunk", rows=n, partial_cap=batch.capacity)
             if host_acc is None and nchunks == 1 \
-                    and batch.capacity >= 0.75 * n:
-                # the FIRST partial barely reduced its chunk: keys are
-                # high-cardinality, device merging cannot pay — degrade
-                # before paying even one probe merge
+                    and self._first_chunk_irreducible(table, stream, keys,
+                                                     batch, n):
+                # the FIRST chunk's keys are ~all distinct: device
+                # merging cannot pay — degrade before paying even one
+                # probe merge
                 host_acc = []
                 self._emit("stream_combine_policy", mode="host",
                            chunks=nchunks, static=True)
@@ -810,10 +880,46 @@ class StreamExecutor:
                 host_acc.append(pt)
                 host_rows += len(next(iter(pt.values()))) if pt else 0
             if host_rows > self.combine_rows and len(host_acc) > 1:
+                pre_rows = host_rows
+                in_bytes = sum(
+                    int(np.asarray(v).nbytes)
+                    for t in host_acc for v in t.values()
+                )
                 merged = host_combine(host_acc, final=False)
                 host_acc = [merged]
                 host_rows = len(next(iter(merged.values()))) if merged else 0
-                self._emit("stream_combine", rows_out=host_rows)
+                out_bytes = sum(
+                    int(np.asarray(v).nbytes) for v in merged.values()
+                )
+                ici, dcn = shape.exchange_split(in_bytes, out_bytes)
+                self._emit("stream_combine", rows_out=host_rows, level=0,
+                           ici_bytes=ici, dcn_bytes=dcn)
+                # un-stick the degrade: host combines that keep reducing
+                # mean the keys DO collapse — the earlier no-reduction
+                # signal was transient (skew burst, unlucky first chunk)
+                if host_rows < 0.75 * pre_rows:
+                    reduce_streak += 1
+                else:
+                    reduce_streak = 0
+                if (
+                    reprobe_after
+                    and reduce_streak >= reprobe_after
+                    and host_rows > 0
+                ):
+                    back = self.ctx._execute_device(
+                        mscope.ingest(merged, pschema)
+                    )
+                    self.metrics.add(
+                        "h2d_bytes",
+                        sum(int(np.asarray(v).nbytes)
+                            for v in merged.values()),
+                    )
+                    comb.push(back)
+                    host_acc = None
+                    host_rows = 0
+                    reduce_streak = 0
+                    self._emit("stream_combine_policy", mode="device",
+                               chunks=nchunks, reprobe=True)
         if pschema is None:  # empty stream
             return "small", _empty_table(node.schema)
         if host_acc is not None:
@@ -825,6 +931,179 @@ class StreamExecutor:
             )
             q = self._finalize_query(q, plan, keys, node.schema)
             out = self.ctx.run_to_host(q)
+        self._emit("stream_group_done", chunks=nchunks,
+                   groups=len(next(iter(out.values()))) if out else 0)
+        return "small", out
+
+    def _group_partial_tree(self, node, stream, keys, agg_list):
+        """Combine-tree driver (``exec.combinetree``): chunk partials
+        route into similarity-placed tree groups whose merges ELIDE the
+        hash exchange — partials are co-hash-partitioned on the group
+        keys, so equal keys are already colocated and one local reduce
+        merges them with zero collective bytes.  Only the final
+        merge+finalize query pays a full exchange: on a hybrid mesh the
+        tree exchange's ICI hop, per-slice combine, and exactly one DCN
+        hop last.
+
+        The all-or-nothing host degrade becomes PER-KEY-RANGE: the
+        driver hashes each raw chunk's keys host-side (before ingest),
+        folds them into a :class:`KeyRangeHistogram`, and ranges whose
+        distinct-key estimate tracks their row count — merging cannot
+        reduce them — split out of subsequent chunks and stream to host
+        accumulation, while hot, still-reducing ranges stay on
+        device."""
+        cfg = self.ctx.config
+        partial, plan = partial_plan(agg_list)
+        merge_spec = merge_agg_spec(plan)
+        scope = self._scope()
+        cscope = self._scope()  # degraded-range (cold) chunk plans
+        mscope = self._scope()  # host-side combine plans
+        pschema = None
+        shape = TreeShape(self.ctx.mesh, cfg)
+        ranges = int(getattr(cfg, "combine_tree_ranges", 64))
+        planner = CombineTreePlanner(
+            ranges, float(getattr(cfg, "combine_tree_degrade_ratio", 0.75))
+        )
+        hist = KeyRangeHistogram(ranges)
+
+        def merge_local(batches):
+            # every chunk's partial group_by hash-exchanged on the same
+            # keys over the same mesh, so the batches are co-partitioned
+            # and the merge elides its exchange entirely
+            # (plan.lower._needs_hash_exchange on the assume claim)
+            qs = [self.ctx._from_device_batch(b, pschema) for b in batches]
+            q = qs[0].concat(*qs[1:]).assume_hash_partition(keys)
+            return self.ctx._execute_device(q.group_by(keys, merge_spec))
+
+        def host_combine(tables, final: bool):
+            cat = _concat_tables(tables, pschema)
+            q = mscope.ingest(cat, pschema).group_by(keys, merge_spec)
+            if final:
+                q = self._finalize_query(q, plan, keys, node.schema)
+            return self.ctx.run_to_host(q)
+
+        comb = TreeCombiner(merge_local, shape, self.combine_rows, self._emit)
+        host_acc: List[Dict[str, np.ndarray]] = []
+        host_rows = 0
+        degraded: set = set()
+        nchunks = 0
+        for table in self._iter_base(stream):
+            n = _chunk_rows(table)
+            h = None
+            if not is_physical_chunk(table, stream.base_schema):
+                h = _host_key_hash64(
+                    table, keys, dictionary=self.ctx.dictionary
+                )
+            snap = None
+            if h is not None:
+                ch = KeyRangeHistogram(ranges)
+                ch.observe(h)
+                hist.merge(ch)
+                snap = ch.snapshot()
+            nchunks += 1
+            hot: Optional[Dict[str, Any]] = table
+            if degraded and h is not None:
+                rid = KeyRangeHistogram.range_ids(h, ranges)
+                cold_mask = np.isin(
+                    rid, np.fromiter(degraded, np.int64, len(degraded))
+                )
+                if cold_mask.any():
+                    cold = {
+                        c: np.asarray(v)[cold_mask]
+                        for c, v in table.items()
+                    }
+                    hot = (
+                        {
+                            c: np.asarray(v)[~cold_mask]
+                            for c, v in table.items()
+                        }
+                        if not cold_mask.all() else None
+                    )
+                    cq = self._chunk_partial_query(
+                        cscope, stream, cold, node, keys, partial
+                    )
+                    if pschema is None:
+                        pschema = cq.schema
+                    pt = self.ctx.run_to_host(cq)
+                    host_acc.append(pt)
+                    host_rows += len(next(iter(pt.values()))) if pt else 0
+            if hot is not None:
+                pq = self._chunk_partial_query(
+                    scope, stream, hot, node, keys, partial
+                )
+                if pschema is None:
+                    pschema = pq.schema
+                batch = self.ctx._execute_device(pq)  # stays in HBM
+                self._emit(
+                    "stream_chunk", rows=n, partial_cap=batch.capacity
+                )
+                comb.push(batch, snap or neutral_snapshot(ranges))
+            else:
+                self._emit("stream_chunk", rows=n, partial_cap=0)
+            if host_rows > self.combine_rows and len(host_acc) > 1:
+                in_bytes = sum(
+                    int(np.asarray(v).nbytes)
+                    for t in host_acc for v in t.values()
+                )
+                merged = host_combine(host_acc, final=False)
+                host_acc = [merged]
+                host_rows = len(next(iter(merged.values()))) if merged else 0
+                out_bytes = sum(
+                    int(np.asarray(v).nbytes) for v in merged.values()
+                )
+                ici, dcn = shape.exchange_split(in_bytes, out_bytes)
+                self._emit("stream_combine", rows_out=host_rows, level=0,
+                           ici_bytes=ici, dcn_bytes=dcn)
+            if h is not None:
+                planner.note_cumulative(hist.snapshot())
+                new = planner.degrade_set()
+                if new - degraded:
+                    degraded = new
+                    self._emit(
+                        "combine_tree_degrade", degraded=len(degraded),
+                        fraction=round(planner.degraded_fraction(), 4),
+                        chunks=nchunks,
+                    )
+        if pschema is None:  # empty stream
+            return "small", _empty_table(node.schema)
+        if not host_acc:
+            # pure device path: collapse the survivors to ONE batch with
+            # elided merges first — the root query's exchange pays bytes
+            # proportional to what it ingests, and elided merges are
+            # nearly free, so the root must see the minimum — then run
+            # the one exchanged merge+finalize reduction, with the DCN
+            # hop accounted at the distribution-informed output estimate
+            # (the exchange folds to at most the estimated distinct keys)
+            folded = comb.fold(1)
+            if not folded:  # every chunk was empty
+                return "small", _empty_table(node.schema)
+            root = folded[0]
+            in_bytes = batch_bytes(root)
+            est_rows = (
+                float(hist.distinct_estimates().sum()) if hist.rows else 0.0
+            )
+            per_row = in_bytes / max(int(root.capacity), 1)
+            out_bytes = int(min(in_bytes, per_row * max(est_rows, 1.0)))
+            ici, dcn = shape.exchange_split(in_bytes, out_bytes)
+            self._emit(
+                "combine_tree_level", level=comb.max_level + 1,
+                fan_in=1, cap_rows=int(root.capacity), bytes=in_bytes,
+                ici_bytes=ici, dcn_bytes=dcn, device=True,
+            )
+            q = self.ctx._from_device_batch(root, pschema).group_by(
+                keys, merge_spec
+            )
+            q = self._finalize_query(q, plan, keys, node.schema)
+            out = self.ctx.run_to_host(q)
+        else:
+            # degraded ranges finish host-side: the device remainder
+            # folds once, pays ONE D2H, and merges with the host
+            # accumulator in the final combine
+            folded = comb.fold(1)
+            tables = list(host_acc)
+            if folded:
+                tables.append(self._batch_to_host(folded[0], pschema))
+            out = host_combine(tables, final=True)
         self._emit("stream_group_done", chunks=nchunks,
                    groups=len(next(iter(out.values()))) if out else 0)
         return "small", out
@@ -869,7 +1148,10 @@ class StreamExecutor:
                 q = qs[0].concat(*qs[1:]).aggregate_as_query(merge_spec)
                 return self.ctx._execute_device(q)
 
-            comb = _DeviceCombiner(merge_many, self.combine_rows, self._emit)
+            comb = _DeviceCombiner(
+                merge_many, self.combine_rows, self._emit,
+                split=TreeShape(self.ctx.mesh, self.ctx.config).exchange_split,
+            )
             for table in self._iter_base(stream):
                 pq = chunk_query(table)
                 if pschema is None:
@@ -1492,14 +1774,16 @@ def _bucket_sample(spill: SpillDir, bucket: int, primary: str) -> np.ndarray:
     return np.concatenate(vals) if vals else np.asarray([])
 
 
-def _host_hash_buckets(
-    table, keys, buckets: int, salt: int = 0, dictionary=None
+def _host_key_hash64(
+    table, keys, salt: int = 0, dictionary=None
 ) -> np.ndarray:
-    """Deterministic row hash over the key columns -> bucket ids.
-    Any mixing works as long as both join sides use the same function;
-    equal logical values must produce equal words, so strings hash via
-    the engine dictionary (``Hash64.cs`` precedent) and numerics widen
-    to a canonical 64-bit pattern."""
+    """Deterministic 64-bit row hash over the key columns.  Any mixing
+    works as long as every consumer uses the same function; equal
+    logical values must produce equal words, so strings hash via the
+    engine dictionary (``Hash64.cs`` precedent) and numerics widen to a
+    canonical 64-bit pattern.  Feeds both the exchange bucket ids and
+    the combine-tree key-range histograms (same high bits, coarser
+    modulus), so range-level decisions align with exchange routing."""
     n = len(np.asarray(table[keys[0]]))
     h = np.full(n, np.uint64(0x84222325 + salt * 0x1000193), np.uint64)
     for kcol in keys:
@@ -1518,6 +1802,14 @@ def _host_hash_buckets(
             w = a.astype(np.int64).view(np.uint64)
         h = (h ^ w) * _MIX
         h ^= h >> np.uint64(29)
+    return h
+
+
+def _host_hash_buckets(
+    table, keys, buckets: int, salt: int = 0, dictionary=None
+) -> np.ndarray:
+    """Deterministic row hash over the key columns -> bucket ids."""
+    h = _host_key_hash64(table, keys, salt=salt, dictionary=dictionary)
     return ((h >> np.uint64(33)) % np.uint64(buckets)).astype(np.int64)
 
 

@@ -71,6 +71,25 @@ def merge_agg_spec(plan):
     return spec
 
 
+def state_reductions(plan):
+    """Partial STATE column -> associative host reduction ("sum" /
+    "min" / "max" / "any" / "all") for an intermediate, UN-finalized
+    merge round: mean's sum+count columns both add, lattice ops stay
+    themselves.  "first" has no associative state reduction (order-
+    dependent) and is absent from the mapping — callers must fall back
+    to a flat engine-order merge when the plan carries it."""
+    red = {}
+    for _out, op, pcols in plan:
+        if op == "mean":
+            red[pcols[0]] = "sum"
+            red[pcols[1]] = "sum"
+        elif op in ("sum", "count"):
+            red[pcols[0]] = "sum"
+        elif op in ("min", "max", "any", "all"):
+            red[pcols[0]] = op
+    return red
+
+
 # -- coded combine (redundancy/: k-of-n partial aggregates) -----------------
 
 def align_partials(tables, key_cols, state_cols):

@@ -24,7 +24,9 @@ import dataclasses
 import threading
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
-__all__ = ["MetricsRegistry", "JobMetrics"]
+import numpy as np
+
+__all__ = ["MetricsRegistry", "JobMetrics", "KeyRangeHistogram"]
 
 
 def _labels_key(labels: Dict[str, Any]) -> Tuple:
@@ -118,6 +120,106 @@ class MetricsRegistry:
             events.emit("metrics", **self.snapshot())
 
 
+# -- coarse per-key-range distribution histogram -----------------------------
+
+# HLL-style registers per key range: enough for a reduction-worthiness
+# estimate (does this range's key set recur across chunks?), tiny enough
+# that a snapshot is a plain numpy pair the planner can read per chunk.
+_KR_REGISTERS = 32
+_KR_ALPHA = 0.697  # standard HyperLogLog bias constant for m=32
+
+
+class KeyRangeHistogram:
+    """Coarse per-key-range distribution of a keyed stream.
+
+    Extends the per-partition skew histograms (pow2-bucket ``_Hist``)
+    with the signal distribution-aware combine scheduling needs
+    (PAPERS.md "Chasing Similarity"): ``ranges`` hash-derived key
+    ranges, each carrying a row count (the placement/similarity vector)
+    and an HLL-style distinct-key estimate (the per-range degrade
+    signal — a range whose distinct estimate tracks its row count never
+    reduces under merging, so device combining cannot pay for it).
+
+    Feeds on PRE-computed 64-bit key hashes (the driver hashes raw host
+    chunks before ingest); consumers read :meth:`snapshot` dicts only —
+    never raw tables — which is what ``tests/test_combinetree_lint.py``
+    enforces for the tree planner.
+    """
+
+    __slots__ = ("ranges", "counts", "registers", "rows")
+
+    def __init__(self, ranges: int = 64):
+        if ranges < 2 or ranges & (ranges - 1):
+            raise ValueError("ranges must be a power of two >= 2")
+        self.ranges = ranges
+        self.counts = np.zeros(ranges, np.int64)
+        # per-(range, register) max leading-zero rank
+        self.registers = np.zeros(ranges * _KR_REGISTERS, np.uint8)
+        self.rows = 0
+
+    @staticmethod
+    def range_ids(hashes: np.ndarray, ranges: int) -> np.ndarray:
+        """Key hash -> range id; the SAME derivation the degrade split
+        uses, so a degraded range's rows route consistently."""
+        h = hashes.astype(np.uint64, copy=False)
+        return ((h >> np.uint64(33)) % np.uint64(ranges)).astype(np.int64)
+
+    def observe(self, hashes: np.ndarray) -> None:
+        """Fold one chunk's key hashes (uint64, one per row)."""
+        if len(hashes) == 0:
+            return
+        h = hashes.astype(np.uint64, copy=False)
+        rid = self.range_ids(h, self.ranges)
+        self.counts += np.bincount(rid, minlength=self.ranges)
+        self.rows += len(h)
+        reg = (h & np.uint64(_KR_REGISTERS - 1)).astype(np.int64)
+        w = (h >> np.uint64(5)).astype(np.uint64)
+        # rank = leading-zero count of the 59-bit remainder + 1; the
+        # float64 exponent gives bit_length (exact for rank purposes)
+        bl = np.zeros(len(w), np.int64)
+        nz = w > 0
+        bl[nz] = np.frexp(w[nz].astype(np.float64))[1]
+        rank = np.clip(60 - bl, 1, 60).astype(np.uint8)
+        np.maximum.at(self.registers, rid * _KR_REGISTERS + reg, rank)
+
+    def merge(self, other: "KeyRangeHistogram") -> None:
+        if other.ranges != self.ranges:
+            raise ValueError("key-range histogram resolution mismatch")
+        self.counts += other.counts
+        self.rows += other.rows
+        np.maximum(self.registers, other.registers, out=self.registers)
+
+    def distinct_estimates(self) -> np.ndarray:
+        """Per-range distinct-key estimates (float64)."""
+        m = _KR_REGISTERS
+        regs = self.registers.reshape(self.ranges, m).astype(np.float64)
+        est = _KR_ALPHA * m * m / np.sum(np.exp2(-regs), axis=1)
+        # small-range correction: linear counting on empty registers
+        zeros = np.sum(regs == 0, axis=1)
+        small = (est <= 2.5 * m) & (zeros > 0)
+        with np.errstate(divide="ignore"):
+            lc = m * np.log(np.where(zeros > 0, m / np.maximum(zeros, 1), 1))
+        est = np.where(small, lc, est)
+        return np.minimum(est, self.counts.astype(np.float64))
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Plain-data view: per-range row counts (the similarity /
+        placement vector) and distinct-ratio estimates (the degrade
+        signal).  This dict — not the histogram, not any table — is
+        what combine-tree placement is allowed to read."""
+        counts = self.counts
+        est = self.distinct_estimates()
+        with np.errstate(invalid="ignore"):
+            ratios = np.where(counts > 0, est / np.maximum(counts, 1), 0.0)
+        return {
+            "ranges": self.ranges,
+            "rows": int(self.rows),
+            "counts": counts.copy(),
+            "distinct": est,
+            "reduction_ratios": ratios,
+        }
+
+
 # -- job-level attribution snapshot -----------------------------------------
 
 # span categories that count as LEAF time (mutually exclusive regions);
@@ -184,6 +286,16 @@ class JobMetrics:
     coded_launches: int = 0
     coded_reconstructs: int = 0
     coded_waste_bytes: int = 0
+    # combine tree (exec.combinetree): estimated collective bytes the
+    # stream-combine merges moved over DCN vs ICI (the number the tree
+    # is supposed to shrink), tree merge count and max depth, and the
+    # per-key-range host degrade extent
+    dcn_bytes: int = 0
+    ici_bytes: int = 0
+    tree_combines: int = 0
+    tree_depth: int = 0
+    degraded_ranges: int = 0
+    degraded_fraction: float = 0.0
 
     @property
     def padding_waste(self) -> float:
@@ -214,6 +326,11 @@ class JobMetrics:
             "fused_dispatches": self.fused_dispatches,
             "coded_launches": self.coded_launches,
             "coded_waste_bytes": self.coded_waste_bytes,
+            "dcn_bytes": self.dcn_bytes,
+            "ici_bytes": self.ici_bytes,
+            "tree_combines": self.tree_combines,
+            "tree_depth": self.tree_depth,
+            "degraded_fraction": round(self.degraded_fraction, 4),
         }
 
     # counter names folded from ``metrics`` snapshot events into the
@@ -264,6 +381,12 @@ class JobMetrics:
                 m.compute_stall_s += ev.get("producer_wait_s", 0.0)
             elif kind == "stream_spill":
                 m.spill_rows += int(ev.get("rows", 0) or 0)
+            elif kind == "stream_combine":
+                # flat-path combines carry the same estimated collective
+                # byte split as combine_tree_level, so tree-on vs -off
+                # runs compare on one scale
+                m.dcn_bytes += int(ev.get("dcn_bytes", 0) or 0)
+                m.ici_bytes += int(ev.get("ici_bytes", 0) or 0)
             elif kind == "stream_chunk":
                 m.rows_in += int(ev.get("rows", 0) or 0)
             elif kind in ("stage_failed", "vertex_retry", "coded_retry"):
@@ -276,6 +399,18 @@ class JobMetrics:
                 m.coded_reconstructs += 1
             elif kind == "coded_waste_bytes":
                 m.coded_waste_bytes += int(ev.get("bytes", 0) or 0)
+            elif kind == "combine_tree_level":
+                m.tree_combines += 1
+                m.tree_depth = max(m.tree_depth, int(ev.get("level", 0)) + 1)
+                m.dcn_bytes += int(ev.get("dcn_bytes", 0) or 0)
+                m.ici_bytes += int(ev.get("ici_bytes", 0) or 0)
+            elif kind == "combine_tree_degrade":
+                m.degraded_ranges = max(
+                    m.degraded_ranges, int(ev.get("degraded", 0) or 0)
+                )
+                m.degraded_fraction = max(
+                    m.degraded_fraction, float(ev.get("fraction", 0.0) or 0.0)
+                )
             elif kind == "metrics":
                 src = ev.get("worker", "driver")
                 for c in ev.get("counters", []):
@@ -328,6 +463,18 @@ def format_attribution(m: JobMetrics) -> List[str]:
             f"coded: launches={m.coded_launches} "
             f"reconstructs={m.coded_reconstructs} "
             f"waste={m.coded_waste_bytes}B"
+        )
+    if m.tree_combines or m.dcn_bytes or m.ici_bytes:
+        parts.append(
+            f"combine: dcn={m.dcn_bytes}B ici={m.ici_bytes}B"
+            + (
+                f" tree[{m.tree_combines} merges, depth {m.tree_depth}]"
+                if m.tree_combines else ""
+            )
+            + (
+                f" degraded={m.degraded_fraction:.0%} of key ranges"
+                if m.degraded_ranges else ""
+            )
         )
     if m.workers:
         parts.append(f"worker_telemetry={m.workers} workers")

@@ -148,6 +148,26 @@ def mesh_axes(mesh: Mesh) -> tuple:
     return tuple(mesh.axis_names)
 
 
+def dcn_slice_count(mesh: Optional[Mesh]) -> int:
+    """Number of DCN-connected slice groups — the outer extent of a
+    hybrid mesh, 1 for a flat (single-slice) mesh or no mesh at all.
+    The combine-tree planner sizes its level-0 groups from this: one
+    accumulator per slice keeps every pre-fold merge off the DCN."""
+    if mesh is None or DCN_AXIS not in mesh.axis_names:
+        return 1
+    return int(mesh.shape[DCN_AXIS])
+
+
+def ici_partitions_per_slice(mesh: Optional[Mesh]) -> int:
+    """Partitions reachable over ICI from any one device — the inner
+    extent of a hybrid mesh, or the whole mesh when flat."""
+    if mesh is None:
+        return 1
+    if DCN_AXIS in mesh.axis_names:
+        return int(mesh.shape[AXIS])
+    return num_partitions(mesh)
+
+
 def partition_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(mesh_axes(mesh)))
 

@@ -181,6 +181,23 @@ def _fold_job(events: List[Dict[str, Any]]) -> JobInfo:
             iters = max(iters, ev.get("iter", 0))
         elif kind == "do_while_state_boost":
             state_boost = max(state_boost, ev.get("boost", 0))
+        elif kind == "combine_tree_level":
+            # per-level combine panel: merges / input bytes / estimated
+            # ICI vs DCN collective traffic at each tree level
+            lv = int(ev.get("level", 0))
+            tl = stream_stats.setdefault("tree_levels", {})
+            ent = tl.setdefault(
+                lv, {"merges": 0, "bytes": 0, "ici": 0, "dcn": 0}
+            )
+            ent["merges"] += 1
+            ent["bytes"] += int(ev.get("bytes", 0) or 0)
+            ent["ici"] += int(ev.get("ici_bytes", 0) or 0)
+            ent["dcn"] += int(ev.get("dcn_bytes", 0) or 0)
+        elif kind == "combine_tree_degrade":
+            stream_stats["degraded_fraction"] = max(
+                stream_stats.get("degraded_fraction", 0.0),
+                float(ev.get("fraction", 0.0) or 0.0),
+            )
         elif kind.startswith("stream_"):
             if kind == "stream_chunk":
                 stream_stats["chunks"] = stream_stats.get("chunks", 0) + 1
@@ -371,6 +388,22 @@ def render(job: JobInfo) -> str:
             + (f" ({st['device_combines']} on-device)"
                if st.get("device_combines") else "")
         )
+        if st.get("tree_levels"):
+            # hierarchical combine panel: level 0/1 merges are exchange-
+            # elided (zero collective bytes); the top level is the one
+            # exchanged reduction whose dcn column is the DCN crossing
+            lines.append("combine tree:")
+            for lv in sorted(st["tree_levels"]):
+                e = st["tree_levels"][lv]
+                lines.append(
+                    f"  level {lv}: merges={e['merges']}  "
+                    f"in={e['bytes']}B  ici={e['ici']}B  dcn={e['dcn']}B"
+                )
+            if st.get("degraded_fraction"):
+                lines.append(
+                    f"  degraded key ranges: "
+                    f"{st['degraded_fraction']:.1%} (host accumulation)"
+                )
         if st.get("pipelines"):
             # occupancy = mean chunks in flight over the prefetch
             # samples; the stall breakdown names the slow side

@@ -220,6 +220,38 @@ class DryadConfig:
     # Bounded buffer of the background spill writer, in queued pieces
     # (exec.spill.SpillWriter): backpressure for the scatter phase.
     stream_writer_queue: int = _env_int("DRYAD_TPU_STREAM_WRITER_QUEUE", 8)
+    # Topology- and distribution-aware combine trees (exec.combinetree):
+    # streaming group_by partials accumulate into similarity-placed tree
+    # groups whose level-0 merges ELIDE the hash exchange (partials are
+    # already co-hash-partitioned, so equal keys are colocated and one
+    # local reduce merges them — zero collective bytes), and only the
+    # final fold pays a full exchange (on a hybrid mesh: one ICI hop +
+    # exactly one DCN hop via the tree exchange).  Off = the flat
+    # N-ary-merge combiner, kept as the differential baseline.
+    combine_tree: bool = _env_bool("DRYAD_TPU_COMBINE_TREE", True)
+    # Max batches one tree-group flush folds in a single program
+    # (stable fan-in -> stable shapes -> compile reuse).
+    combine_tree_fan: int = _env_int("DRYAD_TPU_COMBINE_TREE_FAN", 16)
+    # Coarse key-range resolution of the placement/degrade histograms
+    # (obs.metrics.KeyRangeHistogram): key hashes fold into this many
+    # ranges; placement reads per-range counts, degrade reads per-range
+    # distinct-occupancy estimates.  Power of two.
+    combine_tree_ranges: int = _env_int("DRYAD_TPU_COMBINE_TREE_RANGES", 64)
+    # Tree groups (level-0 accumulators).  0 = auto: the DCN slice
+    # count on a hybrid mesh, else 4.
+    combine_tree_groups: int = _env_int("DRYAD_TPU_COMBINE_TREE_GROUPS", 0)
+    # Per-key-range host degrade threshold: a range whose estimated
+    # distinct-key fraction (est. distinct / rows seen) stays at or
+    # above this stops reducing on device and streams to host
+    # accumulation; hot (reducing) ranges stay in the tree.
+    combine_tree_degrade_ratio: float = _env_float(
+        "DRYAD_TPU_COMBINE_TREE_DEGRADE_RATIO", 0.75
+    )
+    # Host-degrade re-probe (flat combiner): after this many CONSECUTIVE
+    # host combines that DO reduce below the device capacity check, the
+    # device path is retried (the degrade decision is no longer sticky).
+    # 0 disables re-probing.
+    stream_host_reprobe: int = _env_int("DRYAD_TPU_STREAM_HOST_REPROBE", 2)
     # Ring-buffer cap for the context EventLog's in-memory mirror
     # (exec.events): long out-of-core jobs emit per-chunk/span events
     # without bound; the file sink (event_log_dir) keeps the full
@@ -287,3 +319,19 @@ class DryadConfig:
             raise ValueError("stream_writer_queue must be >= 1")
         if self.obs_events_mem_cap < 0:
             raise ValueError("obs_events_mem_cap must be >= 0")
+        if self.combine_tree_fan < 2:
+            raise ValueError("combine_tree_fan must be >= 2")
+        if self.combine_tree_ranges < 2 or (
+            self.combine_tree_ranges & (self.combine_tree_ranges - 1)
+        ):
+            raise ValueError(
+                "combine_tree_ranges must be a power of two >= 2"
+            )
+        if self.combine_tree_groups < 0:
+            raise ValueError("combine_tree_groups must be >= 0")
+        if not 0.0 < self.combine_tree_degrade_ratio <= 1.0:
+            raise ValueError(
+                "combine_tree_degrade_ratio must be in (0, 1]"
+            )
+        if self.stream_host_reprobe < 0:
+            raise ValueError("stream_host_reprobe must be >= 0")

@@ -8,11 +8,28 @@ platform selected, so we switch platform via runtime config (must happen
 before the first backend query).
 """
 
+import os
+
 import jax
 
 from dryad_tpu.parallel.mesh import force_cpu_backend
 
 force_cpu_backend(8)
+
+try:
+    # Persistent XLA compile cache: the pow2 shape palette means hundreds
+    # of tests lower the SAME programs into fresh contexts; deduping the
+    # compiles across tests (and across runs) keeps the suite inside the
+    # tier-1 time gate.  Keyed by HLO hash, so sharing the dir with the
+    # bench harness is safe.
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get("DRYAD_TEST_JAX_CACHE", "/tmp/dryad_jax_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+except Exception:  # older jax without the persistent-cache knobs
+    pass
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,332 @@
+"""Topology- and distribution-aware combine trees (exec.combinetree).
+
+Functional coverage of the hierarchical streaming combine path: tree
+results are differentially validated against a host numpy oracle on
+flat AND hybrid meshes; intermediate tree levels must move zero
+collective bytes (exchange elision) with exactly one DCN-accounted
+reduction at the root; per-key-range degradation and the flat path's
+host-degrade re-probe are exercised end to end; and the placement /
+planner units are tested in isolation on synthetic snapshots.
+"""
+
+import numpy as np
+import pytest
+
+from dryad_tpu import DryadConfig, DryadContext
+from dryad_tpu.exec.combinetree import (
+    CombineTreePlanner,
+    MIN_DEGRADE_ROWS,
+    TreeShape,
+    neutral_snapshot,
+    place,
+    plan_groups,
+)
+from dryad_tpu.obs.metrics import KeyRangeHistogram
+
+
+def _events(c, kind):
+    return [e for e in c.executor.events.events() if e["kind"] == kind]
+
+
+def _oracle_counts(chunks):
+    allk = np.concatenate([c["k"] for c in chunks])
+    return np.unique(allk, return_counts=True)
+
+
+def _assert_counts(out, chunks):
+    uk, cnt = _oracle_counts(chunks)
+    order = np.argsort(np.asarray(out["k"]))
+    np.testing.assert_array_equal(np.asarray(out["k"])[order], uk)
+    np.testing.assert_array_equal(
+        np.asarray(out["c"])[order].astype(np.int64), cnt
+    )
+
+
+def _run_group(ctx, chunks, aggs=None):
+    aggs = aggs or {"c": ("count", None)}
+    return (
+        ctx.from_stream(
+            iter([{k: v.copy() for k, v in c.items()} for c in chunks])
+        )
+        .group_by("k", aggs)
+        .collect()
+    )
+
+
+def test_tree_group_matches_oracle_flat_mesh(mesh8):
+    ctx = DryadContext(
+        num_partitions_=8, config=DryadConfig(stream_combine_rows=2000)
+    )
+    rng = np.random.default_rng(0)
+    chunks = [
+        {"k": rng.integers(0, 60, 1500).astype(np.int64),
+         "v": rng.integers(0, 1000, 1500).astype(np.int64)}
+        for _ in range(6)
+    ]
+    out = _run_group(
+        ctx, chunks, {"c": ("count", None), "s": ("sum", "v")}
+    )
+    allk = np.concatenate([c["k"] for c in chunks])
+    allv = np.concatenate([c["v"] for c in chunks])
+    got = {
+        int(k): (int(s), int(c))
+        for k, s, c in zip(out["k"], out["s"], out["c"])
+    }
+    assert set(got) == set(np.unique(allk).tolist())
+    for k, (s, c) in got.items():
+        m = allk == k
+        assert s == int(allv[m].sum())
+        assert c == int(m.sum())
+    levels = _events(ctx, "combine_tree_level")
+    assert levels, "tree path should have merged hierarchically"
+    # intermediate merges are exchange-elided: zero collective bytes
+    assert all(
+        e["ici_bytes"] == 0 and e["dcn_bytes"] == 0
+        for e in levels if e["level"] == 0
+    )
+
+
+def test_tree_hybrid_mesh_single_dcn_crossing(mesh8):
+    ctx = DryadContext(
+        dcn_slices=2, config=DryadConfig(stream_combine_rows=2000)
+    )
+    rng = np.random.default_rng(3)
+    chunks = [
+        {"k": rng.integers(0, 50, 1200).astype(np.int64),
+         "v": np.ones(1200, np.int64)}
+        for _ in range(5)
+    ]
+    out = _run_group(ctx, chunks)
+    _assert_counts(out, chunks)
+    levels = _events(ctx, "combine_tree_level")
+    assert levels
+    top = max(e["level"] for e in levels)
+    crossing = [e for e in levels if e["dcn_bytes"] > 0]
+    # exactly ONE DCN-accounted reduction, and it is the tree root
+    assert len(crossing) == 1
+    assert crossing[0]["level"] == top
+    assert all(
+        e["dcn_bytes"] == 0 and e["ici_bytes"] == 0
+        for e in levels if e["level"] < top
+    )
+
+
+def test_per_range_degrade_stays_bit_exact(mesh8):
+    ctx = DryadContext(
+        num_partitions_=8, config=DryadConfig(stream_combine_rows=4000)
+    )
+    rng = np.random.default_rng(7)
+    chunks = [
+        {"k": rng.integers(0, 5_000_000, 8000).astype(np.int64),
+         "v": np.ones(8000, np.int64)}
+        for _ in range(8)
+    ]
+    out = _run_group(ctx, chunks)
+    _assert_counts(out, chunks)
+    deg = _events(ctx, "combine_tree_degrade")
+    assert deg, "high-cardinality ranges should degrade to host"
+    assert 0.0 < deg[-1]["fraction"] <= 1.0
+    assert deg[-1]["degraded"] >= deg[0]["degraded"]  # monotone
+
+
+def test_skewed_stream_keeps_hot_ranges_on_device(mesh8):
+    """Zipf-ish skew: a few heavy keys plus a high-cardinality tail —
+    the tail degrades, the heavy ranges keep merging on device, and
+    the union is still exact."""
+    ctx = DryadContext(
+        num_partitions_=8, config=DryadConfig(stream_combine_rows=4000)
+    )
+    rng = np.random.default_rng(13)
+    chunks = []
+    for _ in range(8):
+        hot = rng.integers(0, 8, 5000).astype(np.int64)
+        tail = rng.integers(1000, 4_000_000, 6000).astype(np.int64)
+        k = np.concatenate([hot, tail])
+        rng.shuffle(k)
+        chunks.append({"k": k, "v": np.ones(len(k), np.int64)})
+    out = _run_group(ctx, chunks)
+    _assert_counts(out, chunks)
+    deg = _events(ctx, "combine_tree_degrade")
+    assert deg and deg[-1]["fraction"] < 1.0, (
+        "skewed stream must degrade only part of the key space"
+    )
+
+
+def test_host_reprobe_returns_to_device(mesh8):
+    """Satellite: the flat combiner's host degrade is no longer sticky
+    — consecutive reducing host combines re-probe the device path."""
+    cfg = DryadConfig(
+        combine_tree=False, stream_combine_rows=500, stream_host_reprobe=2
+    )
+    ctx = DryadContext(num_partitions_=8, config=cfg)
+    rng = np.random.default_rng(11)
+    first = {"k": np.arange(3000, dtype=np.int64),
+             "v": np.ones(3000, np.int64)}
+    rest = [
+        {"k": rng.integers(0, 3000, 4000).astype(np.int64),
+         "v": np.ones(4000, np.int64)}
+        for _ in range(6)
+    ]
+    chunks = [first] + rest
+    out = _run_group(ctx, chunks)
+    _assert_counts(out, chunks)
+    pol = _events(ctx, "stream_combine_policy")
+    assert any(e.get("static") for e in pol), "first chunk should degrade"
+    assert any(
+        e["mode"] == "device" and e.get("reprobe") for e in pol
+    ), "reducing host combines must re-probe the device path"
+
+
+def test_first_agg_uses_flat_path(mesh8):
+    """'first' merges are engine-order-sensitive; the tree's similarity
+    routing reorders merges, so such plans stay on the flat combiner."""
+    ctx = DryadContext(
+        num_partitions_=8, config=DryadConfig(stream_combine_rows=2000)
+    )
+    rng = np.random.default_rng(5)
+    chunks = [
+        {"k": rng.integers(0, 30, 800).astype(np.int32),
+         "v": rng.integers(0, 100, 800).astype(np.int64)}
+        for _ in range(4)
+    ]
+    out = _run_group(
+        ctx, chunks, {"f": ("first", "v"), "c": ("count", None)}
+    )
+    assert len(out["k"]) == 30
+    assert not _events(ctx, "combine_tree_level")
+
+
+@pytest.mark.slow  # the tier-1 tree-vs-flat gate is the fuzz
+def test_tree_on_off_outputs_identical(mesh8):  # differential's dense regime
+    rng = np.random.default_rng(21)
+    chunks = [
+        {"k": rng.integers(0, 2000, 3000).astype(np.int64),
+         "v": rng.integers(-50, 50, 3000).astype(np.int64)}
+        for _ in range(5)
+    ]
+    outs = []
+    for tree in (True, False):
+        ctx = DryadContext(
+            num_partitions_=8,
+            config=DryadConfig(combine_tree=tree, stream_combine_rows=2000),
+        )
+        out = _run_group(
+            ctx, chunks, {"s": ("sum", "v"), "c": ("count", None)}
+        )
+        order = np.argsort(np.asarray(out["k"]))
+        outs.append({c: np.asarray(v)[order] for c, v in out.items()})
+    for c in outs[0]:
+        np.testing.assert_array_equal(outs[0][c], outs[1][c])
+
+
+# -- planner / placement units ----------------------------------------------
+
+
+def test_key_range_histogram_distinct_estimates():
+    h = KeyRangeHistogram(4)
+    rng = np.random.default_rng(0)
+    # ~20k rows of 32 distinct hash values: distinct est << row count
+    few = rng.integers(0, 32, 20000).astype(np.uint64) * np.uint64(
+        0x9E3779B97F4A7C15
+    )
+    h.observe(few)
+    snap = h.snapshot()
+    assert snap["rows"] == 20000
+    assert sum(snap["counts"]) == 20000
+    assert sum(snap["distinct"]) < 0.05 * snap["rows"]
+    # all-unique hashes: distinct est tracks the row count
+    h2 = KeyRangeHistogram(4)
+    uniq = rng.integers(0, 2**63, 20000, dtype=np.int64).astype(np.uint64)
+    h2.observe(uniq)
+    assert sum(h2.snapshot()["distinct"]) > 0.5 * 20000
+
+
+def test_planner_degrades_only_irreducible_ranges():
+    p = CombineTreePlanner(4, degrade_ratio=0.75)
+    rows = 4 * MIN_DEGRADE_ROWS
+    snap = {
+        "ranges": 4,
+        "rows": rows * 4,
+        "counts": [rows] * 4,
+        # ranges 0/1 collapse hard; ranges 2/3 are ~all-distinct
+        "distinct": [rows * 0.01, rows * 0.2, rows * 0.9, rows * 1.0],
+        "reduction_ratios": [0.01, 0.2, 0.9, 1.0],
+    }
+    p.note_cumulative(snap)
+    assert p.degrade_set() == {2, 3}
+    assert p.degraded_fraction() == 0.5
+    # monotone: an improving estimate cannot un-degrade a range
+    snap["distinct"] = [0.0] * 4
+    p.note_cumulative(snap)
+    assert p.degrade_set() == {2, 3}
+
+
+def test_planner_needs_evidence_floor():
+    p = CombineTreePlanner(2, degrade_ratio=0.75)
+    few = MIN_DEGRADE_ROWS // 2
+    p.note_cumulative({
+        "ranges": 2, "rows": few * 2, "counts": [few, few],
+        "distinct": [few, few], "reduction_ratios": [1.0, 1.0],
+    })
+    assert p.degrade_set() == set()
+
+
+def test_similarity_grouping_separates_distributions():
+    lo = {"counts": [100, 100, 0, 0], "distinct": [5, 5, 0, 0]}
+    hi = {"counts": [0, 0, 100, 100], "distinct": [0, 0, 5, 5]}
+    snaps = [lo, hi, lo, hi, lo, hi]
+    groups = plan_groups(snaps, 2)
+    assert sorted(sorted(g) for g in groups) == [[0, 2, 4], [1, 3, 5]]
+    # placement of a neutral (shapeless) snapshot prefers an empty group
+    assert place(neutral_snapshot(4), [None, [1.0, 0, 0, 0]]) == 0
+
+
+def test_tree_shape_exchange_split():
+    class _Cfg:
+        combine_tree_groups = 0
+        combine_tree_fan = 16
+
+    shape = TreeShape(None, _Cfg())  # no mesh: flat, single partition
+    assert shape.dcn_slices == 1 and shape.ici_partitions == 1
+    assert shape.exchange_split(1000, 100) == (0, 0)
+    shape.dcn_slices, shape.ici_partitions = 2, 4
+    ici, dcn = shape.exchange_split(1000, 100)
+    assert ici == 750  # (p-1)/p of the input volume crosses ICI
+    assert dcn == 50   # (d-1)/d of the REDUCED per-slice volume
+    # DCN never exceeds the input volume even when output >> input
+    assert shape.exchange_split(1000, 10**9)[1] == 500
+
+
+def test_gang_merge_uses_tree(tmp_path):
+    """Driver-side gang partial merge: per-vertex partials group by
+    histogram similarity and fold un-finalized before the root pass."""
+    from dryad_tpu.cluster.localjob import LocalJobSubmission
+
+    rng = np.random.default_rng(2)
+    tbl = {
+        "k": rng.integers(0, 40, 1200).astype(np.int64),
+        "v": rng.integers(0, 100, 1200).astype(np.int64),
+    }
+    with LocalJobSubmission(num_workers=2, devices_per_worker=2) as sub:
+        ctx = DryadContext(num_partitions_=1)
+        # the min agg keeps the plan OFF the coded (linear-only) path,
+        # so the driver's plain partial merge — and its tree — runs
+        q = ctx.from_arrays(tbl).group_by(
+            "k", {"s": ("sum", "v"), "c": ("count", None),
+                  "mn": ("min", "v")}
+        )
+        out = sub.submit_partitioned(q, nparts=6)
+        evs = [
+            e for e in sub.events.events()
+            if e["kind"] == "combine_tree_level"
+        ]
+    assert evs, "gang merge should reduce hierarchically"
+    assert all(e["device"] is False for e in evs)
+    assert max(e["level"] for e in evs) == 1  # group folds + one root
+    uk = np.unique(tbl["k"])
+    assert sorted(np.asarray(out["k"]).tolist()) == uk.tolist()
+    for k, s, c, mn in zip(out["k"], out["s"], out["c"], out["mn"]):
+        m = tbl["k"] == k
+        assert int(s) == int(tbl["v"][m].sum())
+        assert int(c) == int(m.sum())
+        assert int(mn) == int(tbl["v"][m].min())

@@ -322,3 +322,163 @@ def test_overflow_retry_fused_matches_staged(seed):
     out_off, _ovf_off = run(False)
     assert ovf_on, "slack=1.0 sweep should exercise the overflow retry"
     _assert_byte_identical_rows(out_on, out_off, f"seed={seed}")
+
+
+# -- combine tree vs flat oracle sweep (exec/combinetree.py) -----------------
+#
+# The tree reorders WHICH partial batches merge together (similarity
+# placement, per-key-range host degrade, elided intermediate folds) but
+# every aggregate below is order-independent and exact — int64 sums
+# wrap identically mod 2^64, count is a sum of ones, float min/max are
+# lattice ops — so tree on vs off must be BYTE-identical, not just
+# close.  ("first" and float sums are order-sensitive and excluded by
+# construction: the engine routes "first" to the flat path.)
+
+_TREE_AGGS = {
+    "c": ("count", None), "ws": ("sum", "w"),
+    "mn": ("min", "d"), "mx": ("max", "d"),
+}
+
+
+def _stream_chunks(rng, kind, nchunks=3, n=1000):
+    """Chunk generator per key regime; every regime carries an exact
+    int64 payload and a float64 extremum payload.  Sizes stay small —
+    the differential cost is XLA compiles, not rows."""
+    chunks = []
+    for _ in range(nchunks):
+        if kind == "highcard":  # ~all-distinct keys: degrades to host
+            k = rng.integers(0, 60 * n, n).astype(np.int64)
+        elif kind == "skew":  # heavy hitters + high-cardinality tail
+            hot = rng.integers(0, 8, n // 2).astype(np.int64)
+            tail = rng.integers(1000, 40 * n, n - n // 2).astype(np.int64)
+            k = np.concatenate([hot, tail])
+            rng.shuffle(k)
+        else:  # "dense": few keys, everything collapses on device
+            k = rng.integers(0, 100, n).astype(np.int64)
+        chunks.append({
+            "k": k,
+            "w": rng.integers(-(2 ** 52), 2 ** 52, n).astype(np.int64),
+            "d": rng.standard_normal(n) * np.exp(rng.uniform(-80, 80, n)),
+        })
+    return chunks
+
+
+def _run_stream_group(chunks, key, aggs, combine_tree):
+    from dryad_tpu import DryadConfig
+
+    ctx = DryadContext(
+        num_partitions_=8,
+        config=DryadConfig(
+            combine_tree=combine_tree, stream_combine_rows=2000
+        ),
+    )
+    out = (
+        ctx.from_stream(
+            iter([{c: v.copy() for c, v in ch.items()} for ch in chunks])
+        )
+        .group_by(key, aggs)
+        .collect()
+    )
+    return out, ctx
+
+
+def _assert_tree_matches_flat(chunks, key, ctxmsg):
+    on, ctx_on = _run_stream_group(chunks, key, _TREE_AGGS, True)
+    off, _ = _run_stream_group(chunks, key, _TREE_AGGS, False)
+    assert any(
+        e["kind"] == "combine_tree_level"
+        for e in ctx_on.executor.events.events()
+    ), "tree path should have engaged"
+    _assert_byte_identical_rows(on, off, ctxmsg)
+
+
+@pytest.mark.parametrize(
+    "regime",
+    (
+        # dense gates the tree-vs-flat differential in tier-1; the other
+        # regimes (and the multi-seed sweep below) ride the slow suite
+        pytest.param("highcard", marks=pytest.mark.slow),
+        pytest.param("skew", marks=pytest.mark.slow),
+        "dense",
+    ),
+)
+def test_stream_tree_matches_flat(regime):
+    rng = np.random.default_rng(0)
+    chunks = _stream_chunks(rng, regime)
+    _assert_tree_matches_flat(chunks, "k", f"regime={regime}")
+
+
+def _string_chunks(rng, nchunks=3, n=1000):
+    """Skewed stream re-keyed to dictionary-coded strings: host-side
+    placement hashes strings through the shared dictionary while the
+    device merge stays on code ids."""
+    chunks = []
+    for base in _stream_chunks(rng, "skew", nchunks=nchunks, n=n):
+        chunks.append({
+            "s": np.array(
+                [f"u{int(i) % 40000:05d}" for i in base["k"]], object
+            ),
+            "w": base["w"],
+            "d": base["d"],
+        })
+    return chunks
+
+
+def test_stream_tree_string_keys_match_flat():
+    rng = np.random.default_rng(1)
+    _assert_tree_matches_flat(_string_chunks(rng), "s", "string keys")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", (7, 23, 41))
+@pytest.mark.parametrize("regime", ("highcard", "skew"))
+def test_stream_tree_matches_flat_sweep(regime, seed):
+    """Deeper seeded sweep at larger sizes (excluded from tier-1: each
+    pair recompiles the streaming pipeline at bigger shape palettes)."""
+    rng = np.random.default_rng(seed)
+    chunks = _stream_chunks(rng, regime, nchunks=5, n=3000)
+    _assert_tree_matches_flat(chunks, "k", f"regime={regime} seed={seed}")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", (13,))
+def test_stream_tree_string_keys_sweep(seed):
+    rng = np.random.default_rng(seed)
+    _assert_tree_matches_flat(
+        _string_chunks(rng, nchunks=5, n=3000), "s", f"seed={seed}"
+    )
+
+
+def test_gang_coded_stage_unaffected_by_tree():
+    """Composition with coded k-of-n stages: a LINEAR gang plan rides
+    the coded reconstruction (whose union-alignment decode IS the
+    merge), and a lattice-bearing plan rides the driver combine tree —
+    toggling ``combine_tree`` must leave both byte-identical."""
+    from dryad_tpu import DryadConfig
+    from dryad_tpu.cluster.localjob import LocalJobSubmission
+
+    rng = np.random.default_rng(5)
+    tbl = {
+        "k": rng.integers(0, 60, 2000).astype(np.int32),
+        "w": rng.integers(-(2 ** 52), 2 ** 52, 2000).astype(np.int64),
+    }
+
+    def run(sub, combine_tree, linear):
+        ctx = DryadContext(
+            num_partitions_=1,
+            config=DryadConfig(combine_tree=combine_tree),
+        )
+        aggs = {"c": ("count", None), "ws": ("sum", "w")}
+        if not linear:
+            aggs["mn"] = ("min", "w")  # lattice: off the coded path
+        q = ctx.from_arrays(tbl).group_by("k", aggs)
+        return sub.submit_partitioned(q, nparts=5)
+
+    with LocalJobSubmission(num_workers=2, devices_per_worker=2) as sub:
+        for linear in (True, False):
+            on = run(sub, True, linear)
+            kinds = [e["kind"] for e in sub.events.events()]
+            if linear:
+                assert "coded_reconstruct" in kinds
+            off = run(sub, False, linear)
+            _assert_byte_identical_rows(on, off, f"linear={linear}")

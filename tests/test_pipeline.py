@@ -197,7 +197,12 @@ def test_group_identical_to_serial_and_device_combines(mesh8):
     p = {int(k): (round(float(sv), 3), int(cv))
          for k, sv, cv in zip(piped["k"], piped["s"], piped["c"])}
     assert s == p
-    dev = [e for e in _events(c4, "stream_combine") if e.get("device")]
+    dev = [
+        e
+        for e in _events(c4, "stream_combine")
+        + _events(c4, "combine_tree_level")
+        if e.get("device")
+    ]
     assert dev, "device-resident partials must combine on device"
     assert not _events(c1, "stream_combine_policy")
 
@@ -209,7 +214,9 @@ def test_group_high_cardinality_degrades_to_host(mesh8):
          "v": np.ones(1200, np.float32)}
         for _ in range(3)
     ]
-    c = make_ctx(depth=4, combine_rows=1000)
+    # pins the FLAT baseline's all-or-nothing degrade; the default
+    # combine tree degrades per key range instead (test_combinetree)
+    c = make_ctx(depth=4, combine_rows=1000, combine_tree=False)
     out = (
         c.from_stream(iter(chunks))
         .group_by("k", {"c": ("count", None)})
@@ -383,8 +390,10 @@ def test_pipeline_depth_sweep_identical(mesh8):
     the depth∈{1,4} spot checks above)."""
     rng = np.random.default_rng(11)
     chunks = [
+        # int64 x: device-resident combines sum exactly at any merge
+        # order — int32 would ride float32 partials and round past 2^24
         {"k": rng.integers(0, 200, 2000).astype(np.int32),
-         "x": rng.integers(0, 10**6, 2000).astype(np.int32)}
+         "x": rng.integers(0, 10**6, 2000).astype(np.int64)}
         for _ in range(6)
     ]
     base_sort = base_group = None

@@ -532,3 +532,30 @@ def test_jobview_deterministic_failure_diagnosis(mesh8):
     assert job.failed
     notes = diagnose(job)
     assert any("deterministic failure" in n for n in notes)
+
+
+def test_jobview_combine_tree_panel():
+    """Per-level combine-tree panel: level rows accumulate merges and
+    the ICI/DCN byte split; the degraded-range fraction renders when
+    any key range fell back to host accumulation.  Synthetic events —
+    the panel is pure event folding, no engine run needed."""
+    events = [
+        {"kind": "job_start", "stages": 0},
+        {"kind": "combine_tree_level", "level": 0, "group": 0,
+         "fan_in": 3, "cap_rows": 4096, "bytes": 1000,
+         "ici_bytes": 0, "dcn_bytes": 0, "device": True},
+        {"kind": "combine_tree_level", "level": 0, "group": 1,
+         "fan_in": 2, "cap_rows": 2048, "bytes": 500,
+         "ici_bytes": 0, "dcn_bytes": 0, "device": True},
+        {"kind": "combine_tree_level", "level": 1, "fan_in": 2,
+         "cap_rows": 4096, "bytes": 1500, "ici_bytes": 900,
+         "dcn_bytes": 40, "device": True},
+        {"kind": "combine_tree_degrade", "degraded": 8,
+         "fraction": 0.125, "chunks": 5},
+        {"kind": "job_complete"},
+    ]
+    text = render(build_job(events))
+    assert "combine tree:" in text
+    assert "level 0: merges=2" in text
+    assert "level 1: merges=1" in text
+    assert "degraded key ranges: 12" in text  # 12.5%
