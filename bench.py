@@ -1431,7 +1431,32 @@ def supervise() -> None:
     sys.exit(0)
 
 
+def lint_gate() -> None:
+    """--lint-gate: refuse to record numbers from a dirty tree.  A
+    bench result from a tree with unsuppressed graftlint findings is
+    unreproducible evidence (e.g. a host transfer silently serializing
+    the very dispatch loop being measured), so the gate runs the whole
+    static-analysis registry first and exits 2 on any finding."""
+    from dryad_tpu.analysis import engine
+
+    report = engine.run_repo()
+    if not report.ok:
+        for f in report.unsuppressed():
+            print(f.render(), file=sys.stderr)
+        print(
+            f"bench: refusing to record — {len(report.unsuppressed())} "
+            "unsuppressed graftlint finding(s); fix or suppress with a "
+            "reason (python -m dryad_tpu.tools.lint)",
+            file=sys.stderr,
+        )
+        sys.exit(2)
+
+
 def main() -> None:
+    if "--lint-gate" in sys.argv:
+        sys.argv.remove("--lint-gate")
+        if not os.environ.get("DRYAD_BENCH_CHILD"):
+            lint_gate()
     if os.environ.get("DRYAD_BENCH_CHILD"):
         child_main()
     else:

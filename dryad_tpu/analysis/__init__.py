@@ -1,0 +1,34 @@
+"""graftlint: the repo's unified static-analysis subsystem.
+
+Every contract the runtime's fault-tolerance story leans on —
+deterministic vertex re-execution, device-purity of traced bodies,
+pow2-palette shape discipline, registry/schema coherence — is
+mechanically checkable from the AST.  This package holds the checker
+framework (:mod:`.core`), shared AST helpers (:mod:`.astutil`), the
+built-in checkers, and the repo-level runner (:mod:`.engine`).
+
+Entry points:
+
+- ``python -m dryad_tpu.tools.lint`` — the CLI;
+- :func:`dryad_tpu.analysis.engine.run_repo` — programmatic runs;
+- ``tests/test_graftlint.py`` — the tier-1 gate (whole registry over
+  the package, zero unsuppressed findings).
+
+Suppression grammar (reason REQUIRED, unused suppressions reported)::
+
+    risky_line()  # graftlint: disable=<rule>[,<rule>] -- <reason>
+"""
+
+from dryad_tpu.analysis.core import (  # noqa: F401
+    Checker,
+    FileChecker,
+    Finding,
+    Project,
+    Report,
+    SourceFile,
+    Suppression,
+    all_checkers,
+    known_rules,
+    register,
+    run,
+)

@@ -1,0 +1,185 @@
+"""Checker: event-emission discipline against the events.py schema.
+
+Migrated from ``tests/test_event_schema.py`` and extended.  The source
+of truth is ``exec/events.py`` itself — ``EVENT_KINDS`` (kind -> doc)
+and ``EVENT_PAYLOADS`` (kind -> (required keys, optional keys)); the
+old duplicated allowlists in the test file are gone.  Enforced:
+
+- every ``emit("kind", ...)`` / ``_emit("kind", ...)`` literal call
+  site in the package names a kind in ``EVENT_KINDS`` (both
+  directions: documented kinds with no call site are stale);
+- docs are non-empty one-liners;
+- ``EVENT_PAYLOADS`` covers exactly the kinds in ``EVENT_KINDS``;
+- each call site's explicit keyword payload is consistent with the
+  kind's spec: explicit keys stay inside required+optional, and every
+  required key is present (sites forwarding a ``**kwargs`` blob are
+  only checked for the inclusion direction — the blob's keys are not
+  statically visible).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from dryad_tpu.analysis import astutil
+from dryad_tpu.analysis.core import Checker, Finding, Project, register
+
+EVENTS_PATH = "dryad_tpu/exec/events.py"
+
+
+def _payload_specs(
+    tree: ast.Module,
+) -> Optional[Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]]]:
+    raw = astutil.literal_dict(tree, "EVENT_PAYLOADS")
+    if raw is None:
+        return None
+    out = {}
+    for kind, node in raw.items():
+        if not (isinstance(node, ast.Tuple) and len(node.elts) == 2):
+            return None
+        groups = []
+        for part in node.elts:
+            if not isinstance(part, ast.Tuple):
+                return None
+            keys = []
+            for e in part.elts:
+                if not (
+                    isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)
+                ):
+                    return None
+                keys.append(e.value)
+            groups.append(tuple(keys))
+        out[kind] = (groups[0], groups[1])
+    return out
+
+
+def _emit_sites(project: Project):
+    """(kind, src, call node, explicit keys, has **blob) per literal
+    emit site in the package."""
+    for src in project.package_files():
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            name = getattr(f, "attr", None) or getattr(f, "id", "")
+            if name not in ("emit", "_emit"):
+                continue
+            if not (
+                node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                continue
+            keys = tuple(
+                kw.arg for kw in node.keywords if kw.arg is not None
+            )
+            star = any(kw.arg is None for kw in node.keywords)
+            yield node.args[0].value, src, node, keys, star
+
+
+@register
+class EventSchemaChecker(Checker):
+    rule = "event-schema"
+    summary = (
+        "EVENT_KINDS and emit() sites agree both ways; per-kind payload "
+        "keys match EVENT_PAYLOADS"
+    )
+    hint = (
+        "document the kind (one line) in exec/events.py EVENT_KINDS and "
+        "its payload in EVENT_PAYLOADS"
+    )
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        src = project.file(EVENTS_PATH)
+        if src is None:
+            return
+        kinds = astutil.literal_dict(src.tree, "EVENT_KINDS")
+        payloads = _payload_specs(src.tree)
+        if kinds is None or payloads is None:
+            yield self.finding(
+                src.rel,
+                1,
+                "could not parse EVENT_KINDS / EVENT_PAYLOADS literals",
+                hint="keep both schema dicts as plain literals",
+            )
+            return
+        kinds_stmt = astutil.find_assign(src.tree, "EVENT_KINDS")
+        kinds_line = kinds_stmt.lineno if kinds_stmt is not None else 1
+        pay_stmt = astutil.find_assign(src.tree, "EVENT_PAYLOADS")
+        pay_line = pay_stmt.lineno if pay_stmt is not None else 1
+
+        # docs are non-empty one-liners
+        for kind, doc_node in kinds.items():
+            doc = (
+                doc_node.value
+                if isinstance(doc_node, ast.Constant)
+                and isinstance(doc_node.value, str)
+                else None
+            )
+            if doc is None or not doc.strip() or "\n" in doc:
+                yield self.finding(
+                    src.rel,
+                    doc_node.lineno,
+                    f"doc for {kind!r} must be a non-empty one-line "
+                    "string",
+                )
+
+        # payload specs cover exactly the documented kinds
+        for kind in sorted(set(kinds) - set(payloads)):
+            yield self.finding(
+                src.rel,
+                pay_line,
+                f"kind {kind!r} documented in EVENT_KINDS but missing "
+                "from EVENT_PAYLOADS",
+            )
+        for kind in sorted(set(payloads) - set(kinds)):
+            yield self.finding(
+                src.rel,
+                pay_line,
+                f"EVENT_PAYLOADS names unknown kind {kind!r}",
+            )
+
+        emitted: Dict[str, List] = {}
+        for kind, esrc, node, keys, star in _emit_sites(project):
+            emitted.setdefault(kind, [])
+            if kind not in kinds:
+                yield self.finding(
+                    esrc.rel,
+                    node.lineno,
+                    f"emits undocumented kind {kind!r}",
+                )
+                continue
+            spec = payloads.get(kind)
+            if spec is None:
+                continue
+            required, optional = spec
+            allowed = set(required) | set(optional)
+            for k in keys:
+                if k not in allowed:
+                    yield self.finding(
+                        esrc.rel,
+                        node.lineno,
+                        f"{kind!r} payload key {k!r} not in its "
+                        "EVENT_PAYLOADS spec",
+                    )
+            if not star:
+                missing = sorted(set(required) - set(keys))
+                if missing:
+                    yield self.finding(
+                        esrc.rel,
+                        node.lineno,
+                        f"{kind!r} emit site missing required payload "
+                        f"key(s) {missing}",
+                    )
+
+        # documented kinds with no static call site are stale
+        for kind in sorted(set(kinds) - set(emitted)):
+            yield self.finding(
+                src.rel,
+                kinds_line,
+                f"EVENT_KINDS documents kind {kind!r} that no call "
+                "site emits",
+                hint="remove the stale kind or emit it",
+            )

@@ -31,7 +31,7 @@ import os
 import threading
 import time
 from collections import deque
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 # ``kind`` -> one-line schema doc.  Kept in sync with emit() call sites
 # by tests/test_event_schema.py (both directions: every emitted kind is
@@ -137,6 +137,143 @@ EVENT_KINDS: Dict[str, str] = {
     # -- multihost shared quarantine (obs.gang / cluster.scheduler) -------
     "quarantine_delta": "local failure deltas shipped to peer drivers",
     "quarantine_absorbed": "peer failure delta folded into local blacklist",
+}
+
+# ``kind`` -> (required payload keys, optional payload keys).  The
+# graftlint ``event-schema`` checker cross-references every literal
+# emit() call site against this table: explicit keys must stay inside
+# required+optional, and every required key must be present (sites
+# forwarding a ``**kwargs`` blob are checked for inclusion only).
+# Together with EVENT_KINDS this IS the event schema — jobview and the
+# trace tooling may rely on required keys existing on every record.
+EVENT_PAYLOADS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
+    "job_start": (("stages", "topology"), ()),
+    "job_complete": ((), ()),
+    "job_failed": (("failure_kind", "reason"), ("name", "stage")),
+    "stage_start": (("boost", "name", "stage", "version"), ()),
+    "stage_complete": (
+        ("name", "seconds", "stage", "version"),
+        ("async", "deferred"),
+    ),
+    "stage_failed": (
+        ("backoff", "error", "failure_kind", "failures", "name", "stage",
+         "version"),
+        (),
+    ),
+    "stage_overflow": (("boost", "name", "stage", "version"), ()),
+    "stage_straggler": (
+        ("name", "seconds", "stage", "threshold", "version"), (),
+    ),
+    "stage_dispatched": (
+        ("boost", "inflight", "name", "stage", "version"), (),
+    ),
+    "overflow_drain": (("inflight", "stages"), ()),
+    "stage_fanout": (("name", "nparts", "of", "stage"), ()),
+    "fused_dispatch": (("boost", "members", "name", "stage", "version"), ()),
+    "fuse_break": (("after", "before", "reason"), ()),
+    "stage_width_adapt": (
+        ("name", "nparts", "observed_rows", "of", "stage"), (),
+    ),
+    "stage_delay_injected": (("name", "seconds", "stage"), ()),
+    "dict_miss": (("rows", "stage_name"), ()),
+    "stage_checkpoint_hit": (("name", "stage"), ()),
+    "stage_checkpoint_saved": (("name", "path", "stage"), ()),
+    "checkpoint_corrupt": (("error", "name", "path", "stage"), ()),
+    "checkpoint_gc": (("removed",), ()),
+    "do_while_iter": (("iter", "stage"), ()),
+    "do_while_max_iter": (("iters", "stage"), ()),
+    "do_while_state_boost": (("boost", "stage"), ()),
+    "do_while_device_start": (("boost", "stage"), ()),
+    "do_while_device_done": (("iters", "stage"), ()),
+    "do_while_device_fallback": (("reason", "stage"), ()),
+    "apply_host_start": (("stage",), ()),
+    "apply_host_done": (("stage",), ()),
+    "stream_start": (("node",), ()),
+    "stream_chunk": (("rows",), ("partial_cap", "partial_rows")),
+    "stream_spill": (("bucket", "depth", "rows"), ()),
+    "stream_bucket": (("bucket", "depth", "rows"), ()),
+    "stream_bucket_split": (
+        ("bucket", "depth", "mode", "rows"), ("fanout",),
+    ),
+    "stream_store": (("partitions", "path", "rows"), ()),
+    "stream_prefetch": (("in_flight", "pipeline", "queued"), ()),
+    "stream_pipeline": (("depth", "pipeline"), ()),
+    "stream_pipeline_error": (
+        ("error", "failure_kind", "phase", "pipeline"), (),
+    ),
+    "stream_combine": (
+        ("dcn_bytes", "ici_bytes", "level"),
+        ("cap_rows", "device", "fan_in", "rows_out"),
+    ),
+    "stream_combine_policy": (("chunks", "mode"), ("reprobe", "static")),
+    "stream_group_done": (("chunks", "groups"), ()),
+    "combine_tree_level": (
+        ("bytes", "cap_rows", "dcn_bytes", "device", "fan_in",
+         "ici_bytes", "level"),
+        ("group",),
+    ),
+    "combine_tree_degrade": (("chunks", "degraded", "fraction"), ()),
+    "stream_distinct_spill": (("rows",), ()),
+    "span": (
+        ("cat", "dur", "name", "parent_id", "span_id", "thread"), (),
+    ),
+    "metrics": ((), ("counters", "hists")),
+    "xla_compile": (("compile_s", "key", "stage", "trace_s"), ()),
+    "telemetry_merged": (("events", "offsets"), ()),
+    "process_failed": (("computer", "error", "process"), ()),
+    "process_stranded": (("computer", "process"), ()),
+    "process_dispatch": (("computer", "process", "wait_s"), ()),
+    "computer_quarantined": (
+        ("computer", "cooldown", "failures", "probation"), (),
+    ),
+    "computer_probation": (("computer",), ()),
+    "computer_readmitted": (("computer",), ()),
+    "worker_started": (("worker",), ()),
+    "worker_joined": (("worker",), ()),
+    "worker_dead": (("worker",), ()),
+    "gang_run_start": (("seq", "workers"), ()),
+    "gang_run_complete": (("seconds", "seq"), ()),
+    "gang_straggler": (("seconds", "seq", "threshold"), ()),
+    "gang_rebuild": (("dead", "generation", "workers"), ()),
+    "gang_member_lost_mid_job": (("attempt", "dead"), ()),
+    "vertex_job_start": (("nparts", "seq", "speculation"), ()),
+    "vertex_job_complete": (("seq",), ()),
+    "vertex_job_failed": (("failure_kind", "part"), ()),
+    "vertex_complete": (("computer", "part", "seconds"), ()),
+    "vertex_retry": (
+        ("attempt", "backoff", "computer", "error", "failure_kind",
+         "part"),
+        (),
+    ),
+    "vertex_duplicate": (("elapsed", "part", "threshold"), ()),
+    "vertex_duplicate_win": (("part", "seconds", "winner"), ()),
+    "vertex_duplicate_cancel": (("loser", "part"), ()),
+    "vertex_routed": (("inputs", "nparts", "plan_kind"), ()),
+    "vertex_partials_merged": (("rows", "seq"), ()),
+    "assemble_fetch": (("parts", "raw_bytes", "wire_bytes"), ()),
+    "coded_job_start": (("agg", "k", "n", "r", "seq"), ()),
+    "coded_launch": (
+        ("k", "n", "r", "seq", "threshold", "trigger"), (),
+    ),
+    "coded_task_complete": (
+        ("coded", "computer", "parity", "seconds", "seq"), (),
+    ),
+    "coded_task_failed": (
+        ("coded", "error", "failure_kind", "parity", "seq"), (),
+    ),
+    "coded_retry": (("attempt", "coded", "seq"), ()),
+    "coded_cancel": (("canceled", "seq"), ()),
+    "coded_reconstruct": (
+        ("amplification", "exact", "parity_used", "seconds", "seq",
+         "used"),
+        (),
+    ),
+    "coded_waste_bytes": (("bytes", "seq", "unused"), ()),
+    "coded_job_complete": (("seconds", "seq"), ()),
+    "coded_fallback": (("reason",), ()),
+    "worker_killed_injected": (("name", "stage"), ()),
+    "quarantine_delta": (("computer", "count", "src"), ()),
+    "quarantine_absorbed": (("deltas", "source"), ()),
 }
 
 

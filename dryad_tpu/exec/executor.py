@@ -105,7 +105,9 @@ class DeferredFinish:
         failure from a job that simply hung (ADVICE r4)."""
         self._ckpts = []
         self._pending = []
-        self._executor.events.emit("job_failed", reason=reason)
+        self._executor.events.emit(
+            "job_failed", reason=reason, failure_kind="transient"
+        )
 
     def finish(self, host_vals=None) -> None:
         if host_vals is None:
@@ -122,7 +124,9 @@ class DeferredFinish:
             if int(m):
                 self._ckpts = []  # poisoned results: never persist
                 self._executor.events.emit(
-                    "job_failed", reason=f"dict miss in {name}"
+                    "job_failed",
+                    reason=f"dict miss in {name}",
+                    failure_kind="deterministic",
                 )
                 self._executor._raise_miss(name, int(m))
         for stage, fp, outs in self._ckpts:
@@ -1108,7 +1112,7 @@ class GraphExecutor:
                 if terminal:
                     self.events.emit(
                         "job_failed", stage=stage.id, name=stage.name,
-                        failure_kind=kind.value,
+                        failure_kind=kind.value, reason=str(e),
                     )
                     why = (
                         "failed deterministically (identical error "
@@ -1140,7 +1144,11 @@ class GraphExecutor:
                     version=version, boost=boost,
                 )
                 if boost >= 2 ** self.config.max_shuffle_retries:
-                    self.events.emit("job_failed", stage=stage.id, name=stage.name)
+                    self.events.emit(
+                        "job_failed", stage=stage.id, name=stage.name,
+                        failure_kind="resource",
+                        reason="shuffle overflow at max boost",
+                    )
                     # An expansion join that outgrows every boost is
                     # usually a hot-key quadratic blowup — point at the
                     # knob that actually bounds it.

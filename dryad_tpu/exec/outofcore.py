@@ -1187,7 +1187,10 @@ class StreamExecutor:
                 )
                 acc_t = [merged]
                 acc_rows = len(next(iter(merged.values()))) if merged else 0
-                self._emit("stream_combine", rows_out=acc_rows)
+                self._emit(
+                    "stream_combine", rows_out=acc_rows,
+                    level=0, ici_bytes=0, dcn_bytes=0,
+                )
         if pschema is None:
             raise StreamNotSupported("scalar aggregate over an empty stream")
         cat = _concat_tables(acc_t, pschema)
@@ -1284,7 +1287,7 @@ class StreamExecutor:
                         node, [bscope.ingest(t, node.schema).node]
                     )
                     out = self._run_engine(cur)
-                    self._emit("stream_bucket", bucket=b, rows=rows)
+                    self._emit("stream_bucket", bucket=b, depth=0, rows=rows)
                     yield out
             finally:
                 spill.cleanup()
@@ -1637,7 +1640,7 @@ class StreamExecutor:
             for n in tail_nodes:
                 cur = self._clone(n, [cur] + n.inputs[1:])
             out = self._run_engine(cur)
-            self._emit("stream_bucket", bucket=b, rows=rows)
+            self._emit("stream_bucket", bucket=b, depth=0, rows=rows)
             yield out
 
     def _spill_by_hash(self, spill, table, keys, depth, writer=None):

@@ -205,7 +205,7 @@ def group_reduce(
     """
     import os
 
-    if os.environ.get("DRYAD_TPU_SORT_FUSED") == "1":
+    if os.environ.get("DRYAD_TPU_SORT_FUSED") == "1":  # graftlint: disable=kernel-determinism -- opt-in experiment hatch, off by default; constant within a run
         return group_reduce_fused(batch, key_cols, aggs)
     cap = batch.capacity
     sb, v, start, seg, nseg = _segment_layout(batch, key_cols)

@@ -1,100 +1,15 @@
-"""AST lints for the coded-redundancy contracts (pattern of
-``tests/test_operand_lint.py``):
-
-- every ``Decomposable(...)`` constructed anywhere in the package or
-  the test tree with ``linear=True`` must register its identity
-  element (``identity=...``) — the coding layer scales states by
-  generator coefficients, which is only sound when absent keys decode
-  to a true additive zero;
-- the ``redundancy/`` subsystem must stay layer-clean: it may use the
-  partial-aggregation vocabulary (``exec.partial``) and the columnar
-  schema, but must never import the streaming engine
-  (``exec.outofcore``) or the cluster layer that DRIVES it
-  (``cluster.*``) — the dependency points the other way.
+"""Thin wrapper: the coded-redundancy contracts are now the graftlint
+``coded-linearity`` and ``layer-imports`` rules
+(``dryad_tpu/analysis/checks_layering.py``).  Mutation self-tests:
+``tests/test_graftlint_selftest.py``.
 """
 
-import ast
-import pathlib
+import pytest
 
-import dryad_tpu
-
-PKG_ROOT = pathlib.Path(dryad_tpu.__file__).parent
-TEST_ROOT = pathlib.Path(__file__).parent
+from dryad_tpu.analysis import engine
 
 
-def _raises_spans(tree):
-    """Line spans of ``with pytest.raises(...)`` bodies — constructs in
-    there are EXPECTED to violate the contract (negative tests)."""
-    spans = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.With):
-            continue
-        for item in node.items:
-            c = item.context_expr
-            if (
-                isinstance(c, ast.Call)
-                and getattr(c.func, "attr", "") == "raises"
-            ):
-                spans.append((node.lineno, node.end_lineno))
-    return spans
-
-
-def _decomposable_calls(tree):
-    spans = _raises_spans(tree)
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        f = node.func
-        name = getattr(f, "attr", None) or getattr(f, "id", "")
-        if name != "Decomposable":
-            continue
-        if any(lo <= node.lineno <= hi for lo, hi in spans):
-            continue
-        yield node
-
-
-def test_linear_decomposables_register_identity():
-    problems = []
-    for root in (PKG_ROOT, TEST_ROOT):
-        for p in sorted(root.rglob("*.py")):
-            tree = ast.parse(p.read_text(), filename=str(p))
-            for call in _decomposable_calls(tree):
-                kw = {k.arg: k.value for k in call.keywords}
-                lin = kw.get("linear")
-                declared_linear = (
-                    isinstance(lin, ast.Constant) and lin.value is True
-                )
-                if declared_linear and "identity" not in kw:
-                    problems.append(
-                        f"{p}:{call.lineno}: Decomposable(linear=True) "
-                        "without a registered identity element"
-                    )
-    assert not problems, "\n".join(problems)
-
-
-_FORBIDDEN_PREFIXES = (
-    "dryad_tpu.exec.outofcore",
-    "dryad_tpu.cluster",
-)
-
-
-def _imported_modules(tree):
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                yield a.name
-        elif isinstance(node, ast.ImportFrom) and node.module:
-            yield node.module
-
-
-def test_redundancy_layer_is_clean():
-    offenders = []
-    for p in sorted((PKG_ROOT / "redundancy").glob("*.py")):
-        tree = ast.parse(p.read_text(), filename=str(p))
-        for mod in _imported_modules(tree):
-            if any(mod.startswith(f) for f in _FORBIDDEN_PREFIXES):
-                offenders.append(f"{p.name}: imports {mod}")
-    assert not offenders, (
-        "redundancy/ must not depend on the streaming engine or the "
-        f"cluster layer: {offenders}"
-    )
+@pytest.mark.parametrize("rule", ["coded-linearity", "layer-imports"])
+def test_coded_rules_clean(rule):
+    report = engine.run_repo(rules=[rule])
+    assert report.ok, "\n".join(f.render() for f in report.unsuppressed())

@@ -1,134 +1,18 @@
-"""AST lints for the combine-tree layering contracts (pattern of
-``tests/test_fuse_lint.py`` / ``tests/test_coded_lint.py``):
-
-- the device combine path (all of ``exec/combinetree.py`` plus the
-  streaming driver's ``merge_local`` closure) must never call
-  host-transfer APIs (``np.asarray`` / ``.item()`` /
-  ``jax.device_get``): partial batches are accumulated DEVICE-RESIDENT
-  and one such call would sync the dispatch loop per merge;
-- ``exec/combinetree.py`` must never import ``cluster.*`` — the gang
-  driver imports the PLANNER from here, not the other way around;
-- placement decisions (``place`` / ``plan_groups`` / ``_cosine`` and
-  the :class:`CombineTreePlanner` methods) read histogram SNAPSHOT
-  dicts only — never batch payloads (``.data`` / ``.valid`` /
-  ``.to_numpy``) — so routing can never depend on device readback.
+"""Thin wrapper: the combine-tree layering contracts are now the
+graftlint ``layer-imports``, ``placement-snapshot``, and
+``host-transfer`` rules (``dryad_tpu/analysis/checks_layering.py`` /
+``checks_fusion.py``).  Mutation self-tests:
+``tests/test_graftlint_selftest.py``.
 """
 
-import ast
-import inspect
+import pytest
 
-from dryad_tpu.exec import combinetree as CT
-from dryad_tpu.exec import outofcore as OOC
-
-# attribute calls that move data to the host (or bake host constants)
-_HOST_TRANSFER_ATTRS = {"asarray", "item", "device_get"}
+from dryad_tpu.analysis import engine
 
 
-def _fn_ast(module, name):
-    tree = ast.parse(inspect.getsource(module))
-    for n in ast.walk(tree):
-        if isinstance(n, ast.FunctionDef) and n.name == name:
-            return n
-    raise AssertionError(f"{name} not found in {module.__name__}")
-
-
-def _host_transfer_calls(node):
-    """(lineno, rendered call) for every host-transfer attribute call
-    in the subtree; ``jnp.asarray`` is a trace op and exempt."""
-    hits = []
-    for n in ast.walk(node):
-        if not (isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)):
-            continue
-        attr = n.func.attr
-        if attr not in _HOST_TRANSFER_ATTRS:
-            continue
-        base = n.func.value
-        base_name = base.id if isinstance(base, ast.Name) else None
-        if attr == "asarray" and base_name == "jnp":
-            continue  # traced, stays on device
-        hits.append((n.lineno, f"{base_name or '<expr>'}.{attr}()"))
-    return hits
-
-
-def test_combinetree_module_free_of_host_transfers():
-    tree = ast.parse(inspect.getsource(CT))
-    hits = _host_transfer_calls(tree)
-    assert not hits, (
-        "host-transfer API inside exec/combinetree.py: "
-        + "; ".join(f"line {ln}: {c}" for ln, c in hits)
-    )
-
-
-def test_tree_merge_closure_free_of_host_transfers():
-    """The driver's ``merge_local`` closure is the function the tree
-    calls per merge — a host transfer there syncs EVERY tree level."""
-    driver = _fn_ast(OOC, "_group_partial_tree")
-    closures = [
-        n for n in ast.walk(driver)
-        if isinstance(n, ast.FunctionDef) and n.name == "merge_local"
-    ]
-    assert closures, "merge_local closure not found in _group_partial_tree"
-    hits = _host_transfer_calls(closures[0])
-    assert not hits, (
-        "host-transfer API inside the tree merge closure: "
-        + "; ".join(f"line {ln}: {c}" for ln, c in hits)
-    )
-
-
-def _imported_modules(tree):
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                yield a.name
-        elif isinstance(node, ast.ImportFrom) and node.module:
-            yield node.module
-
-
-def test_combinetree_never_imports_cluster():
-    tree = ast.parse(inspect.getsource(CT))
-    offenders = [
-        mod for mod in _imported_modules(tree)
-        if mod.startswith("dryad_tpu.cluster")
-    ]
-    assert not offenders, (
-        "exec/combinetree.py must not depend on the cluster layer "
-        f"(the gang driver imports the planner, not vice versa): "
-        f"{offenders}"
-    )
-
-
-# attribute reads that would let placement peek at batch payloads
-_PAYLOAD_ATTRS = {"data", "valid", "to_numpy"}
-
-# every placement/planning surface that must stay snapshot-only
-_PLACEMENT_FNS = ("place", "plan_groups", "_cosine")
-
-
-def _attr_reads(node):
-    return [
-        (n.lineno, n.attr)
-        for n in ast.walk(node)
-        if isinstance(n, ast.Attribute) and n.attr in _PAYLOAD_ATTRS
-    ]
-
-
-def test_placement_reads_snapshots_only():
-    offenders = []
-    for name in _PLACEMENT_FNS:
-        offenders += [
-            (name, ln, attr)
-            for ln, attr in _attr_reads(_fn_ast(CT, name))
-        ]
-    tree = ast.parse(inspect.getsource(CT))
-    planner = next(
-        n for n in ast.walk(tree)
-        if isinstance(n, ast.ClassDef) and n.name == "CombineTreePlanner"
-    )
-    offenders += [
-        ("CombineTreePlanner", ln, attr) for ln, attr in _attr_reads(planner)
-    ]
-    assert not offenders, (
-        "placement/planning must read histogram snapshots only, never "
-        "batch payloads: "
-        + "; ".join(f"{w}:{ln} .{a}" for w, ln, a in offenders)
-    )
+@pytest.mark.parametrize(
+    "rule", ["layer-imports", "placement-snapshot", "host-transfer"]
+)
+def test_combinetree_rules_clean(rule):
+    report = engine.run_repo(rules=[rule])
+    assert report.ok, "\n".join(f.render() for f in report.unsuppressed())

@@ -1,70 +1,19 @@
-"""Event-schema lint: the EVENT_KINDS registry vs emit() call sites.
-
-``exec/events.py`` documents every event kind the package emits; this
-test statically cross-references that registry against the actual
-``emit("kind", ...)`` / ``_emit("kind", ...)`` call sites across the
-package (AST scan, no execution) in BOTH directions, so the schema doc
-cannot rot as kinds are added or retired.
+"""Thin wrapper: the event-schema contract is now the graftlint
+``event-schema`` rule (``dryad_tpu/analysis/checks_events.py``).  The
+source of truth is ``exec/events.py`` itself — ``EVENT_KINDS`` +
+``EVENT_PAYLOADS`` — so the old duplicated allowlists here are gone,
+and per-kind payload-key consistency is enforced too.  Mutation
+self-tests: ``tests/test_graftlint_selftest.py``.
 """
 
-import ast
-import pathlib
-
-import dryad_tpu
-from dryad_tpu.exec.events import EVENT_KINDS
-
-PKG_ROOT = pathlib.Path(dryad_tpu.__file__).parent
-
-# emitted through EventLog.absorb / dynamic kinds, or emitted by code
-# outside the package (none today) — extend deliberately, with a reason
-ALLOWED_UNDOCUMENTED: set = set()
-# documented kinds that no static literal call site produces (e.g.
-# emitted with a computed kind) — none today
-ALLOWED_UNEMITTED: set = set()
+from dryad_tpu.analysis import engine
+from dryad_tpu.exec.events import EVENT_KINDS, EVENT_PAYLOADS
 
 
-def _emitted_kinds():
-    kinds = {}
-    for p in PKG_ROOT.rglob("*.py"):
-        tree = ast.parse(p.read_text(), filename=str(p))
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            f = node.func
-            name = getattr(f, "attr", None) or getattr(f, "id", "")
-            if name not in ("emit", "_emit"):
-                continue
-            if node.args and isinstance(node.args[0], ast.Constant) \
-                    and isinstance(node.args[0].value, str):
-                kinds.setdefault(node.args[0].value, set()).add(
-                    str(p.relative_to(PKG_ROOT))
-                )
-    return kinds
+def test_event_schema_rule_clean():
+    report = engine.run_repo(rules=["event-schema"])
+    assert report.ok, "\n".join(f.render() for f in report.unsuppressed())
 
 
-def test_every_emitted_kind_is_documented():
-    emitted = _emitted_kinds()
-    undocumented = {
-        k: sorted(files)
-        for k, files in emitted.items()
-        if k not in EVENT_KINDS and k not in ALLOWED_UNDOCUMENTED
-    }
-    assert not undocumented, (
-        "event kinds emitted but missing from exec.events.EVENT_KINDS "
-        f"(document them there): {undocumented}"
-    )
-
-
-def test_every_documented_kind_is_emitted():
-    emitted = set(_emitted_kinds())
-    stale = set(EVENT_KINDS) - emitted - ALLOWED_UNEMITTED
-    assert not stale, (
-        "EVENT_KINDS documents kinds no call site emits (remove or "
-        f"allowlist them): {sorted(stale)}"
-    )
-
-
-def test_docs_are_nonempty_one_liners():
-    for kind, doc in EVENT_KINDS.items():
-        assert doc.strip(), f"empty doc for {kind}"
-        assert "\n" not in doc, f"doc for {kind} must be one line"
+def test_payload_table_covers_every_kind():
+    assert set(EVENT_PAYLOADS) == set(EVENT_KINDS)

@@ -1,126 +1,16 @@
-"""Fusion lint: the fuser's allowlist vs the device kernel registry,
-and a host-transfer scan over the fused body.
-
-Same static-AST pattern as ``tests/test_operand_lint.py``:
-
-- every op kind the fuser admits (``plan.fuse.FUSABLE_OPS``) must have
-  a registered device kernel (``exec.kernels._KERNELS``) — admitting an
-  unkernelled kind would blow up at trace time inside a fused region;
-  and every registered device kernel must be consciously classified
-  (fusable or driver-evaluated), so new kernels cannot silently fall
-  out of fusion coverage;
-- the fused region body (``build_fused_fn`` + ``build_stage_fn``, and
-  the whole ``plan/fuse.py`` pass) must never call host-transfer APIs
-  (``np.asarray`` / ``.item()`` / ``jax.device_get``): one such call
-  would silently reintroduce the per-seam device->host round-trip the
-  fusion exists to remove (or worse, fail inside the traced region).
+"""Thin wrapper: the fuser-allowlist and host-transfer contracts are
+now the graftlint ``fuse-classification`` and ``host-transfer`` rules
+(``dryad_tpu/analysis/checks_fusion.py``).  The host-transfer scan now
+covers the ENTIRE kernel registry and the device ops modules, not just
+the fused body.  Mutation self-tests: ``tests/test_graftlint_selftest.py``.
 """
 
-import ast
-import inspect
+import pytest
 
-from dryad_tpu.exec import kernels as KM
-from dryad_tpu.exec.kernels import _KERNELS
-from dryad_tpu.plan import fuse as FUSE
-from dryad_tpu.plan.fuse import DRIVER_OPS, FUSABLE_OPS
+from dryad_tpu.analysis import engine
 
 
-def test_fusable_ops_all_have_device_kernels():
-    missing = FUSABLE_OPS - set(_KERNELS)
-    assert not missing, (
-        f"fuser admits op kinds with no registered device kernel: "
-        f"{sorted(missing)}"
-    )
-
-
-def test_every_device_kernel_is_classified():
-    unclassified = set(_KERNELS) - FUSABLE_OPS - DRIVER_OPS
-    assert not unclassified, (
-        "device kernels neither fusable nor driver-evaluated — classify "
-        f"them in plan.fuse: {sorted(unclassified)}"
-    )
-
-
-def test_driver_ops_never_admitted():
-    assert not (FUSABLE_OPS & DRIVER_OPS)
-
-
-# -- host-transfer scan ------------------------------------------------------
-
-# attribute calls that move data to the host (or bake host constants)
-_HOST_TRANSFER_ATTRS = {"asarray", "item", "device_get"}
-
-
-def _fn_ast(module, name):
-    tree = ast.parse(inspect.getsource(module))
-    for n in ast.walk(tree):
-        if isinstance(n, ast.FunctionDef) and n.name == name:
-            return n
-    raise AssertionError(f"{name} not found in {module.__name__}")
-
-
-def _host_transfer_calls(node):
-    """(lineno, rendered call) for every host-transfer attribute call
-    in the subtree.  ``jnp.asarray`` is a TRACE op (device-side) and is
-    exempt; ``np.asarray``, ``jax.device_get`` and ``.item()`` are
-    host transfers wherever they appear."""
-    hits = []
-    for n in ast.walk(node):
-        if not (isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)):
-            continue
-        attr = n.func.attr
-        if attr not in _HOST_TRANSFER_ATTRS:
-            continue
-        base = n.func.value
-        base_name = base.id if isinstance(base, ast.Name) else None
-        if attr == "asarray" and base_name == "jnp":
-            continue  # traced, stays on device
-        hits.append((n.lineno, f"{base_name or '<expr>'}.{attr}()"))
-    return hits
-
-
-def test_fused_body_free_of_host_transfers():
-    offenders = []
-    for name in ("build_fused_fn", "build_stage_fn"):
-        offenders += [
-            (f"kernels.{name}", ln, call)
-            for ln, call in _host_transfer_calls(_fn_ast(KM, name))
-        ]
-    assert not offenders, (
-        "host-transfer API inside the fused body: "
-        + "; ".join(f"{w}:{ln} {c}" for w, ln, c in offenders)
-    )
-
-
-def test_fuse_pass_free_of_host_transfers():
-    tree = ast.parse(inspect.getsource(FUSE))
-    hits = _host_transfer_calls(tree)
-    assert not hits, (
-        "host-transfer API inside plan/fuse.py: "
-        + "; ".join(f"line {ln}: {c}" for ln, c in hits)
-    )
-
-
-def test_fused_kernels_free_of_host_transfers():
-    """Every kernel a fused region may chain must itself stay free of
-    host transfers (a .item() in any member kernel would sync the whole
-    region's dispatch)."""
-    tree = ast.parse(inspect.getsource(KM))
-    defs = {
-        n.name: n for n in ast.walk(tree)
-        if isinstance(n, ast.FunctionDef)
-    }
-    offenders = []
-    for kind in sorted(FUSABLE_OPS):
-        fn = _KERNELS[kind]
-        node = defs.get(fn.__name__)
-        if node is None:
-            continue
-        offenders += [
-            (fn.__name__, ln, call)
-            for ln, call in _host_transfer_calls(node)
-        ]
-    assert not offenders, (
-        "host-transfer API inside fusable kernels: "
-        + "; ".join(f"{w}:{ln} {c}" for w, ln, c in offenders)
-    )
+@pytest.mark.parametrize("rule", ["fuse-classification", "host-transfer"])
+def test_fusion_rules_clean(rule):
+    report = engine.run_repo(rules=[rule])
+    assert report.ok, "\n".join(f.render() for f in report.unsuppressed())

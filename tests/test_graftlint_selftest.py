@@ -1,0 +1,587 @@
+"""Checker self-tests: every graftlint rule must FIRE on a known-bad
+mutation and stay SILENT on the clean fixture.
+
+Each rule gets a minimal fixture project (built via
+``Project.from_sources`` — the checkers read registries as AST
+literals, so synthetic trees exercise the same code paths as the real
+one) and a set of seeded mutations, each the original failure case the
+legacy ``tests/test_*_lint.py`` suites guarded against (plus the new
+determinism / host-transfer / recompile hazards).  A silently-broken
+checker cannot pass CI: its mutation case stops firing.
+
+Also here: the determinism-audit pin for the retry machinery
+(``exec/failure.py``) — golden backoff values hardcoded so a change to
+the seeding scheme (e.g. an accidental switch to process-salted
+``hash()``) fails loudly.
+"""
+
+import pytest
+
+from dryad_tpu.analysis.core import Project, run
+from dryad_tpu.exec.failure import RetryPolicy
+
+
+def _rules(sources, rule):
+    report = run(Project.from_sources(sources), rules=[rule])
+    return [f.rule for f in report.unsuppressed()]
+
+
+def _assert_fires(sources, rule, n=None):
+    fired = _rules(sources, rule)
+    assert fired and set(fired) == {rule}, f"expected {rule}, got {fired}"
+    if n is not None:
+        assert len(fired) == n, f"expected {n} findings, got {len(fired)}"
+
+
+def _mutate(sources, path, old, new):
+    out = dict(sources)
+    assert old in out[path], f"mutation anchor {old!r} missing in {path}"
+    out[path] = out[path].replace(old, new)
+    return out
+
+
+# -- operand-registry --------------------------------------------------------
+
+KERNELS = "dryad_tpu/exec/kernels.py"
+
+KERNELS_CLEAN = '''\
+import jax.numpy as jnp
+
+
+def _k_string_code(ctx, p, cols):
+    table = p["table"]
+    ops = ctx.operand("table")
+    return table.lookup(cols, operands=ops)
+
+
+def _k_select(ctx, p, cols):
+    return cols
+
+
+def _k_do_while(ctx, p, cols):
+    return cols
+
+
+OPERAND_PARAMS = frozenset({("string_code", "table")})
+_KERNELS = {
+    "string_code": _k_string_code,
+    "select": _k_select,
+    "do_while": _k_do_while,
+}
+
+
+def build_stage_fn(stage):
+    return None
+
+
+def build_fused_fn(stages):
+    return None
+'''
+
+FUSE = "dryad_tpu/plan/fuse.py"
+
+FUSE_CLEAN = '''\
+FUSABLE_OPS = frozenset({"select", "string_code"})
+DRIVER_OPS = frozenset({"do_while"})
+'''
+
+OPERAND_FIXTURE = {KERNELS: KERNELS_CLEAN, FUSE: FUSE_CLEAN}
+
+
+def test_operand_registry_clean_fixture():
+    assert _rules(OPERAND_FIXTURE, "operand-registry") == []
+
+
+@pytest.mark.parametrize(
+    "old,new",
+    [
+        # bake the table into the trace
+        (
+            "return table.lookup(cols, operands=ops)",
+            "baked = jnp.asarray(table)\n"
+            "    return table.lookup(cols, operands=ops)",
+        ),
+        # table-method call without the operands routing
+        (
+            "return table.lookup(cols, operands=ops)",
+            "return table.lookup(cols)",
+        ),
+        # ctx.operand() from a kernel with no registered param
+        (
+            "def _k_select(ctx, p, cols):\n    return cols",
+            "def _k_select(ctx, p, cols):\n"
+            "    ops = ctx.operand(\"x\")\n    return cols",
+        ),
+        # stale registry entry: the param is never used
+        (
+            'table = p["table"]\n'
+            '    ops = ctx.operand("table")\n'
+            "    return table.lookup(cols, operands=ops)",
+            "return cols",
+        ),
+    ],
+    ids=["bake", "no-operands-kw", "unregistered-ctx-operand", "stale"],
+)
+def test_operand_registry_fires(old, new):
+    _assert_fires(
+        _mutate(OPERAND_FIXTURE, KERNELS, old, new), "operand-registry"
+    )
+
+
+# -- fuse-classification -----------------------------------------------------
+
+
+def test_fuse_classification_clean_fixture():
+    assert _rules(OPERAND_FIXTURE, "fuse-classification") == []
+
+
+@pytest.mark.parametrize(
+    "path,old,new",
+    [
+        (FUSE, '"select", "string_code"', '"select", "string_code", "ghost"'),
+        (
+            KERNELS,
+            '"do_while": _k_do_while,',
+            '"do_while": _k_do_while,\n    "orphan": _k_select,',
+        ),
+        (FUSE, 'DRIVER_OPS = frozenset({"do_while"})',
+         'DRIVER_OPS = frozenset({"do_while", "select"})'),
+    ],
+    ids=["unkernelled-admit", "unclassified-kernel", "overlap"],
+)
+def test_fuse_classification_fires(path, old, new):
+    _assert_fires(
+        _mutate(OPERAND_FIXTURE, path, old, new), "fuse-classification"
+    )
+
+
+# -- host-transfer -----------------------------------------------------------
+
+OOC = "dryad_tpu/exec/outofcore.py"
+STRINGCODE = "dryad_tpu/ops/stringcode.py"
+
+HOST_FIXTURE = {
+    KERNELS: KERNELS_CLEAN,
+    FUSE: FUSE_CLEAN,
+    OOC: '''\
+def _group_partial_tree(self, node):
+    def merge_local(batches):
+        return batches[0]
+    return merge_local
+''',
+    STRINGCODE: '''\
+import numpy as np
+
+
+def palette_domain(n):
+    return max(4, n)
+
+
+class CodeTable:
+    operand_arity = 3
+
+    def build(self, pairs):
+        return np.asarray(pairs)
+
+    def lookup(self, h0, h1, operands=None):
+        return h0
+''',
+}
+
+
+def test_host_transfer_clean_fixture():
+    # note build()'s np.asarray is FINE: host-side builder, no operands=
+    assert _rules(HOST_FIXTURE, "host-transfer") == []
+
+
+@pytest.mark.parametrize(
+    "path,old,new",
+    [
+        (KERNELS, "def _k_select(ctx, p, cols):\n    return cols",
+         "def _k_select(ctx, p, cols):\n    return cols.item()"),
+        (KERNELS, "def _k_select(ctx, p, cols):\n    return cols",
+         "def _k_select(ctx, p, cols):\n    return float(jnp.sum(cols))"),
+        (FUSE, "DRIVER_OPS = frozenset",
+         "def plan(x):\n    import jax\n    return jax.device_get(x)\n\n\n"
+         "DRIVER_OPS = frozenset"),
+        (OOC, "return batches[0]",
+         "import numpy as np\n        return np.asarray(batches[0])"),
+        (STRINGCODE, "def lookup(self, h0, h1, operands=None):\n        return h0",
+         "def lookup(self, h0, h1, operands=None):\n"
+         "        return np.asarray(h0)"),
+    ],
+    ids=["kernel-item", "kernel-float-traced", "fuse-device-get",
+         "merge-closure", "traced-table-method"],
+)
+def test_host_transfer_fires(path, old, new):
+    _assert_fires(_mutate(HOST_FIXTURE, path, old, new), "host-transfer")
+
+
+def test_host_transfer_lost_anchor_is_a_finding():
+    mutated = _mutate(
+        HOST_FIXTURE, OOC, "def merge_local", "def merge_other"
+    )
+    _assert_fires(mutated, "host-transfer")
+
+
+# -- layer-imports / placement-snapshot --------------------------------------
+
+CT = "dryad_tpu/exec/combinetree.py"
+
+CT_CLEAN = '''\
+def _cosine(a, b):
+    return sum(a[k] * b.get(k, 0.0) for k in sorted(a))
+
+
+def place(snapshot, centroids):
+    return 0
+
+
+def plan_groups(snapshots, k):
+    return [list(snapshots)]
+
+
+class CombineTreePlanner:
+    def plan(self, snapshots):
+        return plan_groups(snapshots, 2)
+'''
+
+LAYER_FIXTURE = {
+    CT: CT_CLEAN,
+    "dryad_tpu/redundancy/coded.py": (
+        "from dryad_tpu.exec import partial\n"
+    ),
+}
+
+
+def test_layer_imports_clean_fixture():
+    assert _rules(LAYER_FIXTURE, "layer-imports") == []
+
+
+@pytest.mark.parametrize(
+    "path,new_header",
+    [
+        (CT, "from dryad_tpu.cluster import scheduler\n"),
+        ("dryad_tpu/redundancy/coded.py",
+         "import dryad_tpu.exec.outofcore\n"),
+        ("dryad_tpu/redundancy/coded.py",
+         "from dryad_tpu.cluster.localjob import Gang\n"),
+    ],
+    ids=["combinetree-cluster", "redundancy-outofcore",
+         "redundancy-cluster"],
+)
+def test_layer_imports_fires(path, new_header):
+    mutated = dict(LAYER_FIXTURE)
+    mutated[path] = new_header + mutated[path]
+    _assert_fires(mutated, "layer-imports")
+
+
+def test_placement_snapshot_clean_fixture():
+    assert _rules(LAYER_FIXTURE, "placement-snapshot") == []
+
+
+@pytest.mark.parametrize(
+    "old,new",
+    [
+        ("def place(snapshot, centroids):\n    return 0",
+         "def place(snapshot, centroids):\n    return snapshot.data"),
+        ("return plan_groups(snapshots, 2)",
+         "return [s.to_numpy() for s in snapshots]"),
+        # structural drift: a scanned surface disappears entirely
+        ("def _cosine(a, b):", "def _cosine_renamed(a, b):"),
+    ],
+    ids=["place-reads-data", "planner-reads-payload", "lost-anchor"],
+)
+def test_placement_snapshot_fires(old, new):
+    _assert_fires(_mutate(LAYER_FIXTURE, CT, old, new),
+                  "placement-snapshot")
+
+
+# -- coded-linearity ---------------------------------------------------------
+
+DEC = "dryad_tpu/redundancy/decs.py"
+
+LINEARITY_FIXTURE = {
+    DEC: '''\
+from dryad_tpu.api.decomposable import Decomposable
+
+SUM = Decomposable(linear=True, identity=0)
+COUNT = Decomposable(linear=False)
+''',
+}
+
+
+def test_coded_linearity_clean_fixture():
+    assert _rules(LINEARITY_FIXTURE, "coded-linearity") == []
+
+
+def test_coded_linearity_fires_without_identity():
+    _assert_fires(
+        _mutate(LINEARITY_FIXTURE, DEC,
+                "Decomposable(linear=True, identity=0)",
+                "Decomposable(linear=True)"),
+        "coded-linearity",
+    )
+
+
+def test_coded_linearity_exempts_pytest_raises_blocks():
+    sources = {
+        "tests/test_neg.py": '''\
+import pytest
+
+from dryad_tpu.api.decomposable import Decomposable
+
+
+def test_rejects_linear_without_identity():
+    with pytest.raises(ValueError):
+        Decomposable(linear=True)
+''',
+    }
+    assert _rules(sources, "coded-linearity") == []
+
+
+# -- event-schema ------------------------------------------------------------
+
+EVENTS = "dryad_tpu/exec/events.py"
+EMITTER = "dryad_tpu/obs/emitter.py"
+
+EVENT_FIXTURE = {
+    EVENTS: '''\
+EVENT_KINDS = {"tick": "one tick; n"}
+EVENT_PAYLOADS = {"tick": (("n",), ("extra",))}
+''',
+    EMITTER: '''\
+def go(log):
+    log.emit("tick", n=1)
+    log.emit("tick", n=2, extra="y")
+''',
+}
+
+
+def test_event_schema_clean_fixture():
+    assert _rules(EVENT_FIXTURE, "event-schema") == []
+
+
+@pytest.mark.parametrize(
+    "path,old,new",
+    [
+        (EMITTER, 'log.emit("tick", n=1)', 'log.emit("boom", n=1)'),
+        (EMITTER, 'log.emit("tick", n=1)', 'log.emit("tick")'),
+        (EMITTER, 'log.emit("tick", n=1)', 'log.emit("tick", n=1, w=2)'),
+        (EVENTS, '{"tick": "one tick; n"}',
+         '{"tick": "one tick; n", "ghost": "never emitted"}'),
+        (EVENTS, 'EVENT_PAYLOADS = {"tick": (("n",), ("extra",))}',
+         'EVENT_PAYLOADS = {}'),
+        (EVENTS, '"one tick; n"', '""'),
+    ],
+    ids=["undocumented-kind", "missing-required-key", "key-off-spec",
+         "stale-kind", "payload-table-gap", "empty-doc"],
+)
+def test_event_schema_fires(path, old, new):
+    mutated = _mutate(EVENT_FIXTURE, path, old, new)
+    fired = _rules(mutated, "event-schema")
+    assert fired and set(fired) == {"event-schema"}, fired
+
+
+def test_event_schema_star_kwargs_checked_for_inclusion_only():
+    # forwarding sites can't prove required keys statically; they must
+    # not false-positive, but explicit off-spec keys still flag
+    ok = _mutate(EVENT_FIXTURE, EMITTER, "def go(log):",
+                 "def fwd(log, blob):\n"
+                 '    log.emit("tick", **blob)\n\n\n'
+                 "def go(log):")
+    assert _rules(ok, "event-schema") == []
+    bad = _mutate(EVENT_FIXTURE, EMITTER, "def go(log):",
+                  "def fwd(log, blob):\n"
+                  '    log.emit("tick", w=1, **blob)\n\n\n'
+                  "def go(log):")
+    _assert_fires(bad, "event-schema", n=1)
+
+
+# -- kernel-determinism ------------------------------------------------------
+
+DET = "dryad_tpu/ops/det.py"
+
+DET_CLEAN = '''\
+import random
+
+
+def f(seed, xs):
+    rng = random.Random(seed)
+    seen = {}
+    for x in sorted(xs):
+        if id(x) in seen:
+            continue
+        seen[id(x)] = x
+    return rng.random()
+'''
+
+
+def test_kernel_determinism_clean_fixture():
+    # seeded Random, id()-as-key, sorted iteration: all legal idioms
+    assert _rules({DET: DET_CLEAN}, "kernel-determinism") == []
+
+
+@pytest.mark.parametrize(
+    "old,new",
+    [
+        ("import random", "import random\nimport time"),
+        ("rng = random.Random(seed)",
+         "rng = random.Random(seed)\n    t = time.time()"),
+        ("rng = random.Random(seed)", "rng = random.Random()"),
+        ("return rng.random()", "return random.random()"),
+        ("return rng.random()",
+         "import numpy as np\n    return np.random.rand(3)"),
+        ("return rng.random()",
+         "import os\n    return os.environ[\"X\"]"),
+        ("return rng.random()",
+         "import os\n    return os.getenv(\"X\")"),
+        ("return rng.random()",
+         "from time import perf_counter\n    return perf_counter()"),
+        ("seen[id(x)] = x", "seen[x] = id(x)"),
+        ("for x in sorted(xs):", "for x in {1, 2, 3}:"),
+        ("return rng.random()", "return [k for k in {1, 2}]"),
+        ("rng = random.Random(seed)",
+         "global _STATE\n    rng = random.Random(seed)"),
+    ],
+    ids=["unused-import-ok-anchor", "wall-clock", "unseeded-Random",
+         "module-random", "np-random", "os-environ", "os-getenv",
+         "from-time-import", "id-as-value", "set-iteration",
+         "set-comprehension", "global-stmt"],
+)
+def test_kernel_determinism_fires(old, new):
+    sources = _mutate({DET: DET_CLEAN}, DET, old, new)
+    if "import time" in new and "time.time" not in new:
+        # the import alone is not a hazard; pair it with the clock read
+        sources = _mutate(sources, DET, "return rng.random()",
+                          "return time.time()")
+    _assert_fires(sources, "kernel-determinism")
+
+
+def test_kernel_determinism_flags_module_mutable_writes():
+    body = '''\
+CACHE = {}
+
+
+def f(k, v):
+    CACHE[k] = v
+    CACHE.update({k: v})
+    return CACHE
+'''
+    _assert_fires({DET: body}, "kernel-determinism", n=2)
+
+
+def test_kernel_determinism_allows_seeded_np_rng():
+    body = "import numpy as np\n\n\ndef f(s):\n    return np.random.default_rng(s)\n"
+    assert _rules({DET: body}, "kernel-determinism") == []
+    bad = body.replace("default_rng(s)", "default_rng()")
+    _assert_fires({DET: bad}, "kernel-determinism", n=1)
+
+
+def test_kernel_determinism_ignores_files_outside_scope():
+    # the executor layer legitimately reads clocks; scope excludes it
+    body = "import time\n\n\ndef f():\n    return time.time()\n"
+    assert _rules({"dryad_tpu/exec/executor.py": body},
+                  "kernel-determinism") == []
+
+
+# -- recompile-hazard --------------------------------------------------------
+
+TBL = "dryad_tpu/ops/table.py"
+
+TBL_CLEAN = '''\
+import numpy as np
+
+from dryad_tpu.ops.stringcode import palette_domain
+
+
+class Table:
+    operand_arity = 2
+
+    def __init__(self, pairs):
+        K = len(pairs)
+        S = 2 * palette_domain(K)
+        self.cap = S
+        self.codes = np.zeros(S, np.uint32)
+
+    def rebuild(self):
+        self.codes = np.zeros(self.cap, np.uint32)
+
+    def operand_signature(self):
+        return (self.codes.shape,)
+'''
+
+
+def test_recompile_hazard_clean_fixture():
+    assert _rules({TBL: TBL_CLEAN}, "recompile-hazard") == []
+
+
+@pytest.mark.parametrize(
+    "old,new",
+    [
+        ("np.zeros(S, np.uint32)", "np.zeros(K, np.uint32)"),
+        ("np.zeros(S, np.uint32)", "np.zeros(len(pairs), np.uint32)"),
+        # raw len() stored on self leaks into ANOTHER method's shape
+        ("self.cap = S", "self.cap = K"),
+    ],
+    ids=["raw-name-dim", "direct-len-dim", "raw-attr-dim"],
+)
+def test_recompile_hazard_fires_in_operand_class(old, new):
+    _assert_fires(_mutate({TBL: TBL_CLEAN}, TBL, old, new),
+                  "recompile-hazard")
+
+
+def test_recompile_hazard_ignores_classes_without_operand_surface():
+    body = TBL_CLEAN.replace("operand_arity = 2\n\n    ", "").replace(
+        "np.zeros(S, np.uint32)", "np.zeros(len(pairs), np.uint32)"
+    ).replace(
+        "def operand_signature(self):\n        return (self.codes.shape,)",
+        "def shape(self):\n        return self.codes.shape",
+    )
+    assert _rules({TBL: body}, "recompile-hazard") == []
+
+
+def test_recompile_hazard_traced_bodies():
+    assert _rules(OPERAND_FIXTURE, "recompile-hazard") == []
+    cases = {
+        "len-dim": ("def _k_select(ctx, p, cols):\n    return cols",
+                    "def _k_select(ctx, p, cols):\n"
+                    "    return jnp.zeros((len(cols), 4))"),
+        "host-numpy": ("def _k_select(ctx, p, cols):\n    return cols",
+                       "def _k_select(ctx, p, cols):\n"
+                       "    import numpy as np\n    return np.zeros(4)"),
+        "off-palette-literal": (
+            "def _k_select(ctx, p, cols):\n    return cols",
+            "def _k_select(ctx, p, cols):\n    return jnp.zeros((24,))"),
+    }
+    for name, (old, new) in cases.items():
+        fired = _rules(_mutate(OPERAND_FIXTURE, KERNELS, old, new),
+                       "recompile-hazard")
+        assert fired == ["recompile-hazard"], (name, fired)
+    # pow2 and sub-16 literal dims ride the palette fine
+    ok = _mutate(OPERAND_FIXTURE, KERNELS,
+                 "def _k_select(ctx, p, cols):\n    return cols",
+                 "def _k_select(ctx, p, cols):\n"
+                 "    return jnp.zeros((32, 4))")
+    assert _rules(ok, "recompile-hazard") == []
+
+
+# -- determinism audit pin (exec/failure.py) ---------------------------------
+
+
+def test_retry_backoff_golden_values_are_process_stable():
+    """The retry schedule must be a pure function of (seed, key,
+    failures) — seeded via str -> sha512, NOT the per-process-salted
+    hash().  Golden values pin the cross-process contract: if this
+    fails, every chaos replay and differential fault test is drifting.
+    """
+    p = RetryPolicy(seed=7)
+    assert [round(p.backoff("stage:3", n), 12) for n in (1, 2, 3)] == [
+        0.070147149864, 0.109707150614, 0.266841169515,
+    ]
+    # distinct seeds and keys de-correlate the jitter
+    assert round(RetryPolicy(seed=8).backoff("stage:3", 1), 12) == \
+        0.073392437727
+    assert round(p.backoff("stage:4", 1), 12) == 0.065387691467
+    # and the schedule is reproducible within a process too
+    assert p.backoff("stage:3", 1) == p.backoff("stage:3", 1)

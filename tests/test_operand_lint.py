@@ -1,196 +1,12 @@
-"""Operand-registry lint: OPERAND_PARAMS vs the kernel bodies.
-
-``exec/kernels.py`` registers (op kind, param name) pairs whose values
-travel as call-time device operands instead of baked trace constants
-(``OPERAND_PARAMS``).  The registry is only honest if the kernels obey
-it, so this test statically walks the kernel bodies (AST scan, the
-pattern of ``tests/test_event_schema.py``) in BOTH directions:
-
-- a kernel registered for an operand param must never materialize that
-  param's arrays through a host-constant path (``np.asarray`` /
-  ``jnp.asarray`` / ``.array`` on anything derived from the param) and
-  must route every table-method call through ``operands=ctx.operand(
-  <param>)`` — otherwise the content silently re-bakes into the
-  compiled program while the executor keys the cache by tier only
-  (stale-table results);
-- a kernel that calls ``ctx.operand(...)`` must belong to an op kind
-  with a registered operand param — otherwise the replicated-input
-  binding in ``build_stage_fn`` never feeds it and the kernel reads
-  None forever.
+"""Thin wrapper: the operand-registry contract is now the graftlint
+``operand-registry`` rule (``dryad_tpu/analysis/checks_operands.py``).
+The seeded-mutation self-tests proving the rule still fires on the
+original failure cases live in ``tests/test_graftlint_selftest.py``.
 """
 
-import ast
-import inspect
-
-from dryad_tpu.exec import kernels as KM
-from dryad_tpu.exec.kernels import _KERNELS, OPERAND_PARAMS
-
-_BAKE_FNS = {"asarray", "array", "device_put"}
+from dryad_tpu.analysis import engine
 
 
-def _kernel_fn_asts():
-    """kind -> (function name, FunctionDef AST) for every kernel."""
-    tree = ast.parse(inspect.getsource(KM))
-    defs = {
-        n.name: n for n in ast.walk(tree)
-        if isinstance(n, ast.FunctionDef)
-    }
-    return {kind: (fn.__name__, defs[fn.__name__])
-            for kind, fn in _KERNELS.items()
-            if fn.__name__ in defs}
-
-
-def _param_exprs(fn_ast, param):
-    """Predicate: does an expression subtree reach ``p["<param>"]`` /
-    ``p.get("<param>")`` or a local name assigned from one?"""
-    tainted = set()
-
-    def direct(node) -> bool:
-        if isinstance(node, ast.Subscript):
-            if (
-                isinstance(node.value, ast.Name) and node.value.id == "p"
-                and isinstance(node.slice, ast.Constant)
-                and node.slice.value == param
-            ):
-                return True
-        if isinstance(node, ast.Call):
-            f = node.func
-            if (
-                isinstance(f, ast.Attribute) and f.attr == "get"
-                and isinstance(f.value, ast.Name) and f.value.id == "p"
-                and node.args
-                and isinstance(node.args[0], ast.Constant)
-                and node.args[0].value == param
-            ):
-                return True
-        return False
-
-    def is_alias(node) -> bool:
-        """The expression IS the param object (not merely derived from
-        it): p["<param>"], p.get("<param>"), or a tainted name — call
-        RESULTS (codes = table.lookup(...)) are arrays, not the table,
-        and do not propagate."""
-        return direct(node) or (
-            isinstance(node, ast.Name) and node.id in tainted
-        )
-
-    def mentions(node) -> bool:
-        return any(is_alias(n) for n in ast.walk(node))
-
-    changed = True
-    while changed:
-        changed = False
-        for stmt in ast.walk(fn_ast):
-            if isinstance(stmt, ast.Assign) and is_alias(stmt.value):
-                for t in stmt.targets:
-                    if isinstance(t, ast.Name) and t.id not in tainted:
-                        tainted.add(t.id)
-                        changed = True
-    return mentions
-
-
-def _calls_ctx_operand(node) -> bool:
-    return (
-        isinstance(node, ast.Call)
-        and isinstance(node.func, ast.Attribute)
-        and node.func.attr == "operand"
-        and isinstance(node.func.value, ast.Name)
-        and node.func.value.id == "ctx"
-    )
-
-
-def test_operand_params_never_baked_as_host_constants():
-    """Direction 1: registered operand params must not reach the trace
-    through np/jnp.asarray-style constant materialization, and their
-    device-method calls must carry operands=ctx.operand(...)."""
-    kernel_asts = _kernel_fn_asts()
-    problems = []
-    for kind, param in sorted(OPERAND_PARAMS):
-        assert kind in kernel_asts, f"no kernel for registered op {kind!r}"
-        fname, fn_ast = kernel_asts[kind]
-        mentions = _param_exprs(fn_ast, param)
-        # names bound from ctx.operand(...) — legal operands= values
-        operand_names = {
-            t.id
-            for stmt in ast.walk(fn_ast)
-            if isinstance(stmt, ast.Assign)
-            and _calls_ctx_operand(stmt.value)
-            for t in stmt.targets
-            if isinstance(t, ast.Name)
-        }
-        saw_table_call = False
-        for node in ast.walk(fn_ast):
-            if not isinstance(node, ast.Call):
-                continue
-            f = node.func
-            if (
-                isinstance(f, ast.Attribute) and f.attr in _BAKE_FNS
-                and any(mentions(a) for a in node.args)
-            ):
-                problems.append(
-                    f"{fname}: {f.attr}() on operand param "
-                    f"({kind!r}, {param!r}) bakes table content into "
-                    "the trace"
-                )
-            # method call ON the param object (lookup / slice_rows):
-            # must route the arrays through operands=ctx.operand(...)
-            if (
-                isinstance(f, ast.Attribute)
-                and f.attr not in ("get",)
-                and mentions(f.value)
-            ):
-                saw_table_call = True
-                ok = any(
-                    kw.arg == "operands"
-                    and (
-                        _calls_ctx_operand(kw.value)
-                        or (
-                            isinstance(kw.value, ast.Name)
-                            and kw.value.id in operand_names
-                        )
-                    )
-                    for kw in node.keywords
-                )
-                if not ok:
-                    problems.append(
-                        f"{fname}: {f.attr}() on operand param "
-                        f"({kind!r}, {param!r}) without "
-                        "operands=ctx.operand(...)"
-                    )
-        assert saw_table_call, (
-            f"{fname}: registered operand param ({kind!r}, {param!r}) "
-            "is never used — stale registry entry"
-        )
-    assert not problems, "\n".join(problems)
-
-
-def test_ctx_operand_only_used_by_registered_kernels():
-    """Direction 2: a kernel reading ctx.operand(...) must have a
-    registered operand param for its op kind — otherwise nothing ever
-    binds the arrays it asks for."""
-    registered_kinds = {k for k, _ in OPERAND_PARAMS}
-    offenders = []
-    for kind, (fname, fn_ast) in _kernel_fn_asts().items():
-        uses = any(_calls_ctx_operand(n) for n in ast.walk(fn_ast))
-        if uses and kind not in registered_kinds:
-            offenders.append(f"{fname} (op {kind!r})")
-    assert not offenders, (
-        "kernels call ctx.operand() without a registered OPERAND param "
-        f"for their op kind: {offenders}"
-    )
-
-
-def test_registry_entries_name_real_params():
-    """Every registered (kind, param) pair points at an existing kernel
-    that actually reads that param name."""
-    kernel_asts = _kernel_fn_asts()
-    for kind, param in sorted(OPERAND_PARAMS):
-        assert kind in kernel_asts, f"unknown op kind {kind!r}"
-        _fname, fn_ast = kernel_asts[kind]
-        consts = {
-            n.value for n in ast.walk(fn_ast)
-            if isinstance(n, ast.Constant) and isinstance(n.value, str)
-        }
-        assert param in consts, (
-            f"kernel for {kind!r} never references param {param!r}"
-        )
+def test_operand_registry_rule_clean():
+    report = engine.run_repo(rules=["operand-registry"])
+    assert report.ok, "\n".join(f.render() for f in report.unsuppressed())
