@@ -63,7 +63,21 @@ _EMIT_LOCK = _threading.Lock()
 def emit(record: dict) -> None:
     """One NDJSON record + an updated summary line (kill-safe tail).
     The lock keeps the watchdog's forced final SUMMARY from landing
-    between (or inside) these two writes."""
+    between (or inside) these two writes.  Every record carries a
+    ``diagnoses`` block: the pathologies the online engine
+    (obs.diagnose) caught while the metric ran — a benchmark number
+    measured during a recompile storm or partition skew is not the
+    number you think it is."""
+    try:
+        from dryad_tpu.obs.diagnose import drain_recent
+
+        record.setdefault("diagnoses", [
+            {"rule": d["rule"], "severity": d["severity"],
+             "subject": d["subject"], "evidence": d["evidence"]}
+            for d in drain_recent()
+        ])
+    except Exception:
+        record.setdefault("diagnoses", [])
     with _EMIT_LOCK:
         print(json.dumps(record), flush=True)
         print(json.dumps(SUMMARY), flush=True)
@@ -437,10 +451,12 @@ def _job_phases(ctx) -> dict:
     return JobMetrics.from_events(ctx.events.events()).attribution()
 
 
-def _ooc_sort_once(n: int, chunk_rows: int, depth=None):
+def _ooc_sort_once(n: int, chunk_rows: int, depth=None, obs=True):
     """One timed out-of-core sort run; returns (seconds, phases).
     ``depth`` overrides ``stream_pipeline_depth`` (1 = the serial
-    legacy driver, the pre-pipeline baseline)."""
+    legacy driver, the pre-pipeline baseline); ``obs=False`` turns the
+    always-on observability layer (flight recorder + diagnosis
+    engine) off for the --obs-overhead A/B."""
     from dryad_tpu import DryadConfig, DryadContext
 
     rng = np.random.default_rng(3)
@@ -453,6 +469,8 @@ def _ooc_sort_once(n: int, chunk_rows: int, depth=None):
     total = nchunks * chunk_rows
     bucket_rows = max(chunk_rows, 1 << 20)
     kw = {} if depth is None else {"stream_pipeline_depth": depth}
+    if not obs:
+        kw.update(obs_flight_recorder=False, obs_diagnosis=False)
     cfg = DryadConfig(
         stream_bucket_rows=bucket_rows * 2,
         stream_buckets=max(8, 2 * total // bucket_rows),
@@ -1452,11 +1470,57 @@ def lint_gate() -> None:
         sys.exit(2)
 
 
+OBS_OVERHEAD_LIMIT = 0.02  # always-on observability budget: 2%
+
+
+def obs_overhead_gate(n: int = 1 << 22, chunk_rows: int = 1 << 20) -> None:
+    """--obs-overhead: prove the always-on observability layer (event
+    taps -> flight-recorder ring + diagnosis folds) costs < 2% on the
+    out-of-core sort, the event-densest workload in the suite.  A/B in
+    one process — warmup run first (XLA compile), then interleaved
+    off/on pairs, best-of each so scheduler noise cancels.  Emits one
+    NDJSON record either way; exits 2 on breach, 0 on pass."""
+    from dryad_tpu.obs import flightrec
+
+    _ooc_sort_once(n, chunk_rows)  # warmup: compile + page caches
+    on_s, off_s = [], []
+    for _ in range(2):
+        flightrec.uninstall_recorder()
+        off_s.append(_ooc_sort_once(n, chunk_rows, obs=False)[0])
+        on_s.append(_ooc_sort_once(n, chunk_rows)[0])
+    overhead = min(on_s) / max(min(off_s), 1e-9) - 1.0
+    ok = overhead < OBS_OVERHEAD_LIMIT
+    emit({
+        "metric": "obs_overhead_oocsort",
+        "value": round(overhead * 100, 3),
+        "unit": "%",
+        "limit_pct": OBS_OVERHEAD_LIMIT * 100,
+        "ok": ok,
+        "obs_on_s": [round(t, 4) for t in on_s],
+        "obs_off_s": [round(t, 4) for t in off_s],
+        "rows": n,
+        "chunk_rows": chunk_rows,
+        "platform": _PLATFORM,
+    })
+    if not ok:
+        print(
+            f"bench: obs overhead {overhead:.2%} exceeds the "
+            f"{OBS_OVERHEAD_LIMIT:.0%} budget on oocsort",
+            file=sys.stderr,
+        )
+        sys.exit(2)
+
+
 def main() -> None:
     if "--lint-gate" in sys.argv:
         sys.argv.remove("--lint-gate")
         if not os.environ.get("DRYAD_BENCH_CHILD"):
             lint_gate()
+    if "--obs-overhead" in sys.argv:
+        sys.argv.remove("--obs-overhead")
+        if not os.environ.get("DRYAD_BENCH_CHILD"):
+            obs_overhead_gate()
+            sys.exit(0)
     if os.environ.get("DRYAD_BENCH_CHILD"):
         child_main()
     else:

@@ -219,6 +219,7 @@ def _load_builtin() -> None:
         checks_events,
         checks_fusion,
         checks_layering,
+        checks_obs,
         checks_operands,
         checks_recompile,
     )

@@ -28,6 +28,8 @@ from dryad_tpu.columnar.batch import ColumnBatch
 from dryad_tpu.columnar.schema import ColumnType, Schema, StringDictionary
 from dryad_tpu.exec.events import EventLog
 from dryad_tpu.exec.executor import GraphExecutor
+from dryad_tpu.obs import flightrec
+from dryad_tpu.obs.diagnose import DiagnosisEngine
 from dryad_tpu.parallel import distribute as D
 from dryad_tpu.parallel.mesh import make_mesh, num_partitions
 from dryad_tpu.plan.lower import lower
@@ -123,6 +125,7 @@ class DryadContext:
         # context); in-place mutation of arrays passed to from_arrays is
         # NOT tracked — inputs snapshot at first execution.
         self._device_cache: "OrderedDict[int, tuple]" = OrderedDict()
+        self.diagnosis: Optional[DiagnosisEngine] = None
         if local_debug:
             self.mesh = None
             self.executor = None
@@ -159,6 +162,39 @@ class DryadContext:
             self.events = EventLog(
                 path, mem_cap=self.config.obs_events_mem_cap
             )
+            # Flight recorder: always-on crash-forensics ring tapped
+            # into this context's stream, dumped on JobFailedError /
+            # unhandled exceptions (obs.flightrec).  The driver does
+            # NOT dump on clean exit.  The process recorder may already
+            # be owned by someone with a better dump location — the
+            # worker harness (role "worker-<i>") or a LocalJobSubmission
+            # driver, both dumping into the shared job root.  In that
+            # case tap this context's stream into the existing ring
+            # instead of displacing it.
+            if self.config.obs_flight_recorder:
+                rec = flightrec.get_recorder()
+                if rec is not None:
+                    self.events.add_tap(rec.record)
+                else:
+                    flightrec.install_recorder(
+                        capacity=self.config.flightrec_events,
+                        snapshot_s=self.config.flightrec_snapshot_s,
+                        dump_dir=(
+                            self.config.flightrec_dir
+                            or self.config.event_log_dir
+                            or "."
+                        ),
+                        role="driver",
+                        events=self.events,
+                    )
+            # Online diagnosis engine: live pathology folds over the
+            # same stream (obs.diagnose); diagnoses are emitted back
+            # into it and retained for explain(analyze=True)/jobview.
+            if self.config.obs_diagnosis:
+                self.diagnosis = DiagnosisEngine(
+                    config=self.config, events=self.events
+                )
+                self.events.add_tap(self.diagnosis.observe)
             self.executor = GraphExecutor(
                 self.mesh, self.config, self.events,
                 subquery_runner=self._run_subquery,

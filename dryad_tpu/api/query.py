@@ -1203,12 +1203,19 @@ class Query:
         return bool(self._scalar("all", col))
 
     # -- materialization -----------------------------------------------------
-    def explain(self) -> str:
+    def explain(self, analyze: bool = False) -> str:
         """Pretty-print the logical plan and fused stage graph
-        (``DryadLinqQueryExplain.cs`` analog)."""
-        from dryad_tpu.tools.explain import explain
+        (``DryadLinqQueryExplain.cs`` analog).  ``analyze=True``
+        EXECUTES the query first and appends the runtime-diagnosis
+        panel — phase attribution plus any pathologies the online
+        engine (``obs.diagnose``) caught during the run."""
+        from dryad_tpu.tools.explain import explain, explain_diagnoses
 
-        return explain(self)
+        text = explain(self)
+        if analyze:
+            self.collect()
+            text += "\n\n" + explain_diagnoses(self.ctx)
+        return text
 
     def collect(self) -> Dict[str, np.ndarray]:
         """Execute and fetch host logical columns (reference

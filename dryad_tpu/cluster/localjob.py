@@ -61,6 +61,8 @@ from dryad_tpu.exec.failure import (
 )
 from dryad_tpu.exec.jobpackage import pack_query
 from dryad_tpu.exec.stats import StageStatistics
+from dryad_tpu.obs import flightrec
+from dryad_tpu.obs.diagnose import DiagnosisEngine
 from dryad_tpu.obs.span import Tracer
 from dryad_tpu.utils.logging import get_logger
 
@@ -304,6 +306,24 @@ class LocalJobSubmission:
         self.service = ProcessService(self.root, host=bind_host)
         self.launcher = launcher or SubprocessLauncher()
         self.events = EventLog(os.path.join(self.root, "events.jsonl"))
+        # Flight recorder: the gang driver's ring dumps next to the
+        # workers' (every process writes blackbox-<pid>.json under
+        # <root>/blackbox), and this dump is the one carrying the
+        # per-worker clock offsets tools/blackbox.py corrects with.
+        flightrec.install_recorder(
+            capacity=2048,
+            snapshot_s=1.0,
+            dump_dir=os.path.join(self.root, "blackbox"),
+            role="driver",
+            events=self.events,
+        )
+        # Online diagnosis over the driver-side stream.  The engine's
+        # per-family duration models persist ACROSS submissions, which
+        # is what lets a later coded job pre-launch parity from prior
+        # jobs' completion times instead of waiting for its own first
+        # failure (see _submit_coded).
+        self.diagnosis = DiagnosisEngine(events=self.events)
+        self.events.add_tap(self.diagnosis.observe)
         # Computers register on ANNOUNCE (elastic membership), not at
         # construction — a late worker's slot must not accept tasks
         # that would stall until it exists.  The scheduler shares the
@@ -592,6 +612,13 @@ class LocalJobSubmission:
                     "gang_member_lost_mid_job", dead=sorted(dead),
                     attempt=attempts,
                 )
+                # Forensics checkpoint: the dead worker already left
+                # its own dump (or not, if it was SIGKILLed); the
+                # driver's view of the fatal window must survive the
+                # recovery that is about to rewrite gang state.
+                flightrec.dump_now(
+                    f"gang_member_lost:{','.join(map(str, sorted(dead)))}"
+                )
                 log.warning(
                     "gang member(s) %s died mid-job; shrinking to %d "
                     "workers and re-running", sorted(dead),
@@ -688,10 +715,21 @@ class LocalJobSubmission:
             from dryad_tpu.obs.gang import ship_failure_deltas
 
             ship_failure_deltas(self._cp, self.scheduler, self.events)
-            return self._cp.drain_telemetry(
+            n = self._cp.drain_telemetry(
                 self.n, self._telemetry_state, self.events,
                 scheduler=self.scheduler,
             )
+            # Stash the drain's min-RTT clock offsets in the flight
+            # recorder so a post-mortem blackbox merge can apply the
+            # same correction live telemetry got (tools.blackbox).
+            rec = flightrec.get_recorder()
+            if rec is not None:
+                rec.set_info(worker_offsets={
+                    i: st.get("off")
+                    for i, st in self._telemetry_state.items()
+                    if st.get("off") is not None
+                })
+            return n
         except Exception as e:  # noqa: BLE001 — observability only
             log.warning("worker telemetry drain failed: %s", e)
             return 0
@@ -1099,6 +1137,13 @@ class LocalJobSubmission:
         )
         t_job0 = time.monotonic()
         stats = StageStatistics(floor_ratio=cfg.straggler_floor_ratio)
+        # Diagnosis-driven pre-seeding: the engine's "coded" duration
+        # model accumulated coded_task_complete times from PRIOR
+        # submissions, so spare_threshold() is armed from t=0 of this
+        # job — a straggler can trigger parity before this job records
+        # a single completion (and before any failure).
+        for d in self.diagnosis.stats_for("coded").durations:
+            stats.record(d)
         run_t0: Dict[int, float] = {}
         retry_policy = RetryPolicy(
             backoff_base=cfg.retry_backoff_base,
@@ -1227,12 +1272,26 @@ class LocalJobSubmission:
                 # per-task identification needed — see spare_threshold)
                 if not parity_launched:
                     thr = stats.spare_threshold()
-                    if thr is not None and any(
-                        p.state is PS.RUNNING
-                        and now - run_t0.get(p.id, now) > thr
-                        for j, t in tasks.items() if j not in completed
-                        for p in t["procs"]
-                    ):
+                    slow = None
+                    if thr is not None:
+                        slow = next(
+                            (
+                                (j, now - run_t0[p.id])
+                                for j, t in tasks.items()
+                                if j not in completed
+                                for p in t["procs"]
+                                if p.state is PS.RUNNING
+                                and p.id in run_t0
+                                and now - run_t0[p.id] > thr
+                            ),
+                            None,
+                        )
+                    if slow is not None:
+                        # diagnose FIRST so the `diagnosis` event
+                        # precedes the coded_launch it is driving
+                        self.diagnosis.note_inflight(
+                            "coded", slow[1], subject=f"coded{slow[0]}"
+                        )
                         launch_parity("straggler", thr)
                 # coverage shortfall: relaunch dead vertices only when
                 # k completions are otherwise impossible

@@ -273,6 +273,27 @@ def main(argv=None) -> int:
     wlog = EventLog(None, mem_cap=8192)
     wtracer = Tracer(wlog)
 
+    # Flight recorder (obs.flightrec): the worker's ring survives what
+    # telemetry shipping cannot — a process death takes un-shipped
+    # events with it, so the ring dumps to the SHARED job root
+    # (blackbox-<ospid>.json) on any exit: atexit, SIGTERM, unhandled
+    # exceptions, and the chaos os._exit path (dumped explicitly by
+    # the executor before _exit).  tools/blackbox.py merges these with
+    # the driver's dump into one clock-corrected timeline.
+    from dryad_tpu.obs import flightrec
+
+    flightrec.install_recorder(
+        capacity=2048,
+        snapshot_s=1.0,
+        dump_dir=os.path.join(args.root, "blackbox"),
+        role=f"worker-{args.pid}",
+        worker=args.pid,
+        events=wlog,
+        atexit_dump=True,
+        signals=True,
+    )
+    flightrec.get_recorder().set_info(job=args.job, nproc=args.nproc)
+
     after = 0
     pkgs = _PackageCache()
     delay = {"seconds": 0.0, "count": 0}  # injected straggler behavior

@@ -94,6 +94,9 @@ EVENT_KINDS: Dict[str, str] = {
     "metrics": "counter/histogram registry snapshot; counters/hists",
     "xla_compile": "stage (re)compiled; stage/key/trace_s/compile_s",
     "telemetry_merged": "driver absorbed worker span/counter batches",
+    # -- diagnosis / flight recorder (obs.diagnose / exec.events) ---------
+    "diagnosis": "online pathology detected; rule/severity/evidence/hint",
+    "events_dropped": "in-memory ring evicted events; dropped total",
     # -- cluster: scheduler / quarantine (cluster.scheduler) --------------
     "process_failed": "a scheduled process failed; computer/error",
     "process_stranded": "hard affinity unsatisfiable after removal",
@@ -274,6 +277,10 @@ EVENT_PAYLOADS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
     "worker_killed_injected": (("name", "stage"), ()),
     "quarantine_delta": (("computer", "count", "src"), ()),
     "quarantine_absorbed": (("deltas", "source"), ()),
+    "diagnosis": (
+        ("evidence", "hint", "rule", "severity"), ("name", "stage"),
+    ),
+    "events_dropped": (("dropped",), ()),
 }
 
 
@@ -306,6 +313,17 @@ class EventLog:
     out-of-core jobs emit per-chunk events without bound); the file
     sink, when configured, always keeps the full stream.  ``None``
     keeps the unbounded list (test-friendly default).
+
+    Ring evictions are COUNTED (``dropped``) and announced in-stream
+    with ``events_dropped`` markers on a doubling schedule, so the
+    diagnosis engine and blackbox merges see "the stream is truncated
+    here" instead of misreading a gap as idleness.
+
+    ``add_tap(fn)`` registers a live observer called with every
+    appended event OUTSIDE the log lock — the feed for the online
+    diagnosis engine and the flight recorder.  Taps must be fast and
+    must never raise (exceptions are swallowed; observability cannot
+    fail the job).
     """
 
     def __init__(self, path: Optional[str] = None,
@@ -316,11 +334,25 @@ class EventLog:
         self._mem = (
             deque(maxlen=mem_cap) if mem_cap else []
         )  # type: ignore[var-annotated]
+        self.dropped = 0  # total ring evictions since construction
+        self._next_drop_marker = 1  # doubling threshold for the marker
+        self._taps: List[Any] = []
         if path:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
             self._fh = open(path, "a", buffering=1)
         else:
             self._fh = None
+
+    def add_tap(self, fn) -> None:
+        """Register a live per-event observer (called outside the
+        lock, after the event is appended)."""
+        self._taps.append(fn)
+
+    def remove_tap(self, fn) -> None:
+        try:
+            self._taps.remove(fn)
+        except ValueError:
+            pass
 
     def emit(self, kind: str, **fields: Any) -> None:
         ev = {
@@ -336,10 +368,30 @@ class EventLog:
         self._append({k: _to_native(v) for k, v in ev.items()})
 
     def _append(self, ev: Dict[str, Any]) -> None:
+        marker = False
         with self._lock:
+            if (
+                self.mem_cap
+                and len(self._mem) == self.mem_cap
+            ):
+                self.dropped += 1
+                if self.dropped >= self._next_drop_marker:
+                    # next marker at 2x: O(log drops) markers total, so
+                    # the announcement cannot itself flood the ring
+                    self._next_drop_marker = max(
+                        self._next_drop_marker * 2, self.dropped * 2
+                    )
+                    marker = True
             self._mem.append(ev)
             if self._fh:
                 self._fh.write(json.dumps(ev, default=str) + "\n")
+        for tap in self._taps:
+            try:
+                tap(ev)
+            except Exception:
+                pass  # observability must never fail the job
+        if marker:
+            self.emit("events_dropped", dropped=self.dropped)
 
     def events(self) -> List[Dict[str, Any]]:
         with self._lock:

@@ -50,6 +50,7 @@ from dryad_tpu.exec.kernels import (
 )
 from dryad_tpu.exec.operands import DeviceOperandPool, is_operand_capable
 from dryad_tpu.exec.stats import StageStatistics
+from dryad_tpu.obs import flightrec
 from dryad_tpu.obs.metrics import MetricsRegistry
 from dryad_tpu.obs.span import Tracer
 from dryad_tpu.parallel.mesh import mesh_axes, num_partitions
@@ -234,6 +235,22 @@ class GraphExecutor:
             getattr(self.config, "stringcode_runtime_tables", True)
         )
         self.operand_pool = DeviceOperandPool(mesh, metrics=self.metrics)
+        # health probes for the flight recorder's microsnapshots
+        # (no-ops when no recorder is installed): compiled-program and
+        # operand-pool residency on THIS executor (last one wins when
+        # a process holds several — fine for forensics)
+        flightrec.probe(
+            "xla_programs", lambda: len(self._compiled)
+        )
+        flightrec.probe(
+            "operand_pool",
+            lambda: {
+                "tiers": len(self.operand_pool._tiers),
+                "hits": self.operand_pool.hits,
+                "full_uploads": self.operand_pool.full_uploads,
+                "delta_scatters": self.operand_pool.delta_scatters,
+            },
+        )
         # do_while loop-state compaction programs (see _compact_loop_state)
         self._compact_cache: Dict[Tuple, Any] = {}
         self.stats: Dict[str, StageStatistics] = {}
@@ -728,6 +745,9 @@ class GraphExecutor:
         # end.  Downstream stages consume the optimistic results — an
         # overflow (rare) re-runs the affected suffix synchronously.
         window: List[Dict] = []
+        # the window list object outlives this call only in the probe
+        # closure; re-registering per run keeps the sample live
+        flightrec.probe("inflight_dispatches", lambda: len(window))
         for stage in graph.stages:
             if stage.ops and stage.ops[0].kind == "do_while":
                 self._drain_window(window, graph, bindings, results,
@@ -1011,6 +1031,9 @@ class GraphExecutor:
                         "worker_killed_injected", stage=stage.id,
                         name=stage.name,
                     )
+                    # os._exit skips atexit: the blackbox must be on
+                    # disk BEFORE the process vanishes mid-collective
+                    flightrec.dump_now(f"worker_killed:{stage.name}")
                     os._exit(113)
                 inj_delay = faults.registry.maybe_delay(stage.name)
                 if inj_delay:
@@ -1122,6 +1145,7 @@ class GraphExecutor:
                         else "exceeded failure budget "
                         f"({self.config.max_stage_failures})"
                     )
+                    flightrec.dump_now(f"job_failed:{stage.name}")
                     raise JobFailedError(
                         f"stage {stage.name!r} {why}: {e}",
                         stage=stage.name, attempts=attempts,
@@ -1162,6 +1186,7 @@ class GraphExecutor:
                         if join_exp
                         else "raise shuffle_slack or partition count"
                     )
+                    flightrec.dump_now(f"overflow_exhausted:{stage.name}")
                     raise StageFailedError(
                         f"stage {stage.name!r} still overflowing at "
                         f"boost {boost}; {hint}"

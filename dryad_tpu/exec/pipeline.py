@@ -32,6 +32,7 @@ import threading
 import time
 from typing import Any, Iterator, Optional
 
+from dryad_tpu.obs import flightrec
 from dryad_tpu.obs.span import Tracer
 
 __all__ = ["ChunkPrefetcher", "PipelineStats", "prefetched"]
@@ -104,6 +105,16 @@ class ChunkPrefetcher:
         self._finished = False
         self._thread = threading.Thread(
             target=self._feed, name=f"dryad-{name}", daemon=True
+        )
+        # pipeline occupancy in the flight recorder's microsnapshots
+        # (unregistered at close)
+        flightrec.probe(
+            f"pipeline:{name}",
+            lambda: {
+                "queued": len(self._items),
+                "in_flight": self.stats.produced - self.stats.consumed,
+                "depth": self.depth,
+            },
         )
         self._thread.start()
 
@@ -189,6 +200,7 @@ class ChunkPrefetcher:
         # unblock a producer waiting on the semaphore
         self._sem.release()
         self._thread.join(timeout=30.0)
+        flightrec.unprobe(f"pipeline:{self.name}")
         if not closed_already:
             self._emit_summary()
 

@@ -1,0 +1,316 @@
+"""Flight recorder — crash forensics that survive the process.
+
+The reference GM's failure story is post-hoc: the Calypso log and the
+JobBrowser reconstruct what happened from whatever reached the DFS.
+But a gang worker that dies mid-collective takes its un-shipped
+telemetry with it, and a driver crash loses the in-memory event mirror
+entirely.  This module is the airplane blackbox for that gap: an
+always-on bounded ring of the most recent events (span closes
+included — they ride the same stream) plus periodic health
+microsnapshots (RSS, in-flight dispatches, pipeline occupancy,
+operand-pool residency via registered probes), dumped ATOMICALLY to
+``blackbox-<pid>.json`` when the process is about to die:
+
+- explicitly, from the executor's ``JobFailedError`` raise sites and
+  the chaos ``os._exit`` kill path (``exec.faults`` — ``os._exit``
+  skips ``atexit``, so the dump happens first);
+- on unhandled exceptions (chained ``sys.excepthook``);
+- on worker death (``atexit`` + SIGTERM, opt-in per process role).
+
+``tools/blackbox.py`` merges the per-process dumps using the gang
+clock-offset correction (``obs.gang``) into one last-N-seconds
+timeline and a Chrome-trace export.
+
+The recorder is deliberately dumb and allocation-light: ``record`` is
+an ``EventLog`` tap (called on every event, outside the log lock), so
+it must never raise and never block.  Microsnapshots are sampled
+opportunistically inside ``record`` when ``snapshot_s`` has elapsed —
+no background thread, no timer, zero idle cost.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = [
+    "FlightRecorder",
+    "install_recorder",
+    "get_recorder",
+    "uninstall_recorder",
+    "probe",
+    "unprobe",
+    "dump_now",
+]
+
+DUMP_VERSION = 1
+_SNAPSHOT_CAP = 256  # snapshots kept alongside the event ring
+
+
+def _rss_kb() -> Optional[int]:
+    """Resident set size in KB; /proc fast path, getrusage fallback."""
+    try:
+        with open("/proc/self/statm") as fh:
+            pages = int(fh.read().split()[1])
+        return pages * (os.sysconf("SC_PAGE_SIZE") // 1024)
+    except Exception:
+        try:
+            import resource
+
+            return int(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss)
+        except Exception:
+            return None
+
+
+class FlightRecorder:
+    """Bounded ring of recent events + health microsnapshots, dumped
+    to ``blackbox-<pid>.json`` when the process is about to die."""
+
+    def __init__(
+        self,
+        capacity: int = 2048,
+        snapshot_s: float = 1.0,
+        dump_dir: Optional[str] = None,
+        role: str = "driver",
+        worker: Optional[int] = None,
+    ):
+        self.capacity = capacity
+        self.snapshot_s = snapshot_s
+        self.dump_dir = dump_dir or "."
+        self.role = role
+        self.worker = worker
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=capacity)
+        self._snapshots: deque = deque(maxlen=_SNAPSHOT_CAP)
+        self._probes: Dict[str, Callable[[], Any]] = {}
+        self._info: Dict[str, Any] = {}
+        self._last_snap = 0.0
+        self._dumped_reasons: List[str] = []
+        self.dropped_hint = 0  # last events_dropped total seen in-stream
+
+    # -- feeding ------------------------------------------------------------
+
+    def record(self, ev: Dict[str, Any]) -> None:
+        """EventLog tap: append one event to the ring.  Must never
+        raise (the tap caller swallows, but don't rely on it)."""
+        try:
+            with self._lock:
+                self._ring.append(ev)
+                if ev.get("kind") == "events_dropped":
+                    self.dropped_hint = int(ev.get("dropped", 0) or 0)
+            now = time.monotonic()
+            if now - self._last_snap >= self.snapshot_s:
+                self._last_snap = now
+                self.snapshot()
+        except Exception:
+            pass
+
+    def probe(self, name: str, fn: Callable[[], Any]) -> None:
+        """Register a health probe sampled into every microsnapshot
+        (in-flight dispatches, pipeline occupancy, pool residency...).
+        The callable must be cheap and is allowed to raise (the sample
+        is skipped)."""
+        with self._lock:
+            self._probes[name] = fn
+
+    def unprobe(self, name: str) -> None:
+        with self._lock:
+            self._probes.pop(name, None)
+
+    def set_info(self, **kw: Any) -> None:
+        """Attach identity/context metadata to future dumps (job dir,
+        gang generation, per-worker clock offsets...)."""
+        with self._lock:
+            self._info.update(kw)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Take one health microsnapshot now and retain it."""
+        snap: Dict[str, Any] = {
+            "ts": time.time(), "mono": time.monotonic(),
+        }
+        rss = _rss_kb()
+        if rss is not None:
+            snap["rss_kb"] = rss
+        with self._lock:
+            probes = list(self._probes.items())
+        for name, fn in probes:
+            try:
+                snap[name] = fn()
+            except Exception:
+                pass
+        with self._lock:
+            self._snapshots.append(snap)
+        return snap
+
+    # -- dumping ------------------------------------------------------------
+
+    def dump(self, reason: str) -> Optional[str]:
+        """Write the blackbox atomically (tmp + rename); returns the
+        path, or None when the write failed or there is nothing to
+        say.  Repeated same-process dumps overwrite — the LAST reason
+        wins, but every reason is retained in the payload."""
+        try:
+            with self._lock:
+                self._dumped_reasons.append(reason)
+                payload = {
+                    "version": DUMP_VERSION,
+                    "pid": os.getpid(),
+                    "role": self.role,
+                    "worker": self.worker,
+                    "reason": reason,
+                    "reasons": list(self._dumped_reasons),
+                    "wall": time.time(),
+                    "mono": time.monotonic(),
+                    "dropped": self.dropped_hint,
+                    "info": dict(self._info),
+                    "events": list(self._ring),
+                    "snapshots": list(self._snapshots),
+                }
+            path = os.path.join(
+                self.dump_dir, f"blackbox-{os.getpid()}.json"
+            )
+            os.makedirs(self.dump_dir, exist_ok=True)
+            tmp = path + f".tmp.{os.getpid()}"
+            with open(tmp, "w") as fh:
+                json.dump(payload, fh, default=str)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, path)
+            return path
+        except Exception:
+            return None
+
+
+# -- per-process singleton ---------------------------------------------------
+
+_RECORDER: Optional[FlightRecorder] = None
+_TAPPED_LOG = None
+_HOOKS_INSTALLED = False
+_ATEXIT_DUMP = False
+_PREV_EXCEPTHOOK = None
+_PREV_SIGTERM = None
+
+
+def _excepthook(etype, value, tb):
+    rec = _RECORDER
+    if rec is not None:
+        rec.dump(f"unhandled:{etype.__name__}")
+    hook = _PREV_EXCEPTHOOK or sys.__excepthook__
+    hook(etype, value, tb)
+
+
+def _atexit_dump():
+    rec = _RECORDER
+    if rec is not None and _ATEXIT_DUMP:
+        rec.dump("atexit")
+
+
+def _sigterm(signum, frame):
+    rec = _RECORDER
+    if rec is not None:
+        rec.dump("sigterm")
+    prev = _PREV_SIGTERM
+    if callable(prev):
+        prev(signum, frame)
+    else:
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+        os.kill(os.getpid(), signal.SIGTERM)
+
+
+def _install_hooks(atexit_dump: bool, signals: bool) -> None:
+    global _HOOKS_INSTALLED, _ATEXIT_DUMP, _PREV_EXCEPTHOOK, _PREV_SIGTERM
+    _ATEXIT_DUMP = _ATEXIT_DUMP or atexit_dump
+    if _HOOKS_INSTALLED:
+        return
+    _HOOKS_INSTALLED = True
+    _PREV_EXCEPTHOOK = sys.excepthook
+    sys.excepthook = _excepthook
+    atexit.register(_atexit_dump)
+    if signals:
+        try:
+            # only the main thread may set handlers; workers install
+            # from main(), library use from elsewhere just skips it
+            _PREV_SIGTERM = signal.signal(signal.SIGTERM, _sigterm)
+        except ValueError:
+            pass
+
+
+def install_recorder(
+    capacity: int = 2048,
+    snapshot_s: float = 1.0,
+    dump_dir: Optional[str] = None,
+    role: str = "driver",
+    worker: Optional[int] = None,
+    events=None,
+    atexit_dump: bool = False,
+    signals: bool = False,
+) -> FlightRecorder:
+    """Create (or replace) the process flight recorder, tap it into
+    *events*, and install the death hooks.
+
+    ``atexit_dump``/``signals`` are opt-in per role: worker processes
+    dump on ANY exit (their telemetry may be un-shipped); the driver
+    dumps only on failure paths (clean test runs must not litter)."""
+    global _RECORDER, _TAPPED_LOG
+    if _RECORDER is not None and _TAPPED_LOG is not None:
+        try:
+            _TAPPED_LOG.remove_tap(_RECORDER.record)
+        except Exception:
+            pass
+    rec = FlightRecorder(
+        capacity=capacity, snapshot_s=snapshot_s, dump_dir=dump_dir,
+        role=role, worker=worker,
+    )
+    _RECORDER = rec
+    _TAPPED_LOG = events
+    if events is not None:
+        events.add_tap(rec.record)
+    _install_hooks(atexit_dump=atexit_dump, signals=signals)
+    return rec
+
+
+def get_recorder() -> Optional[FlightRecorder]:
+    return _RECORDER
+
+
+def uninstall_recorder() -> None:
+    """Detach the current recorder (tests / context teardown).  The
+    death hooks stay installed but become no-ops."""
+    global _RECORDER, _TAPPED_LOG, _ATEXIT_DUMP
+    if _RECORDER is not None and _TAPPED_LOG is not None:
+        try:
+            _TAPPED_LOG.remove_tap(_RECORDER.record)
+        except Exception:
+            pass
+    _RECORDER = None
+    _TAPPED_LOG = None
+    _ATEXIT_DUMP = False
+
+
+def probe(name: str, fn: Callable[[], Any]) -> None:
+    """Register a health probe on the process recorder (no-op when no
+    recorder is installed — probes never gate on obs being on)."""
+    rec = _RECORDER
+    if rec is not None:
+        rec.probe(name, fn)
+
+
+def unprobe(name: str) -> None:
+    rec = _RECORDER
+    if rec is not None:
+        rec.unprobe(name)
+
+
+def dump_now(reason: str) -> Optional[str]:
+    """Dump the process blackbox now (no-op without a recorder)."""
+    rec = _RECORDER
+    if rec is not None:
+        return rec.dump(reason)
+    return None

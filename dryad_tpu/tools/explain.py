@@ -266,6 +266,39 @@ def explain_svg(query) -> str:
     return "\n".join(out)
 
 
+def explain_diagnoses(ctx) -> str:
+    """Runtime-health panel for ``Query.explain(analyze=True)``: the
+    online pathologies (``obs.diagnose``) the context's engine caught,
+    plus the phase attribution of the stream it watched — EXPLAIN
+    ANALYZE for the dataflow runtime."""
+    lines = ["== runtime diagnosis =="]
+    eng = getattr(ctx, "diagnosis", None)
+    if eng is None:
+        lines.append("  (diagnosis engine off: config.obs_diagnosis)")
+        return "\n".join(lines)
+    from dryad_tpu.obs.metrics import JobMetrics
+
+    attr = JobMetrics.from_events(ctx.events.events()).attribution()
+    if attr:
+        phases = "  ".join(
+            f"{k[:-2]}={v:.3f}s"
+            for k, v in sorted(attr.items())
+            if v and k.endswith("_s")
+        )
+        if phases:
+            lines.append(f"  phases: {phases}")
+    found = eng.diagnoses()
+    if not found:
+        lines.append("  no pathologies detected")
+    for d in found:
+        ev = " ".join(f"{k}={v}" for k, v in sorted(d["evidence"].items()))
+        lines.append(
+            f"  [{d['severity']}] {d['rule']} ({d['subject']}): {ev}"
+        )
+        lines.append(f"      hint: {d['hint']}")
+    return "\n".join(lines)
+
+
 def explain_lint(root=None) -> str:
     """Static-analysis panel: per-rule finding counts and the tree's
     reasoned suppressions, so lint state is visible alongside the

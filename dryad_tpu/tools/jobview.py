@@ -988,6 +988,37 @@ def render_attribution(events: List[Dict[str, Any]]) -> str:
     return "\n".join(["-- time attribution --"] + ["  " + l for l in lines])
 
 
+def render_health(events: List[Dict[str, Any]]) -> str:
+    """Live-pathology panel: ``diagnosis`` events the online engine
+    (``obs.diagnose``) emitted into the stream, newest last, plus any
+    ring-truncation markers — so ``--follow`` shows WHAT is going
+    wrong while it still is.  Empty when the stream is healthy."""
+    diags = [e for e in events if e.get("kind") == "diagnosis"]
+    dropped = [e for e in events if e.get("kind") == "events_dropped"]
+    if not diags and not dropped:
+        return ""
+    lines = ["-- health --"]
+    for d in diags:
+        ev = d.get("evidence") or {}
+        subject = ev.get("subject", "")
+        brief = " ".join(
+            f"{k}={v}" for k, v in sorted(ev.items()) if k != "subject"
+        )
+        lines.append(
+            f"  [{d.get('severity', '?'):<5}] {d.get('rule')}"
+            + (f" ({subject})" if subject else "")
+            + (f": {brief}" if brief else "")
+        )
+        if d.get("hint"):
+            lines.append(f"      hint: {d['hint']}")
+    if dropped:
+        lines.append(
+            f"  NOTE: event ring overflowed ({dropped[-1].get('dropped')} "
+            "evicted) — older history above is truncated"
+        )
+    return "\n".join(lines)
+
+
 def _render_stream(events: List[Dict[str, Any]]) -> str:
     """Render whichever job model the stream holds."""
     kinds = {e["kind"] for e in events}
@@ -996,7 +1027,12 @@ def _render_stream(events: List[Dict[str, Any]]) -> str:
     else:
         text = render(build_job(events))
     attr = render_attribution(events)
-    return text + ("\n" + attr if attr else "")
+    health = render_health(events)
+    return (
+        text
+        + ("\n" + attr if attr else "")
+        + ("\n\n" + health if health else "")
+    )
 
 
 def _load_tolerant(path: str) -> List[Dict[str, Any]]:

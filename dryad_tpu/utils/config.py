@@ -257,6 +257,50 @@ class DryadConfig:
     # without bound; the file sink (event_log_dir) keeps the full
     # stream.  0 = unbounded (legacy behavior).
     obs_events_mem_cap: int = _env_int("DRYAD_TPU_OBS_EVENTS_MEM_CAP", 1 << 16)
+    # Flight recorder (obs.flightrec): always-on bounded ring of recent
+    # events + periodic health microsnapshots in every process, dumped
+    # atomically to blackbox-<pid>.json on JobFailedError, unhandled
+    # exceptions, and worker death (incl. the chaos os._exit path) —
+    # crash forensics that survive the process.  Off = no ring, no
+    # dump hooks.
+    obs_flight_recorder: bool = _env_bool("DRYAD_TPU_FLIGHT_RECORDER", True)
+    # Flight-recorder ring capacity in events and the minimum seconds
+    # between health microsnapshots (RSS, in-flight dispatches,
+    # pipeline occupancy, operand-pool residency; sampled
+    # opportunistically on record — no background thread).
+    flightrec_events: int = _env_int("DRYAD_TPU_FLIGHTREC_EVENTS", 2048)
+    flightrec_snapshot_s: float = _env_float(
+        "DRYAD_TPU_FLIGHTREC_SNAPSHOT_S", 1.0
+    )
+    # Blackbox dump directory; None = the event_log_dir when set, else
+    # the process working directory.
+    flightrec_dir: Optional[str] = os.environ.get(
+        "DRYAD_TPU_FLIGHTREC_DIR"
+    ) or None
+    # Online diagnosis engine (obs.diagnose): streaming folds over the
+    # live event stream that detect named pathologies (recompile storm,
+    # straggler, partition skew, stall dominance, quarantine churn,
+    # combine-tree thrash, overflow loops) and emit schema-registered
+    # ``diagnosis`` events; the straggler diagnosis seeds coded-spare
+    # pre-launch.  Off = record-only observability (PR 3 behavior).
+    obs_diagnosis: bool = _env_bool("DRYAD_TPU_OBS_DIAGNOSIS", True)
+    # Partition-skew trigger: max/mean per-partition (or per-range) row
+    # ratio at or above this diagnoses ``partition_skew``.
+    diagnose_skew_ratio: float = _env_float(
+        "DRYAD_TPU_DIAGNOSE_SKEW_RATIO", 4.0
+    )
+    # Recompile-storm trigger: this many xla_compile events for ONE
+    # lowering tier within the sliding window diagnoses a storm (the
+    # palette exists precisely so tiers compile once).
+    diagnose_recompile_burst: int = _env_int(
+        "DRYAD_TPU_DIAGNOSE_RECOMPILE_BURST", 4
+    )
+    # Per-(rule, subject) re-diagnosis cooldown in seconds: a persistent
+    # pathology re-announces at most this often instead of flooding the
+    # stream it is diagnosing.
+    diagnose_cooldown_s: float = _env_float(
+        "DRYAD_TPU_DIAGNOSE_COOLDOWN_S", 5.0
+    )
 
     def __post_init__(self) -> None:
         self.validate()
@@ -319,6 +363,16 @@ class DryadConfig:
             raise ValueError("stream_writer_queue must be >= 1")
         if self.obs_events_mem_cap < 0:
             raise ValueError("obs_events_mem_cap must be >= 0")
+        if self.flightrec_events < 16:
+            raise ValueError("flightrec_events must be >= 16")
+        if self.flightrec_snapshot_s <= 0:
+            raise ValueError("flightrec_snapshot_s must be > 0")
+        if self.diagnose_skew_ratio < 1.0:
+            raise ValueError("diagnose_skew_ratio must be >= 1.0")
+        if self.diagnose_recompile_burst < 2:
+            raise ValueError("diagnose_recompile_burst must be >= 2")
+        if self.diagnose_cooldown_s < 0:
+            raise ValueError("diagnose_cooldown_s must be >= 0")
         if self.combine_tree_fan < 2:
             raise ValueError("combine_tree_fan must be >= 2")
         if self.combine_tree_ranges < 2 or (
@@ -335,3 +389,69 @@ class DryadConfig:
             )
         if self.stream_host_reprobe < 0:
             raise ValueError("stream_host_reprobe must be >= 0")
+
+
+# Every ``DryadConfig`` field, one line each — THE documented key
+# table.  The graftlint ``config-key`` rule cross-references this dict
+# against the dataclass fields (both directions: every field is
+# documented here; every documented key is a real field) AND against
+# every ``config.<attr>`` / ``getattr(config, "attr", ...)`` use in the
+# package, so a renamed or misspelled knob cannot silently read a
+# default.
+CONFIG_KEYS = {
+    "partition_count": "default output partitioning (DefaultPartitionCount)",
+    "enable_speculative_duplication":
+        "duplicate straggling vertex tasks (DryadLinqContext.cs:959)",
+    "max_stage_failures": "GM failure budget per stage before job failure",
+    "shuffle_slack": "padded shuffle-bucket slack over uniform expectation",
+    "max_shuffle_retries": "bounded shape palette for overflow retries",
+    "intermediate_compression": "channel compression: None or 'zlib'",
+    "sample_rate": "range-partition sampler rate (reference 0.1%)",
+    "event_log_dir": "JSONL event-log directory (Calypso); None disables",
+    "profile_dir": "XLA/JAX profiler output directory; None disables",
+    "checkpoint_dir": "stage-output checkpoint directory; None disables",
+    "checkpoint_retain_seconds": "checkpoint retention lease; None keeps",
+    "io_threads": "host-side IO thread count (DRYAD_THREADS_PER_WORKER)",
+    "outlier_sigmas": "speculative-duplication outlier threshold (sigmas)",
+    "straggler_floor_ratio": "straggler-threshold floor over trimmed mean",
+    "coded_redundancy": "k-of-n coded spares for linear partial aggregates",
+    "coded_parity_tasks": "max parity spares r per coded stage",
+    "coded_max_amplification": "float-decode rounding amplification guard",
+    "retry_backoff_base": "transient-retry backoff base seconds",
+    "retry_backoff_max": "transient-retry backoff cap seconds",
+    "retry_jitter": "seeded retry-backoff jitter fraction",
+    "retry_seed": "retry-jitter RNG seed",
+    "broadcast_limit": "broadcast-join max replicated right-side rows",
+    "topk_limit": "order_by+take fuses to shuffle-free top-k at or below",
+    "auto_dense_strings": "single-STRING-key group_by lowers to MXU buckets",
+    "auto_dense_ints": "bounded-INT32-key group_by rides the dense path",
+    "auto_dense_limit": "dense-key domain cap for the MXU bucket path",
+    "stringcode_runtime_tables": "code tables ship as palette operands",
+    "device_cache_bytes": "device-resident ingest cache budget; 0 off",
+    "rows_per_vertex": "target rows per independent vertex task",
+    "plan_fuse": "whole-DAG SPMD fusion into one dispatched program",
+    "overflow_sync_depth": "speculative dispatches per overflow readback",
+    "tail_fanout_rows": "static row bound enabling tail fan-out; 0 off",
+    "tail_rows_per_partition": "rows per partition after tail fan-out",
+    "stream_bucket_rows": "max rows per phase-2 bucket before re-split",
+    "stream_combine_rows": "partial-accumulator compaction threshold",
+    "stream_buckets": "phase-1 spill fan-out (bucket count)",
+    "stream_spill_dir": "spill directory; None = fresh tempdir",
+    "stream_pipeline_depth": "chunks in flight across the ooc pipeline",
+    "stream_writer_queue": "background spill-writer queue, in pieces",
+    "combine_tree": "topology-aware hierarchical streaming combines",
+    "combine_tree_fan": "max batches folded per tree-group flush",
+    "combine_tree_ranges": "key-range histogram resolution (power of two)",
+    "combine_tree_groups": "level-0 tree groups; 0 = auto from topology",
+    "combine_tree_degrade_ratio": "per-range host-degrade distinct ratio",
+    "stream_host_reprobe": "reducing host combines before device re-probe",
+    "obs_events_mem_cap": "EventLog in-memory ring cap; 0 unbounded",
+    "obs_flight_recorder": "crash-forensics ring + blackbox dump hooks",
+    "flightrec_events": "flight-recorder ring capacity in events",
+    "flightrec_snapshot_s": "min seconds between health microsnapshots",
+    "flightrec_dir": "blackbox dump dir; None = event_log_dir or cwd",
+    "obs_diagnosis": "online pathology detection over the live stream",
+    "diagnose_skew_ratio": "partition-skew max/mean row-ratio trigger",
+    "diagnose_recompile_burst": "per-tier compiles in window = storm",
+    "diagnose_cooldown_s": "per-(rule, subject) re-diagnosis cooldown",
+}

@@ -317,3 +317,120 @@ def test_chaos_budget_exhaustion_carries_history(mesh8):
         ).collect()
     assert len(ei.value.attempts) == 3
     assert all(a.kind == "transient" for a in ei.value.attempts)
+
+
+# -- flight recorder forensics (obs.flightrec + tools.blackbox) --------------
+
+
+def test_chaos_worker_kill_leaves_recoverable_blackbox_dumps():
+    """The PR's crash-forensics contract: a seeded FaultPlan kill
+    mid-collective takes the worker down via ``os._exit`` (no atexit,
+    no unwinding) — yet every process leaves a ``blackbox-<pid>.json``
+    under the shared job root, and ``tools.blackbox`` merges them into
+    one clock-corrected timeline whose fatal window contains both the
+    worker-side kill and the driver-side loss detection, in causal
+    order."""
+    import os
+
+    from dryad_tpu.cluster.localjob import LocalJobSubmission
+    from dryad_tpu.tools import blackbox
+
+    rng = np.random.default_rng(3)
+    tbl = {
+        "k": rng.integers(0, 13, 800).astype(np.int32),
+        "v": rng.standard_normal(800).astype(np.float32),
+    }
+    with LocalJobSubmission(num_workers=2, devices_per_worker=1) as sub:
+        root = sub.root
+        ctx = DryadContext(num_partitions_=2)
+        q = ctx.from_arrays(tbl).group_by(
+            "k", {"s": ("sum", "v"), "n": ("count", None)}
+        )
+        sub.submit(q)  # warm run: collects telemetry (clock offsets)
+        sub.inject_fault(
+            None,
+            plan={"seed": 3, "worker_kill_prob": 1.0,
+                  "max_worker_kills": 1, "stages": ["group_by"]},
+            workers=[1],
+        )
+        sub.submit(q)  # kill + auto-recovery
+        dump_dir = os.path.join(root, "blackbox")
+        dumps = blackbox.load_dumps(dump_dir)
+        roles = {d["role"] for d in dumps}
+        # the killed worker dumped BEFORE os._exit, the driver dumped
+        # on detecting the loss
+        assert "driver" in roles and "worker-1" in roles, roles
+        killed = [
+            d for d in dumps
+            if d["reason"].startswith("worker_killed:")
+        ]
+        assert killed and killed[0]["role"] == "worker-1"
+        drv = [d for d in dumps if d["role"] == "driver"][0]
+        assert drv["reason"].startswith("gang_member_lost:")
+        # the warm run's telemetry drain left the offset table the
+        # merge corrects with
+        assert drv["info"].get("worker_offsets")
+    # after shutdown the surviving workers dumped too (atexit)
+    dumps = blackbox.load_dumps(os.path.join(root, "blackbox"))
+    assert len(dumps) >= 3
+    merged = blackbox.merge(dumps, window_s=30.0)
+    kinds = [e["kind"] for e in merged["events"]]
+    assert "worker_killed_injected" in kinds
+    assert "gang_member_lost_mid_job" in kinds
+    # causal order survives the merge: the injected kill precedes the
+    # driver noticing the dead gang member
+    assert kinds.index("worker_killed_injected") < kinds.index(
+        "gang_member_lost_mid_job"
+    )
+    # every source is clock-tagged in the summary and the text render
+    # names the fatal window
+    text = blackbox.render(merged)
+    assert "worker_killed" in text
+    assert "clock_offset" in text
+
+
+def test_chaos_straggler_diagnosed_and_parity_prelaunched():
+    """The diagnosis->control loop: a 6s injected straggler on one
+    coded vertex is (1) diagnosed online (``straggler`` rule, in-flight
+    evidence) and (2) masked by parity pre-launched from PRIOR-job
+    statistics — trigger ``straggler``, zero failures, makespan far
+    under the injected delay."""
+    from dryad_tpu.cluster.localjob import LocalJobSubmission
+
+    DELAY = 6.0
+    rng = np.random.default_rng(5)
+    tbl = {
+        "k": rng.integers(0, 20, 3000).astype(np.int32),
+        "v": rng.integers(-100, 100, 3000).astype(np.int32),
+    }
+    ctx = DryadContext(num_partitions_=1)
+    q = ctx.from_arrays(tbl).group_by(
+        "k", {"c": ("count", None), "s": ("sum", "v")}
+    )
+    with LocalJobSubmission(num_workers=2, devices_per_worker=1) as sub:
+        out0 = sub.submit_partitioned(q, nparts=2, coded=True)  # seeds stats
+        assert sub.diagnosis.stats_for("coded").durations, (
+            "warm run must feed the engine's coded duration model"
+        )
+        sub.inject_delay(worker=1, seconds=DELAY, count=1)
+        t0 = time.monotonic()
+        out = sub.submit_partitioned(q, nparts=2, coded=True)
+        dt = time.monotonic() - t0
+        assert dt < DELAY - 1.0, f"straggler not masked ({dt:.1f}s)"
+        for c in out0:
+            assert out0[c].tobytes() == out[c].tobytes(), c
+        evs = sub.events.events()
+        # zero failures: this was pure pre-launch, not failure masking
+        assert [e for e in evs if e["kind"] == "coded_task_failed"] == []
+        launches = [e for e in evs if e["kind"] == "coded_launch"]
+        assert launches and launches[-1]["trigger"] == "straggler"
+        diags = [
+            e for e in evs
+            if e["kind"] == "diagnosis" and e["rule"] == "straggler"
+        ]
+        assert diags, "no online straggler diagnosis emitted"
+        assert diags[-1]["evidence"]["in_flight"] is True
+        # the diagnosis precedes the launch it drove
+        assert evs.index(diags[-1]) < evs.index(launches[-1])
+        # and the engine retained it for explain/jobview
+        assert "straggler" in [d["rule"] for d in sub.diagnosis.diagnoses()]

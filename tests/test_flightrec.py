@@ -1,0 +1,390 @@
+"""Flight recorder + online diagnosis unit coverage.
+
+The crash-forensics half (``obs.flightrec`` + ``tools.blackbox``) is
+exercised on synthetic dumps and rings here; the full multi-process
+kill path lives in ``tests/test_chaos.py``.  The diagnosis half
+(``obs.diagnose``) gets one test per named pathology — each fold is a
+contract: THIS event pattern produces THIS rule.
+"""
+
+import json
+import os
+
+import pytest
+
+from dryad_tpu.exec.events import EventLog
+from dryad_tpu.obs import flightrec
+from dryad_tpu.obs.diagnose import DiagnosisEngine, RULES, scan
+from dryad_tpu.obs.flightrec import FlightRecorder
+from dryad_tpu.tools import blackbox
+
+
+@pytest.fixture(autouse=True)
+def _no_global_recorder():
+    """Tests must not leak a process recorder into each other."""
+    yield
+    flightrec.uninstall_recorder()
+
+
+# -- EventLog ring-overflow accounting (the silent-eviction fix) -------------
+
+
+def test_eventlog_counts_evictions_and_emits_marker():
+    log = EventLog(None, mem_cap=8)
+    for i in range(30):
+        log.emit("note", text=f"e{i}")
+    assert log.dropped >= 20  # evictions are counted, not silent
+    assert len(log.events()) == 8
+    # markers are O(log drops), not one per eviction (no self-flood):
+    # keep emitting with a tap attached and count marker emissions
+    tap_seen = []
+    log.add_tap(tap_seen.append)
+    for i in range(200):
+        log.emit("note", text=f"x{i}")
+    marks = [e for e in tap_seen if e["kind"] == "events_dropped"]
+    assert marks, "ring overflow must announce itself"
+    assert len(marks) < 10  # doubling schedule, not per-event flood
+    # each marker carries the cumulative eviction total at emission
+    assert marks[-1]["dropped"] <= log.dropped
+    assert marks == sorted(marks, key=lambda e: e["dropped"])
+
+
+def test_eventlog_no_marker_without_cap():
+    log = EventLog(None, mem_cap=None)
+    for i in range(100):
+        log.emit("note", text=str(i))
+    assert log.dropped == 0
+    assert log.filter("events_dropped") == []
+
+
+def test_eventlog_tap_errors_are_swallowed():
+    log = EventLog(None, mem_cap=16)
+
+    def bad(ev):
+        raise RuntimeError("tap bug")
+
+    seen = []
+    log.add_tap(bad)
+    log.add_tap(seen.append)
+    log.emit("note", text="ok")
+    assert seen and seen[0]["kind"] == "note"
+    log.remove_tap(bad)
+    log.remove_tap(bad)  # double-remove is a no-op
+    log.emit("note", text="ok2")
+    assert len(seen) == 2
+
+
+# -- FlightRecorder ----------------------------------------------------------
+
+
+def test_recorder_ring_is_bounded_and_dump_is_atomic(tmp_path):
+    rec = FlightRecorder(capacity=16, snapshot_s=0.0, dump_dir=str(tmp_path))
+    for i in range(100):
+        rec.record({"kind": "note", "ts": float(i), "text": str(i)})
+    path = rec.dump("test_reason")
+    assert path is not None and os.path.exists(path)
+    assert not [p for p in os.listdir(tmp_path) if ".tmp." in p]
+    with open(path) as fh:
+        d = json.load(fh)
+    assert d["reason"] == "test_reason"
+    assert len(d["events"]) == 16  # ring bounded
+    assert d["events"][-1]["text"] == "99"  # most recent survive
+    assert d["pid"] == os.getpid()
+    # repeated dumps overwrite but retain every reason
+    rec.dump("second_reason")
+    with open(path) as fh:
+        d2 = json.load(fh)
+    assert d2["reason"] == "second_reason"
+    assert d2["reasons"] == ["test_reason", "second_reason"]
+
+
+def test_recorder_probes_feed_snapshots(tmp_path):
+    rec = FlightRecorder(capacity=8, snapshot_s=0.0, dump_dir=str(tmp_path))
+    rec.probe("inflight", lambda: 3)
+    rec.probe("broken", lambda: 1 / 0)  # failing probe: sample skipped
+    snap = rec.snapshot()
+    assert snap["inflight"] == 3
+    assert "broken" not in snap
+    assert "ts" in snap and "mono" in snap
+    rec.unprobe("inflight")
+    assert "inflight" not in rec.snapshot()
+
+
+def test_install_taps_events_and_uninstall_detaches(tmp_path):
+    log = EventLog(None, mem_cap=64)
+    rec = flightrec.install_recorder(
+        capacity=8, dump_dir=str(tmp_path), role="driver", events=log
+    )
+    assert flightrec.get_recorder() is rec
+    log.emit("note", text="hello")
+    assert any(e.get("text") == "hello" for e in rec._ring)
+    flightrec.probe("x", lambda: 1)  # module helpers hit the singleton
+    assert rec.snapshot()["x"] == 1
+    path = flightrec.dump_now("why")
+    assert path and os.path.basename(path) == f"blackbox-{os.getpid()}.json"
+    flightrec.uninstall_recorder()
+    assert flightrec.get_recorder() is None
+    assert flightrec.dump_now("nobody") is None
+    log.emit("note", text="after")  # detached: ring unchanged
+    assert not any(e.get("text") == "after" for e in rec._ring)
+
+
+def test_install_replaces_previous_tap(tmp_path):
+    log1 = EventLog(None, mem_cap=16)
+    rec1 = flightrec.install_recorder(events=log1, dump_dir=str(tmp_path))
+    rec2 = flightrec.install_recorder(events=log1, dump_dir=str(tmp_path))
+    log1.emit("note", text="once")
+    assert not any(e.get("text") == "once" for e in rec1._ring)
+    assert sum(1 for e in rec2._ring if e.get("text") == "once") == 1
+
+
+# -- DiagnosisEngine: one test per pathology ---------------------------------
+
+
+def _engine():
+    log = EventLog(None, mem_cap=256)
+    eng = DiagnosisEngine(events=log)
+    log.add_tap(eng.observe)
+    return eng, log
+
+
+def _rules_of(eng):
+    return [d["rule"] for d in eng.diagnoses()]
+
+
+def test_recompile_storm():
+    eng, log = _engine()
+    for i in range(4):
+        log.emit("xla_compile", stage="agg", key=f"k{i}",
+                 trace_s=0.01, compile_s=0.1)
+    assert "recompile_storm" in _rules_of(eng)
+    d = eng.diagnoses()[0]
+    assert d["severity"] == "error"
+    assert d["evidence"]["distinct_keys"] == 4
+    # the diagnosis went back into the SAME stream, schema'd
+    diag_evs = log.filter("diagnosis")
+    assert diag_evs and diag_evs[0]["rule"] == "recompile_storm"
+
+
+def test_straggler_completed_duration_outlier():
+    eng, log = _engine()
+    for _ in range(3):
+        log.emit("stage_complete", name="sort", seconds=1.0,
+                 version=1, rows=10, async_dispatch=False, deferred=False)
+    log.emit("stage_complete", name="sort", seconds=10.0,
+             version=1, rows=10, async_dispatch=False, deferred=False)
+    assert "straggler" in _rules_of(eng)
+    d = next(x for x in eng.diagnoses() if x["rule"] == "straggler")
+    assert d["evidence"]["in_flight"] is False
+    assert d["evidence"]["family"] == "stage:sort"
+
+
+def test_straggler_inflight_feeds_spare_threshold():
+    eng, log = _engine()
+    # three completed coded tasks arm the family threshold...
+    for j in range(3):
+        log.emit("coded_task_complete", seq=1, coded=j, parity=False,
+                 seconds=1.0)
+    thr = eng.spare_threshold("coded")
+    assert thr is not None and thr == pytest.approx(1.5)
+    # ...and an in-flight task over it emits the proactive diagnosis
+    got = eng.note_inflight("coded", 5.0, subject="coded2")
+    assert got == pytest.approx(thr)
+    d = next(x for x in eng.diagnoses() if x["rule"] == "straggler")
+    assert d["evidence"]["in_flight"] is True
+    assert d["subject"] == "coded2"
+    # under the threshold: no emission, returns None
+    assert eng.note_inflight("coded", 0.1) is None
+
+
+def test_partition_skew_from_spill_events():
+    eng, log = _engine()
+    for b, rows in enumerate([10, 10, 10, 10, 200]):
+        log.emit("stream_spill", bucket=b, depth=0, rows=rows)
+    assert "partition_skew" in _rules_of(eng)
+    d = next(x for x in eng.diagnoses() if x["rule"] == "partition_skew")
+    assert d["evidence"]["hot_bucket"] == 4
+
+
+def test_partition_skew_from_metrics_histogram():
+    eng, log = _engine()
+    log.emit("metrics", counters={}, hists=[{
+        "name": "partition_rows", "labels": "depth=0",
+        "n": 8, "sum": 800, "min": 1, "max": 500, "buckets": {},
+    }])
+    assert "partition_skew" in _rules_of(eng)
+
+
+def test_stall_dominance():
+    eng, log = _engine()
+    log.emit("span", name="exec", cat="execute", dur=0.5)
+    log.emit("stream_pipeline", pipeline="ingest", depth=3,
+             consumer_wait_s=5.0)
+    assert "stall_dominance" in _rules_of(eng)
+    ev = next(
+        x for x in eng.diagnoses() if x["rule"] == "stall_dominance"
+    )["evidence"]
+    assert ev["ingest_stall_s"] == pytest.approx(5.0)
+
+
+def test_quarantine_churn():
+    eng, log = _engine()
+    log.emit("computer_quarantined", computer="worker1", failures=3,
+             cooldown_s=5.0)
+    assert "quarantine_churn" not in _rules_of(eng)  # once is policy
+    log.emit("computer_quarantined", computer="worker1", failures=3,
+             cooldown_s=10.0)
+    assert "quarantine_churn" in _rules_of(eng)
+
+
+def test_combine_thrash():
+    eng, log = _engine()
+    for mode in ("device", "host", "device", "host"):
+        log.emit("stream_combine_policy", mode=mode, chunks=4)
+    assert "combine_thrash" in _rules_of(eng)
+    assert eng.diagnoses()[0]["evidence"]["flips"] == 3
+
+
+def test_overflow_loop():
+    eng, log = _engine()
+    log.emit("stage_overflow", name="shuffle", stage="s1", boost=2,
+             version=1)
+    log.emit("stage_overflow", name="shuffle", stage="s1", boost=4,
+             version=2)
+    assert "overflow_loop" in _rules_of(eng)
+
+
+def test_cooldown_dedup_and_no_feedback_loop():
+    eng, log = _engine()
+    for _ in range(2):
+        for mode in ("device", "host", "device", "host"):
+            log.emit("stream_combine_policy", mode=mode, chunks=4)
+    # cooldown: one record despite the pathology persisting
+    assert _rules_of(eng).count("combine_thrash") == 1
+    # the emitted diagnosis event was observed but NOT re-folded
+    assert len(log.filter("diagnosis")) == 1
+
+
+def test_every_rule_has_severity_and_hint():
+    for rule, (severity, hint) in RULES.items():
+        assert severity in ("warn", "error"), rule
+        assert hint and "\n" not in hint, rule
+
+
+def test_offline_scan_replays_a_recorded_stream():
+    events = [
+        {"kind": "xla_compile", "stage": "agg", "key": f"k{i}",
+         "trace_s": 0.01, "compile_s": 0.1}
+        for i in range(5)
+    ]
+    found = scan(events)
+    # cooldown is zeroed offline: the storm re-announces while it lasts
+    assert found and {d["rule"] for d in found} == {"recompile_storm"}
+
+
+# -- blackbox merge ----------------------------------------------------------
+
+
+def _write_dump(dirpath, pid, role, worker, events, info=None, dropped=0):
+    d = {
+        "version": 1, "pid": pid, "role": role, "worker": worker,
+        "reason": "test", "reasons": ["test"], "wall": 1000.0,
+        "mono": 5.0, "dropped": dropped, "info": info or {},
+        "events": events, "snapshots": [],
+    }
+    path = os.path.join(dirpath, f"blackbox-{pid}.json")
+    with open(path, "w") as fh:
+        json.dump(d, fh)
+    return path
+
+
+def test_blackbox_merge_clock_corrects_and_trims(tmp_path):
+    # driver clock is truth; worker 1's clock runs 5s BEHIND, and the
+    # driver dump carries the offset (as obs.gang measured it)
+    _write_dump(
+        str(tmp_path), 100, "driver", None,
+        [{"kind": "gang_run_start", "ts": 1000.0, "seq": 1, "workers": 2},
+         {"kind": "gang_member_lost_mid_job", "ts": 1010.0,
+          "dead": [1], "attempt": 1}],
+        info={"worker_offsets": {"1": 5.0}},
+    )
+    _write_dump(
+        str(tmp_path), 200, "worker-1", 1,
+        [{"kind": "vertex_start", "ts": 996.0, "part": 0},
+         {"kind": "worker_killed_injected", "ts": 1004.9, "stage": "agg",
+          "prob": 1.0}],
+        dropped=7,
+    )
+    dumps = blackbox.load_dumps(str(tmp_path))
+    assert len(dumps) == 2
+    merged = blackbox.merge(dumps, window_s=30.0)
+    # worker events shifted onto the driver clock (+5s)
+    by_kind = {e["kind"]: e for e in merged["events"]}
+    assert by_kind["vertex_start"]["ts"] == pytest.approx(1001.0)
+    assert by_kind["worker_killed_injected"]["ts"] == pytest.approx(1009.9)
+    assert by_kind["worker_killed_injected"]["worker"] == 1
+    assert "worker" not in by_kind["gang_run_start"]
+    # ordering is the corrected one: the kill lands BEFORE the driver
+    # notices the loss
+    kinds = [e["kind"] for e in merged["events"]]
+    assert kinds.index("worker_killed_injected") < kinds.index(
+        "gang_member_lost_mid_job"
+    )
+    assert merged["fatal_ts"] == pytest.approx(1010.0)
+    assert merged["dropped"] == 7
+    text = blackbox.render(merged)
+    assert "driver" in text and "worker-1" in text
+    assert "truncated" in text  # dropped events are called out
+    # narrow window trims the early event
+    narrow = blackbox.merge(dumps, window_s=2.0)
+    assert [e["kind"] for e in narrow["events"]] == [
+        "worker_killed_injected", "gang_member_lost_mid_job",
+    ]
+
+
+def test_blackbox_cli_trace_and_diagnose(tmp_path, capsys):
+    _write_dump(
+        str(tmp_path), 300, "driver", None,
+        [{"kind": "xla_compile", "ts": 1000.0 + i, "stage": "agg",
+          "key": f"k{i}", "trace_s": 0.01, "compile_s": 0.1}
+         for i in range(5)],
+    )
+    trace = str(tmp_path / "out.json")
+    rc = blackbox.main([str(tmp_path), "--trace", trace, "--diagnose"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "blackbox merge" in out
+    assert "recompile_storm" in out  # offline scan over the merge
+    with open(trace) as fh:
+        tr = json.load(fh)
+    assert tr["traceEvents"]
+
+
+def test_blackbox_cli_errors(tmp_path, capsys):
+    assert blackbox.main([]) == 2
+    assert blackbox.main([str(tmp_path)]) == 1  # no dumps
+
+
+# -- surfacing panels --------------------------------------------------------
+
+
+def test_jobview_health_panel():
+    from dryad_tpu.tools.jobview import render_health
+
+    log = EventLog(None, mem_cap=64)
+    eng = DiagnosisEngine(events=log)
+    log.add_tap(eng.observe)
+    assert render_health(log.events()) == ""
+    for b, rows in enumerate([10, 10, 10, 10, 200]):
+        log.emit("stream_spill", bucket=b, depth=0, rows=rows)
+    text = render_health(log.events())
+    assert "partition_skew" in text and "hint:" in text
+
+
+def test_explain_diagnoses_panel_without_engine():
+    from dryad_tpu.tools.explain import explain_diagnoses
+
+    class Ctx:
+        diagnosis = None
+
+    assert "diagnosis engine off" in explain_diagnoses(Ctx())

@@ -35,6 +35,7 @@ def test_registry_has_every_expected_rule():
         "operand-registry", "fuse-classification", "host-transfer",
         "layer-imports", "placement-snapshot", "coded-linearity",
         "event-schema", "kernel-determinism", "recompile-hazard",
+        "span-discipline", "config-key",
     }
     assert expected == set(all_checkers())
     assert {"bad-suppression", "unused-suppression"} <= set(known_rules())

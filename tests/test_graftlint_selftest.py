@@ -585,3 +585,152 @@ def test_retry_backoff_golden_values_are_process_stable():
     assert round(p.backoff("stage:4", 1), 12) == 0.065387691467
     # and the schedule is reproducible within a process too
     assert p.backoff("stage:3", 1) == p.backoff("stage:3", 1)
+
+
+# -- span-discipline ---------------------------------------------------------
+
+SPANPY = "dryad_tpu/obs/span.py"
+STREAM = "dryad_tpu/exec/stream.py"
+
+SPAN_FIXTURE = {
+    SPANPY: '''\
+class Span:
+    pass
+
+
+class Tracer:
+    def span(self, name, **kw):
+        return Span()
+''',
+    STREAM: '''\
+from dryad_tpu.obs.span import Tracer
+
+tracer = Tracer()
+
+
+def run_stage(chunks):
+    with tracer.span("execute", cat="execute"):
+        for c in chunks:
+            with tracer.span("chunk", cat="stream") as sp:
+                pass
+''',
+}
+
+
+def test_span_discipline_clean_fixture():
+    assert _rules(SPAN_FIXTURE, "span-discipline") == []
+
+
+@pytest.mark.parametrize(
+    "old,new",
+    [
+        # span held as a value: never closes on the exception path
+        (
+            'with tracer.span("execute", cat="execute"):',
+            'sp = tracer.span("execute", cat="execute")\n'
+            "    if True:",
+        ),
+        # span opened inside an expression, not a with-item
+        (
+            'with tracer.span("chunk", cat="stream") as sp:',
+            'sp = enter(tracer.span("chunk", cat="stream"))\n'
+            "            if True:",
+        ),
+        # direct Span construction bypasses the tracer factory
+        (
+            "for c in chunks:",
+            "bare = Span()\n    for c in chunks:",
+        ),
+    ],
+)
+def test_span_discipline_fires(old, new):
+    _assert_fires(_mutate(SPAN_FIXTURE, STREAM, old, new),
+                  "span-discipline", n=1)
+
+
+def test_span_discipline_exempts_span_py_itself():
+    # the factory file returns Spans by design
+    assert _rules(
+        {SPANPY: SPAN_FIXTURE[SPANPY]}, "span-discipline"
+    ) == []
+
+
+# -- config-key --------------------------------------------------------------
+
+CONFIGPY = "dryad_tpu/utils/config.py"
+USER = "dryad_tpu/exec/driver.py"
+
+CONFIG_FIXTURE = {
+    CONFIGPY: '''\
+class DryadConfig:
+    chunk_rows: int = 4096
+    straggler_floor_ratio: float = 1.5
+
+    def validate(self):
+        pass
+
+
+CONFIG_KEYS = {
+    "chunk_rows": "rows per streamed chunk",
+    "straggler_floor_ratio": "spare-launch floor multiplier",
+}
+''',
+    USER: '''\
+def run(ctx, cfg):
+    ctx.config.validate()
+    n = ctx.config.chunk_rows
+    ratio = cfg.straggler_floor_ratio
+    return getattr(ctx.config, "chunk_rows", n) * ratio
+
+
+def tune(runtime):
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+''',
+}
+
+
+def test_config_key_clean_fixture():
+    assert _rules(CONFIG_FIXTURE, "config-key") == []
+
+
+@pytest.mark.parametrize(
+    "path,old,new",
+    [
+        # typo'd attribute read (the bug the rule exists for)
+        (USER, "ctx.config.chunk_rows", "ctx.config.chunks_rows"),
+        # typo'd getattr key: silently returns the default forever
+        (
+            USER,
+            'getattr(ctx.config, "chunk_rows", n)',
+            'getattr(ctx.config, "chunk_row", n)',
+        ),
+        # field added to the dataclass but not documented
+        (
+            CONFIGPY,
+            "chunk_rows: int = 4096",
+            "chunk_rows: int = 4096\n    new_knob: int = 1",
+        ),
+        # stale schema entry: key documented, field deleted
+        (
+            CONFIGPY,
+            "    straggler_floor_ratio: float = 1.5\n",
+            "",
+        ),
+        # doc must be a non-empty one-liner
+        (
+            CONFIGPY,
+            '"rows per streamed chunk"',
+            '""',
+        ),
+    ],
+)
+def test_config_key_fires(path, old, new):
+    _assert_fires(_mutate(CONFIG_FIXTURE, path, old, new), "config-key")
+
+
+def test_config_key_ignores_jax_config():
+    # jax.config.update is a different animal — never checked
+    assert "jax.config.update" in CONFIG_FIXTURE[USER]
+    assert _rules(CONFIG_FIXTURE, "config-key") == []

@@ -63,9 +63,16 @@ class TestEventLog:
         for i in range(10):
             log.emit("stream_chunk", i=i)
         mem = log.events()
-        assert [e["i"] for e in mem] == [6, 7, 8, 9]
+        # the ring evicts the oldest; an events_dropped marker flags
+        # the truncation so consumers can tell it from quiet history
+        data = [e for e in mem if e["kind"] == "stream_chunk"]
+        assert [e["i"] for e in data] == list(range(10 - len(data), 10))
+        assert len(mem) <= 4
+        assert log.dropped >= 10 - len(data)  # markers evict too
+        assert any(e["kind"] == "events_dropped" for e in mem)
         log.close()
-        assert [e["i"] for e in EventLog.load(path)] == list(range(10))
+        loaded = [e for e in EventLog.load(path) if e["kind"] == "stream_chunk"]
+        assert [e["i"] for e in loaded] == list(range(10))
 
     def test_drain_and_absorb(self):
         src, dst = EventLog(None), EventLog(None)
