@@ -689,6 +689,138 @@ def aggtree_metric(n: int, chunk_rows: int = 1 << 14):
     )
 
 
+# Child body for ooc_exchange_metric: the staged exchange only does
+# anything on a multi-device mesh (P=1 short-circuits to the flat
+# path), so the window sweep runs on 8 virtual CPU devices in a fresh
+# subprocess — same reasoning as the aggtree child.
+_OOCXCHG_CHILD = r"""
+import json, os, sys, time
+import numpy as np
+
+from dryad_tpu.parallel.mesh import force_cpu_backend
+
+force_cpu_backend(8)
+
+import jax
+
+try:  # persistent compile cache: reruns skip the pow2-palette compiles
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get("DRYAD_BENCH_JAX_CACHE", "/tmp/dryad_jax_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+except Exception:
+    pass
+
+from dryad_tpu import DryadConfig, DryadContext
+from dryad_tpu.obs.metrics import JobMetrics
+
+nchunks, chunk_rows = int(sys.argv[1]), int(sys.argv[2])
+
+
+def chunks():
+    rng = np.random.default_rng(7)
+    for _ in range(nchunks):
+        yield {
+            "key": rng.integers(
+                -(2 ** 31), 2 ** 31 - 1, chunk_rows
+            ).astype(np.int32),
+            "v": rng.integers(-1000, 1000, chunk_rows).astype(np.int64),
+        }
+
+
+def run(bucket_rows, window):
+    ctx = DryadContext(config=DryadConfig(
+        stream_bucket_rows=bucket_rows, stream_buckets=8,
+        exchange_window=window,
+    ))
+
+    def once():
+        return ctx.from_stream(chunks()).order_by(["key"]).collect()
+
+    once()  # warm: pays every compile at this shape palette
+    mark = len(ctx.executor.events.events())
+    t0 = time.perf_counter()
+    out = once()
+    dt = time.perf_counter() - t0
+    assert len(out["key"]) == nchunks * chunk_rows
+    assert (np.diff(out["key"]) >= 0).all()
+    ev = ctx.executor.events.events()[mark:]
+    m = JobMetrics.from_events(ev)
+    return {
+        "rows_per_sec": round(nchunks * chunk_rows / dt, 1),
+        "seconds": round(dt, 3),
+        "window": window,
+        "bucket_rows": bucket_rows,
+        "dispatches": sum(1 for e in ev if e["kind"] == "stage_start"),
+        "exchange_rounds": m.exchange_rounds,
+        "peak_exchange_bytes": m.peak_exchange_bytes,
+        "spill_bytes": m.spill_bytes,
+    }
+
+
+res = {}
+for bucket_rows in (chunk_rows, 4 * chunk_rows):
+    res[str(bucket_rows)] = {
+        str(w): run(bucket_rows, w) for w in (0, 2, 4)
+    }
+print(json.dumps(res))
+"""
+
+
+def ooc_exchange_metric(n: int, chunk_rows: int = 1 << 15):
+    """Memory-bounded exchange planner on the out-of-core range sort
+    (plan/xchgplan.py): window in {0, 2, 4} x two stream-bucket sizes
+    on an 8-device virtual mesh.  window=0 is the flat all_to_all
+    (peak send buffer P*B*row_bytes per device); a positive window
+    stages the exchange into ppermute rounds bounded at
+    window*B*row_bytes, and the streaming driver spends the reclaimed
+    HBM on larger buckets (exec/outofcore chunk sizing) — fewer device
+    dispatches and spill pieces at equal-or-better rows/s.  Reports
+    rows/s, dispatch count, exchange_round count, peak per-device
+    exchange bytes, and spill bytes per (bucket_rows, window) cell."""
+    import subprocess
+
+    nchunks = max(3, n // chunk_rows)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-c", _OOCXCHG_CHILD,
+         str(nchunks), str(chunk_rows)],
+        capture_output=True, text=True, timeout=max(remaining(), 120),
+        env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"oocxchg child rc={out.returncode}: {out.stderr[-2000:]}"
+        )
+    res = json.loads(out.stdout.strip().splitlines()[-1])
+    rows = nchunks * chunk_rows
+    small = res[str(chunk_rows)]
+    extra = {"cells": res, "chunks": nchunks, "chunk_rows": chunk_rows,
+             "devices": 8}
+    flat, staged = small["0"], small["2"]
+    # same config: staged spends the reclaimed HBM on 4x buckets, so it
+    # dispatches and spills less while the peak exchange buffer shrinks
+    extra["dispatch_reduction"] = round(
+        flat["dispatches"] / max(staged["dispatches"], 1), 2
+    )
+    extra["spill_reduction"] = round(
+        flat["spill_bytes"] / max(staged["spill_bytes"], 1), 2
+    )
+    # same EFFECTIVE bucket rows (flat at 4x buckets vs staged whose
+    # chunk sizing auto-raises 1x to 4x): the pure peak-HBM bound,
+    # P/window at matched capacity
+    flat_big = res[str(4 * chunk_rows)]["0"]
+    extra["peak_exchange_reduction"] = round(
+        flat_big["peak_exchange_bytes"]
+        / max(staged["peak_exchange_bytes"], 1), 2
+    )
+    return rep_record(
+        "oocxchg_rows_per_sec", rows, [staged["seconds"]], extra
+    )
+
+
 def ooc_wordcount_metric(
     n_words: int, vocab: int = 1 << 14, chunk_bytes: int = 1 << 22
 ):
@@ -1248,6 +1380,12 @@ def child_main() -> None:
         # merge structure and byte accounting are platform-free)
         ("aggtree_rows_per_sec",
          lambda: aggtree_metric(1 << 16, chunk_rows=1 << 13),
+         300, False),
+        # memory-bounded staged exchange vs flat all_to_all on the
+        # out-of-core range sort (8 virtual CPU devices in a
+        # subprocess; peak-byte accounting is platform-free)
+        ("oocxchg_rows_per_sec",
+         lambda: ooc_exchange_metric(1 << 18, chunk_rows=1 << 14),
          300, False),
     ]
     if platform in ("tpu", "axon"):

@@ -1,0 +1,122 @@
+"""Checker: canonical collective ordering inside shard_map bodies.
+
+``collective-order``: every device-path function must issue its mesh
+collectives in the canonical class order
+
+    ppermute  ->  all_to_all  ->  all_gather  ->  reductions
+                                                  (psum/pmin/pmax/
+                                                   psum_scatter)
+
+On a single-controller CPU/TPU simulation any order works, but on real
+multi-controller TPU every process traces and launches collectives
+independently, and two fused kernel bodies that interleave data
+movement with flag reductions in different orders can deadlock the
+fabric (each device parked in a different collective).  A fixed
+class order per function body makes any two fused members' sequences
+mutually consistent by construction — the prerequisite the ROADMAP
+names for trusting the exchange planner's multi-round schedules.
+
+The check is purely syntactic and per-scope: within one function body
+(nested functions and lambdas are separate scopes — they run when
+CALLED, not where they are defined), the ``jax.lax`` collective calls
+must appear in non-decreasing class rank by source position.  Loops
+repeat a subsequence in place, which preserves relative class order,
+so source position is the right proxy for issue order.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Tuple
+
+from dryad_tpu.analysis.core import Checker, Finding, Project, register
+
+# class rank per collective: data permutation, then subgroup exchange,
+# then gathers, then reductions
+COLLECTIVE_RANK = {
+    "ppermute": 0,
+    "all_to_all": 1,
+    "all_gather": 2,
+    "psum": 3,
+    "pmin": 3,
+    "pmax": 3,
+    "psum_scatter": 3,
+}
+
+_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _is_collective(node: ast.AST) -> str:
+    """The collective name when *node* is a ``[jax.]lax.<coll>`` call."""
+    if not isinstance(node, ast.Call):
+        return ""
+    f = node.func
+    if not isinstance(f, ast.Attribute) or f.attr not in COLLECTIVE_RANK:
+        return ""
+    v = f.value
+    if isinstance(v, ast.Name) and v.id == "lax":
+        return f.attr
+    if isinstance(v, ast.Attribute) and v.attr == "lax":
+        return f.attr
+    return ""
+
+
+def _direct_collectives(scope: ast.AST) -> List[Tuple[int, str]]:
+    """Collective calls belonging to *scope* itself, in source order,
+    excluding those inside nested function/lambda scopes."""
+    out: List[Tuple[int, str]] = []
+
+    def visit(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _SCOPES):
+                continue
+            name = _is_collective(child)
+            if name:
+                out.append((child.lineno, name))
+            visit(child)
+
+    visit(scope)
+    out.sort()
+    return out
+
+
+def scope_violations(tree: ast.AST) -> List[Tuple[int, str, str]]:
+    """(line, earlier collective, out-of-order collective) triples."""
+    bad: List[Tuple[int, str, str]] = []
+    for scope in ast.walk(tree):
+        if not isinstance(scope, _SCOPES):
+            continue
+        calls = _direct_collectives(scope)
+        high: Tuple[int, str] = (-1, "")
+        for line, name in calls:
+            rank = COLLECTIVE_RANK[name]
+            if rank < high[0]:
+                bad.append((line, high[1], name))
+            else:
+                high = (rank, name)
+    return bad
+
+
+@register
+class CollectiveOrderChecker(Checker):
+    rule = "collective-order"
+    summary = (
+        "device-path functions issue collectives in canonical class "
+        "order: ppermute -> all_to_all -> all_gather -> reductions"
+    )
+    hint = (
+        "move the data-movement collective ahead of the reduction (or "
+        "split the phases into separate functions)"
+    )
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for src in project.package_files():
+            for line, before, name in scope_violations(src.tree):
+                yield self.finding(
+                    src.rel,
+                    line,
+                    f"collective {name}() issued after {before}() — "
+                    "out of canonical class order; fused shard_map "
+                    "regions on multi-controller TPU can deadlock on "
+                    "inconsistent collective sequences",
+                )

@@ -42,6 +42,7 @@ DEVICE_MODULES = (
     KERNELS_PATH,
     FUSE_PATH,
     COMBINETREE_PATH,
+    "dryad_tpu/plan/xchgplan.py",
     "dryad_tpu/ops/hash.py",
     "dryad_tpu/ops/join.py",
     "dryad_tpu/ops/segmented.py",

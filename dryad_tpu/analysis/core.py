@@ -215,6 +215,7 @@ def _load_builtin() -> None:
     _BUILTIN_LOADED = True
     # imports populate _REGISTRY via @register
     from dryad_tpu.analysis import (  # noqa: F401
+        checks_collectives,
         checks_determinism,
         checks_events,
         checks_fusion,

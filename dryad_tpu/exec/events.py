@@ -53,6 +53,8 @@ EVENT_KINDS: Dict[str, str] = {
     "fuse_break": "plan fusion kept a driver seam; after/before/reason",
     "stage_width_adapt": "observed-volume width adaptation; nparts/of",
     "stage_delay_injected": "fault-injection delay before the attempt",
+    "exchange_round": "one planned exchange round; round/window/bytes/"
+                      "ici_bytes/dcn_bytes (window 0 = flat all_to_all)",
     "dict_miss": "rows outside the dense key domain; stage_name/rows",
     # -- checkpointing (exec.checkpoint / executor) -----------------------
     "stage_checkpoint_hit": "stage served from the checkpoint store",
@@ -178,6 +180,10 @@ EVENT_PAYLOADS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
         ("name", "nparts", "observed_rows", "of", "stage"), (),
     ),
     "stage_delay_injected": (("name", "seconds", "stage"), ()),
+    "exchange_round": (
+        ("bytes", "dcn_bytes", "ici_bytes", "round", "window"),
+        ("name", "stage"),
+    ),
     "dict_miss": (("rows", "stage_name"), ()),
     "stage_checkpoint_hit": (("name", "stage"), ()),
     "stage_checkpoint_saved": (("name", "path", "stage"), ()),

@@ -163,15 +163,23 @@ class _CompileTimed:
     single attribute check.
     """
 
-    __slots__ = ("fn", "_exec", "_name", "_key", "_build_s", "_pending")
+    __slots__ = (
+        "fn", "_exec", "_name", "_key", "_build_s", "_pending",
+        "xchg_rounds",
+    )
 
-    def __init__(self, fn, executor, name, key_hash, build_s):
+    def __init__(self, fn, executor, name, key_hash, build_s,
+                 xchg_rounds=None):
         self.fn = fn
         self._exec = executor
         self._name = name
         self._key = key_hash
         self._build_s = build_s
         self._pending = True
+        # Static exchange-round byte accounting, filled at trace time by
+        # the stage builder's cell (kernels._exchange): one dict per
+        # round, emitted as exchange_round events on every dispatch.
+        self.xchg_rounds = xchg_rounds if xchg_rounds is not None else []
 
     def __call__(self, *args):
         if not self._pending:
@@ -373,21 +381,26 @@ class GraphExecutor:
             )
             axes = mesh_axes(self.mesh)
             sizes = tuple(self.mesh.shape[a] for a in axes)
+            window = self.config.exchange_window
+            cell: List[Dict[str, int]] = []
             if isinstance(run_stage, FusedStage):
                 fn = build_fused_fn(
                     run_stage, self.P, self.config.shuffle_slack, boost,
                     axes, sizes, operand_objs=objs,
+                    window=window, xchg_cell=cell,
                 )
                 compiled = compile_fused(self.mesh, fn)
             else:
                 fn = build_stage_fn(
                     run_stage, self.P, self.config.shuffle_slack, boost,
                     axes, sizes, operand_objs=objs,
+                    window=window, xchg_cell=cell,
                 )
                 compiled = compile_stage(self.mesh, fn)
             hit = _CompileTimed(
                 compiled, self, run_stage.name,
                 _lowering_key_hash(key), time.monotonic() - t0,
+                xchg_rounds=cell,
             )
             self._compiled[key] = hit
         return hit
@@ -1064,6 +1077,14 @@ class GraphExecutor:
                     outs, (overflow, dict_miss) = fn(
                         inputs, self._stage_rep(stage)
                     )
+                    # Static per-round exchange accounting (filled at
+                    # trace time by kernels._exchange): every dispatch
+                    # re-ships these bytes, so emit per attempt.
+                    for rnd in fn.xchg_rounds:
+                        self.events.emit(
+                            "exchange_round", stage=stage.id,
+                            name=stage.name, **rnd,
+                        )
                     counts_dev = None
                     if want_count:
                         import jax.numpy as jnp

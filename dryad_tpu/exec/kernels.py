@@ -23,6 +23,7 @@ from dryad_tpu.ops import shuffle as SH
 from dryad_tpu.ops import sort as SORT
 from dryad_tpu.ops.hash import partition_ids
 from dryad_tpu.parallel.mesh import AXIS
+from dryad_tpu.plan import xchgplan as XP
 
 
 def _round8(n: float) -> int:
@@ -71,12 +72,20 @@ class StageContext:
 
     def __init__(self, P: int, slack: float, boost: int,
                  axes: Tuple[str, ...] = (AXIS,),
-                 axis_sizes: Tuple[int, ...] = ()):
+                 axis_sizes: Tuple[int, ...] = (),
+                 window: int = 0):
         self.P = P
         self.axes = axes
         self.axis_sizes = axis_sizes if axis_sizes else (P,)
         self.slack = slack
         self.boost = boost
+        # Staged-exchange bucket window (config.exchange_window);
+        # 0 = flat all_to_all.
+        self.window = window
+        # Static per-round exchange byte accounting, appended by
+        # _exchange at trace time and surfaced by the executor as
+        # exchange_round events (no device readback involved).
+        self.xchg_log: List[Dict[str, int]] = []
         self.slots: Dict[int, ColumnBatch] = {}
         self.entry_caps: Dict[int, int] = {}
         # id(param object) -> tuple of traced operand arrays (bound
@@ -178,6 +187,33 @@ def _fanout(ctx: StageContext, nparts) -> int:
     return min(int(nparts), ctx.P)
 
 
+def _exchange(
+    ctx: StageContext, b: ColumnBatch, dest, P: int, B: int, axes
+) -> Tuple[ColumnBatch, jax.Array]:
+    """Route one repartition through the flat or staged exchange.
+
+    ``ctx.window >= 1`` lowers the all-to-all into the planner's
+    ppermute schedule (``plan.xchgplan``), bounding peak extra HBM at
+    O(window * B) per device; 0 keeps the flat single-collective path.
+    Either way the round-by-round byte accounting — a trace-time
+    constant — lands on ``ctx.xchg_log`` for the executor to emit as
+    ``exchange_round`` events.
+    """
+    if len(axes) == 2:
+        dcn = ctx.axis_sizes[0]
+    elif len(ctx.axes) == 2 and axes[0] == ctx.axes[0]:
+        dcn = P  # exchange over the DCN axis alone: every hop crosses
+    else:
+        dcn = 1
+    per_row = SH.row_bytes(b)
+    if ctx.window < 1 or P == 1:
+        ctx.xchg_log.append(XP.flat_accounting(P, dcn, B, per_row))
+        return SH.exchange(b, dest, P, B, axes)
+    schedule = XP.plan_exchange(P, ctx.window, dcn)
+    ctx.xchg_log.extend(schedule.accounting(B, per_row))
+    return SH.exchange_staged(b, dest, P, B, axes, schedule)
+
+
 def _do_exchange_hash(
     ctx: StageContext, slot: int, keys, tree=None, nparts=None
 ) -> None:
@@ -188,7 +224,7 @@ def _do_exchange_hash(
     P_eff = _fanout(ctx, nparts)
     dest = partition_ids([b.data[k] for k in keys], P_eff)
     B = SH.bucket_capacity(b.capacity, P_eff, ctx.slack * ctx.boost)
-    out, ovf = SH.exchange(b, dest, ctx.P, B, ctx.axes)
+    out, ovf = _exchange(ctx, b, dest, ctx.P, B, ctx.axes)
     ctx.slots[slot] = out
     ctx.overflow = ctx.overflow | ovf
 
@@ -214,8 +250,8 @@ def _tree_exchange_hash(ctx: StageContext, slot: int, keys, tree) -> None:
     # Hop 1: within-slice exchange over ICI to local index g %% P_ici.
     b = ctx.slots[slot]
     B1 = SH.bucket_capacity(b.capacity, P_in, slack)
-    out, ovf = SH.exchange(
-        b, dest_global(b) % P_in, P_in, B1, (ctx.axes[1],)
+    out, ovf = _exchange(
+        ctx, b, dest_global(b) % P_in, P_in, B1, (ctx.axes[1],)
     )
     ctx.overflow = ctx.overflow | ovf
     out, ovf = SH.resize(out, _round8(b.capacity * ctx.slack))
@@ -233,8 +269,8 @@ def _tree_exchange_hash(ctx: StageContext, slot: int, keys, tree) -> None:
 
     # Hop 2: cross-slice exchange over DCN to slice g // P_ici.
     B2 = SH.bucket_capacity(out.capacity, D, slack)
-    out2, ovf = SH.exchange(
-        out, dest_global(out) // P_in, D, B2, (ctx.axes[0],)
+    out2, ovf = _exchange(
+        ctx, out, dest_global(out) // P_in, D, B2, (ctx.axes[0],)
     )
     ctx.overflow = ctx.overflow | ovf
     ctx.slots[slot] = out2
@@ -310,7 +346,7 @@ def _k_exchange_range(ctx: StageContext, p) -> None:
         )
         dest = SORT.range_dest(operands[0], splitters)
     B = SH.bucket_capacity(b.capacity, P_eff, ctx.slack * ctx.boost)
-    out, ovf = SH.exchange(b, dest, ctx.P, B, ctx.axes)
+    out, ovf = _exchange(ctx, b, dest, ctx.P, B, ctx.axes)
     ctx.slots[p["slot"]] = out
     ctx.overflow = ctx.overflow | ovf
 
@@ -693,7 +729,7 @@ def _exchange_by_rank(
     rank = b.data["#rank"].astype(jnp.int32)
     dest = jnp.clip(rank // per, 0, ctx.P - 1)
     B = SH.bucket_capacity(b.capacity, ctx.P, ctx.slack * ctx.boost)
-    out, ovf = SH.exchange(b, dest, ctx.P, B, ctx.axes)
+    out, ovf = _exchange(ctx, b, dest, ctx.P, B, ctx.axes)
     ctx.overflow = ctx.overflow | ovf
     out, ovf2 = SH.resize(out, per)
     ctx.overflow = ctx.overflow | ovf2
@@ -1028,7 +1064,9 @@ _KERNELS = {
 def build_fused_fn(fused, P: int, slack: float, boost: int,
                    axes: "Tuple[str, ...]" = (AXIS,),
                    axis_sizes: "Tuple[int, ...]" = (),
-                   operand_objs: "Tuple[Any, ...]" = ()):
+                   operand_objs: "Tuple[Any, ...]" = (),
+                   window: int = 0,
+                   xchg_cell: "List[Dict[str, int]]" = None):
     """Compose a whole fused REGION (``plan.fuse.FusedStage``) into one
     per-partition function: the member stage fns chain device-resident
     — member i's output batches feed member j's slots directly in HBM,
@@ -1054,10 +1092,15 @@ def build_fused_fn(fused, P: int, slack: float, boost: int,
         tuple(stage_operand_objs(m)) if operand_objs else ()
         for m in members
     ]
+    # Per-member exchange-round accounting cells; each member fn
+    # rewrites its own cell idempotently at trace time, and the region
+    # fn flattens them in member order into the caller's cell.
+    member_cells = [[] for _ in members]
     member_fns = [
         build_stage_fn(
             m, P, slack, boost, axes, axis_sizes,
             operand_objs=member_objs[i],
+            window=window, xchg_cell=member_cells[i],
         )
         for i, m in enumerate(members)
     ]
@@ -1095,6 +1138,8 @@ def build_fused_fn(fused, P: int, slack: float, boost: int,
         region_outs = tuple(
             member_outs[mi][oi] for mi, oi in fused.exports
         )
+        if xchg_cell is not None:
+            xchg_cell[:] = [r for c in member_cells for r in c]
         return region_outs, (overflow, miss)
 
     return fn
@@ -1103,7 +1148,9 @@ def build_fused_fn(fused, P: int, slack: float, boost: int,
 def build_stage_fn(stage, P: int, slack: float, boost: int,
                    axes: "Tuple[str, ...]" = (AXIS,),
                    axis_sizes: "Tuple[int, ...]" = (),
-                   operand_objs: "Tuple[Any, ...]" = ()):
+                   operand_objs: "Tuple[Any, ...]" = (),
+                   window: int = 0,
+                   xchg_cell: "List[Dict[str, int]]" = None):
     """Compose the stage's ops into one per-partition function.
 
     ``operand_objs``: the stage's OPERAND-registered param objects (in
@@ -1113,7 +1160,7 @@ def build_stage_fn(stage, P: int, slack: float, boost: int,
     passes operands must feed the matching arrays on every call)."""
 
     def fn(sharded_inputs, replicated):
-        ctx = StageContext(P, slack, boost, axes, axis_sizes)
+        ctx = StageContext(P, slack, boost, axes, axis_sizes, window)
         ctx.bind_inputs(tuple(sharded_inputs))
         rep = tuple(replicated)
         pos = 0
@@ -1136,6 +1183,10 @@ def build_stage_fn(stage, P: int, slack: float, boost: int,
         # device-local flag loses rows without tripping the retry).
         overflow = jax.lax.psum(ctx.overflow.astype(jnp.int32), axes) > 0
         miss = jax.lax.psum(ctx.dict_miss, axes)
+        if xchg_cell is not None:
+            # Idempotent rewrite (not append): a retrace must not
+            # double-count the static accounting.
+            xchg_cell[:] = list(ctx.xchg_log)
         return outs, (overflow, miss)
 
     return fn

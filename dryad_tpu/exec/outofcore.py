@@ -424,6 +424,17 @@ class StreamExecutor:
         self.ctx = ctx
         cfg = ctx.config
         self.bucket_rows = int(getattr(cfg, "stream_bucket_rows", 1 << 21))
+        # The staged exchange (plan.xchgplan, config.exchange_window)
+        # caps the per-dispatch redistribution footprint at
+        # O(window * B) instead of the flat path's O(P * B); spend the
+        # reclaimed HBM on bigger buckets — fewer device jobs, fewer
+        # spill round-trips — scaling by the P/window buffer shrink,
+        # clamped to 4x so ingest chunking stays responsive.
+        window = int(getattr(cfg, "exchange_window", 0))
+        if window > 0:
+            P = self._P()
+            if P > window:
+                self.bucket_rows *= min(4, max(1, P // window))
         self.combine_rows = int(getattr(cfg, "stream_combine_rows", 1 << 20))
         self.num_buckets = int(getattr(cfg, "stream_buckets", 32))
         # chunk pipeline: ingest / compute / readback-spill overlap with

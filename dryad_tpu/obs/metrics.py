@@ -296,6 +296,17 @@ class JobMetrics:
     tree_depth: int = 0
     degraded_ranges: int = 0
     degraded_fraction: float = 0.0
+    # exchange planner (plan.xchgplan): staged/flat redistribution
+    # rounds dispatched, the largest per-device exchange send-buffer
+    # footprint any single round materialized (the number the
+    # exchange_window bound caps at O(window * B * row_bytes)), and the
+    # exchanges' own ICI/DCN collective split — kept separate from the
+    # combine-tree dcn_bytes/ici_bytes so tree-on/off comparisons stay
+    # on their own scale
+    exchange_rounds: int = 0
+    peak_exchange_bytes: int = 0
+    exchange_ici_bytes: int = 0
+    exchange_dcn_bytes: int = 0
 
     @property
     def padding_waste(self) -> float:
@@ -331,6 +342,8 @@ class JobMetrics:
             "tree_combines": self.tree_combines,
             "tree_depth": self.tree_depth,
             "degraded_fraction": round(self.degraded_fraction, 4),
+            "exchange_rounds": self.exchange_rounds,
+            "peak_exchange_bytes": self.peak_exchange_bytes,
         }
 
     # counter names folded from ``metrics`` snapshot events into the
@@ -404,6 +417,15 @@ class JobMetrics:
                 m.tree_depth = max(m.tree_depth, int(ev.get("level", 0)) + 1)
                 m.dcn_bytes += int(ev.get("dcn_bytes", 0) or 0)
                 m.ici_bytes += int(ev.get("ici_bytes", 0) or 0)
+            elif kind == "exchange_round":
+                # "bytes" is the round's peak send-buffer footprint per
+                # device; ici/dcn are the shipped collective bytes
+                m.exchange_rounds += 1
+                m.peak_exchange_bytes = max(
+                    m.peak_exchange_bytes, int(ev.get("bytes", 0) or 0)
+                )
+                m.exchange_dcn_bytes += int(ev.get("dcn_bytes", 0) or 0)
+                m.exchange_ici_bytes += int(ev.get("ici_bytes", 0) or 0)
             elif kind == "combine_tree_degrade":
                 m.degraded_ranges = max(
                     m.degraded_ranges, int(ev.get("degraded", 0) or 0)
@@ -475,6 +497,12 @@ def format_attribution(m: JobMetrics) -> List[str]:
                 f" degraded={m.degraded_fraction:.0%} of key ranges"
                 if m.degraded_ranges else ""
             )
+        )
+    if m.exchange_rounds:
+        parts.append(
+            f"exchange: rounds={m.exchange_rounds} "
+            f"peak={m.peak_exchange_bytes}B "
+            f"dcn={m.exchange_dcn_bytes}B ici={m.exchange_ici_bytes}B"
         )
     if m.workers:
         parts.append(f"worker_telemetry={m.workers} workers")

@@ -38,10 +38,33 @@ from dryad_tpu.columnar.batch import ColumnBatch
 
 
 def bucket_capacity(capacity: int, num_partitions: int, slack: float) -> int:
-    """Per-(src,dst) bucket rows: slack * uniform expectation, >= 8."""
+    """Per-(src,dst) bucket rows: slack * uniform expectation, >= 8.
+
+    Clamped to ``capacity``: one source holds at most ``capacity`` valid
+    rows, so a bucket of ``capacity`` rows can never overflow — without
+    the clamp the 8-row floor pads tiny chunks ~P x on wide meshes
+    (send buffer ``P * 8`` rows for a source that only has, say, 4).
+    Placement within a destination is independent of ``B``, so the
+    clamp never changes exchanged bytes, only trims the padding.
+    """
     import math
 
-    return max(8, int(math.ceil(capacity * slack / num_partitions)))
+    want = max(8, int(math.ceil(capacity * slack / num_partitions)))
+    return max(1, min(want, capacity))
+
+
+def row_bytes(batch: ColumnBatch) -> int:
+    """Static per-row byte footprint (columns + validity mask).
+
+    Shape-only arithmetic — safe at trace time, used for the exchange
+    planner's ``exchange_round`` byte accounting.
+    """
+    import math
+
+    per = 1  # validity mask
+    for col in batch.data.values():
+        per += col.dtype.itemsize * int(math.prod(col.shape[1:]))
+    return per
 
 
 def exchange(
@@ -102,6 +125,109 @@ def exchange(
 
     overflow = jax.lax.psum(overflow.astype(jnp.int32), axis_name) > 0
     return ColumnBatch(recv, recv_valid), overflow
+
+
+def exchange_staged(
+    batch: ColumnBatch,
+    dest: jax.Array,
+    num_partitions: int,
+    bucket_cap: int,
+    axis_name,
+    schedule,
+) -> Tuple[ColumnBatch, jax.Array]:
+    """Staged exchange: the flat all-to-all decomposed into ppermute hops.
+
+    Same contract as :func:`exchange`, but instead of materializing the
+    whole ``(P, B)`` send buffer, rows ship one destination bucket at a
+    time along *schedule* (an :class:`~dryad_tpu.plan.xchgplan.ExchangeSchedule`):
+    hop ``(sd, sp)`` builds a single ``(B, ...)`` block per column —
+    the bucket destined for device ``((d+sd) % D, (p+sp) % ici)`` —
+    ``ppermute``\\ s it, and writes the received block into the output at
+    the sender's slot.  Peak extra HBM is one block per in-flight hop,
+    ``O(window * B)`` per round, instead of the flat path's ``O(P * B)``.
+
+    The output layout is the same ``(P * B)`` source-major placement as
+    the flat path — (source, bucket-position) ordered, independent of
+    the schedule — so staged and flat results are byte-identical and the
+    choice is invisible to every consumer (including fused regions and
+    overflow-palette retries).
+    """
+    P, B = num_partitions, bucket_cap
+    cap = batch.capacity
+    D, ici = schedule.dcn_slices, schedule.ici_partitions
+    assert P == schedule.num_partitions == D * ici
+
+    dest = jnp.where(batch.valid, dest, P)  # invalid rows -> sentinel
+    operands = (dest, jnp.arange(cap, dtype=jnp.int32))
+    dsorted, order = jax.lax.sort(operands, num_keys=1, is_stable=True)
+    sb = batch.take(order)
+
+    counts = jnp.bincount(dsorted, length=P + 1)[:P]
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), counts.dtype), jnp.cumsum(counts)[:-1]]
+    )
+    within = jnp.arange(cap, dtype=jnp.int32) - jnp.where(
+        dsorted < P, offsets[jnp.clip(dsorted, 0, P - 1)], 0
+    ).astype(jnp.int32)
+
+    in_range = (dsorted < P) & (within < B)
+    overflow = jnp.any((dsorted < P) & (within >= B))
+
+    me = jax.lax.axis_index(axis_name)  # flattened, slice-major
+    md, mp = me // ici, me % ici
+
+    out = {
+        name: jnp.zeros((P * B,) + col.shape[1:], col.dtype)
+        for name, col in sb.data.items()
+    }
+    out_valid = jnp.zeros((P * B,), jnp.bool_)
+
+    def bucket_block(tgt):
+        """The (B, ...) block of rows destined for device ``tgt``."""
+        sel = in_range & (dsorted == tgt)
+        idx = jnp.where(sel, within, B)
+        blocks = {}
+        for name, col in sb.data.items():
+            buf = jnp.zeros((B,) + col.shape[1:], col.dtype)
+            blocks[name] = buf.at[idx].set(col, mode="drop")
+        bv = (
+            jnp.zeros((B,), jnp.bool_)
+            .at[idx]
+            .set(sb.valid & sel, mode="drop")
+        )
+        return blocks, bv
+
+    def place(blocks, bv, src):
+        start = (src * B).astype(jnp.int32)
+        for name, blk in blocks.items():
+            zeros = (0,) * (blk.ndim - 1)
+            out[name] = jax.lax.dynamic_update_slice(
+                out[name], blk, (start,) + zeros
+            )
+        return jax.lax.dynamic_update_slice(out_valid, bv, (start,))
+
+    # Local bucket: zero network bytes, scatter straight into my slot.
+    blocks, bv = bucket_block(me)
+    out_valid = place(blocks, bv, me)
+
+    for rnd in schedule.rounds:
+        for sd, sp in rnd.hops:
+            perm = [
+                (i, ((i // ici + sd) % D) * ici + (i % ici + sp) % ici)
+                for i in range(P)
+            ]
+            tgt = ((md + sd) % D) * ici + (mp + sp) % ici
+            src = ((md - sd) % D) * ici + (mp - sp) % ici
+            blocks, bv = bucket_block(tgt)
+            blocks = {
+                name: jax.lax.ppermute(blk, axis_name, perm)
+                for name, blk in blocks.items()
+            }
+            bv = jax.lax.ppermute(bv, axis_name, perm)
+            out_valid = place(blocks, bv, src)
+
+    overflow = jax.lax.psum(overflow.astype(jnp.int32), axis_name) > 0
+    return ColumnBatch(out, out_valid), overflow
 
 
 def resize(

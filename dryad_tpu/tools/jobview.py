@@ -77,6 +77,12 @@ class JobInfo:
     # {chunks, chunk_rows, spills, spill_rows, buckets, splits,
     #  combines} — zero when the job never streamed
     stream: Dict[str, int] = dataclasses.field(default_factory=dict)
+    # exchange planner rounds (exchange_round events) grouped by stage
+    # name: {rounds, window, peak, ici, dcn} — empty when the job never
+    # repartitioned
+    exchanges: Dict[str, Dict[str, int]] = dataclasses.field(
+        default_factory=dict
+    )
 
     @property
     def ok(self) -> bool:
@@ -123,6 +129,7 @@ def _fold_job(events: List[Dict[str, Any]]) -> JobInfo:
     state_boost = 0
     topology: List[Dict[str, Any]] = []
     stream_stats: Dict[str, int] = {}
+    exchanges: Dict[str, Dict[str, int]] = {}
     t0 = t1 = None
 
     def stage(ev) -> StageInfo:
@@ -193,6 +200,20 @@ def _fold_job(events: List[Dict[str, Any]]) -> JobInfo:
             ent["bytes"] += int(ev.get("bytes", 0) or 0)
             ent["ici"] += int(ev.get("ici_bytes", 0) or 0)
             ent["dcn"] += int(ev.get("dcn_bytes", 0) or 0)
+        elif kind == "exchange_round":
+            # per-exchange panel: rounds grouped by the stage that ran
+            # them, with the window, per-round peak footprint, and the
+            # ICI/DCN collective split
+            key = ev.get("name", f"stage{ev.get('stage', '?')}")
+            ent = exchanges.setdefault(
+                key,
+                {"rounds": 0, "window": 0, "peak": 0, "ici": 0, "dcn": 0},
+            )
+            ent["rounds"] += 1
+            ent["window"] = max(ent["window"], int(ev.get("window", 0)))
+            ent["peak"] = max(ent["peak"], int(ev.get("bytes", 0) or 0))
+            ent["ici"] += int(ev.get("ici_bytes", 0) or 0)
+            ent["dcn"] += int(ev.get("dcn_bytes", 0) or 0)
         elif kind == "combine_tree_degrade":
             stream_stats["degraded_fraction"] = max(
                 stream_stats.get("degraded_fraction", 0.0),
@@ -260,7 +281,7 @@ def _fold_job(events: List[Dict[str, Any]]) -> JobInfo:
     wall = (t1 - t0) if (t0 is not None and t1 is not None) else 0.0
     return JobInfo(
         stages, declared, started, completed, failed, iters, state_boost,
-        wall, topology, stream_stats,
+        wall, topology, stream_stats, exchanges,
     )
 
 
@@ -423,6 +444,21 @@ def render(job: JobInfo) -> str:
                 f"errors={st.get('pipeline_errors', 0)}"
                 + (f"  combine_policy={st['combine_policy']}"
                    if st.get("combine_policy") else "")
+            )
+    if job.exchanges:
+        # exchange planner panel: one line per repartitioning stage —
+        # window 0 means the flat all_to_all baseline, whose peak is
+        # the whole (P, B) send buffer; a staged window caps the peak
+        # at window * B * row_bytes per round
+        lines.append("exchanges:")
+        for name in sorted(job.exchanges):
+            e = job.exchanges[name]
+            mode = (
+                f"window={e['window']}" if e["window"] else "flat"
+            )
+            lines.append(
+                f"  {name}: rounds={e['rounds']} ({mode})  "
+                f"peak={e['peak']}B  ici={e['ici']}B  dcn={e['dcn']}B"
             )
     if any(s.attempt_log for s in job.stages.values()):
         lines.append("-- attempt history --")

@@ -184,6 +184,15 @@ class DryadConfig:
     # an overflow re-runs the affected suffix at a larger boost.
     # 1 = legacy per-stage sync.
     overflow_sync_depth: int = _env_int("DRYAD_TPU_OVERFLOW_SYNC_DEPTH", 4)
+    # Memory-bounded staged exchange (plan.xchgplan): hash/range/join
+    # repartitions decompose into ppermute rounds shipping at most this
+    # many destination buckets each, so peak extra HBM per device is
+    # O(window * B) instead of the flat all_to_all's O(P * B) — ICI
+    # hops staged first, all DCN-crossing traffic batched into one
+    # round per remote slice (arxiv 2112.01075's decomposition over the
+    # combinetree mesh model).  0 = the flat single-collective path,
+    # kept as the differential baseline.
+    exchange_window: int = _env_int("DRYAD_TPU_EXCHANGE_WINDOW", 0)
     # Stage-level fan-out adaptation (DrDynamicRangeDistributor.cpp:
     # 54-110: consumer copies = observed size / data-per-vertex): when a
     # stage's input row count is STATICALLY bounded at or below
@@ -347,6 +356,8 @@ class DryadConfig:
             raise ValueError("device_cache_bytes must be >= 0")
         if self.overflow_sync_depth < 1:
             raise ValueError("overflow_sync_depth must be >= 1")
+        if self.exchange_window < 0:
+            raise ValueError("exchange_window must be >= 0")
         if self.tail_fanout_rows < 0:
             raise ValueError("tail_fanout_rows must be >= 0")
         if self.tail_rows_per_partition < 1:
@@ -431,6 +442,7 @@ CONFIG_KEYS = {
     "rows_per_vertex": "target rows per independent vertex task",
     "plan_fuse": "whole-DAG SPMD fusion into one dispatched program",
     "overflow_sync_depth": "speculative dispatches per overflow readback",
+    "exchange_window": "staged-exchange buckets per round (0 = flat all_to_all)",
     "tail_fanout_rows": "static row bound enabling tail fan-out; 0 off",
     "tail_rows_per_partition": "rows per partition after tail fan-out",
     "stream_bucket_rows": "max rows per phase-2 bucket before re-split",

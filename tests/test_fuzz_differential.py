@@ -482,3 +482,121 @@ def test_gang_coded_stage_unaffected_by_tree():
                 assert "coded_reconstruct" in kinds
             off = run(sub, False, linear)
             _assert_byte_identical_rows(on, off, f"linear={linear}")
+
+
+# -- staged vs flat exchange oracle sweep (plan/xchgplan.py) -----------------
+#
+# exchange_window > 0 reroutes every hash/range repartition through the
+# planner's staged ppermute schedule; 0 is the flat all_to_all.  The
+# staged path writes each received bucket at the sender's slot — the
+# exact (source, bucket-position) placement the flat tiled all_to_all
+# produces — so the two paths are BIT-exact per cell across overflow
+# boosts, fusion, and any window, not merely row-set equal.
+
+_XCHG_SEEDS = (5, 13, 29)
+
+
+def _xchg_pipeline(op, q):
+    if op == "hash":
+        return q.hash_partition("g").group_by(
+            ["g"], {"c": ("count", None), "sv": ("sum", "v")}
+        )
+    if op == "range":
+        return q.order_by([("v", True), ("k", False), ("g", False)])
+    # the join itself may broadcast its small right side, so repartition
+    # the left explicitly: the sweep must drive a staged exchange INTO
+    # the join's row placement
+    return _STEPS["left_join"](q.hash_partition("k"))
+
+
+@pytest.mark.parametrize("seed", _XCHG_SEEDS)
+@pytest.mark.parametrize("op", ("hash", "range", "join"))
+@pytest.mark.parametrize("window", (1, 2, 8))
+def test_exchange_staged_matches_flat(seed, op, window):
+    from dryad_tpu import DryadConfig
+
+    rng = np.random.default_rng(seed)
+    tbl = _rand_table(rng, int(rng.integers(80, 400)))
+
+    def run(w):
+        ctx = DryadContext(
+            num_partitions_=8, config=DryadConfig(exchange_window=w)
+        )
+        out = _xchg_pipeline(op, ctx.from_arrays(tbl)).collect()
+        rounds = [
+            e for e in ctx.events.events() if e["kind"] == "exchange_round"
+        ]
+        return out, rounds
+
+    out_staged, staged_rounds = run(window)
+    out_flat, flat_rounds = run(0)
+    assert staged_rounds and all(
+        e["window"] == window for e in staged_rounds
+    ), "staged sweep must route through the planner"
+    assert all(e["window"] == 0 for e in flat_rounds)
+    _assert_byte_identical_rows(
+        out_staged, out_flat, f"seed={seed} op={op} window={window}"
+    )
+
+
+@pytest.mark.parametrize("seed", _XCHG_SEEDS)
+def test_exchange_staged_overflow_retry_matches_flat(seed):
+    """Near-distinct keys at slack=1.0 force bucket overflows: the
+    palette retry re-traces the staged exchange at a larger B, and
+    placement is B-independent, so results must stay byte-identical."""
+    from dryad_tpu import DryadConfig
+
+    rng = np.random.default_rng(seed)
+    n = 2048
+    tbl = {
+        "k": (rng.permutation(n).astype(np.int32) - 1),
+        "w": rng.integers(-(2 ** 40), 2 ** 40, n).astype(np.int64),
+    }
+
+    def run(w):
+        ctx = DryadContext(
+            num_partitions_=8,
+            config=DryadConfig(shuffle_slack=1.0, exchange_window=w),
+        )
+        out = ctx.from_arrays(tbl).group_by(
+            "k", {"c": ("count", None), "ws": ("sum", "w")}
+        ).collect()
+        overflowed = any(
+            e["kind"] == "stage_overflow" for e in ctx.events.events()
+        )
+        return out, overflowed
+
+    out_staged, ovf_staged = run(2)
+    out_flat, _ = run(0)
+    assert ovf_staged, "slack=1.0 sweep should exercise the overflow retry"
+    _assert_byte_identical_rows(out_staged, out_flat, f"seed={seed}")
+
+
+@pytest.mark.parametrize("seed", _XCHG_SEEDS)
+def test_exchange_staged_fused_matches_flat(seed):
+    """Staged exchanges at fusion seams: whole-DAG fusion traces the
+    same exchange_staged calls inside one program; plan_fuse on with a
+    window must match plan_fuse on with the flat path byte-for-byte."""
+    from dryad_tpu import DryadConfig
+
+    rng = np.random.default_rng(seed)
+    tbl = _rand_table(rng, 300)
+
+    def run(w):
+        ctx = DryadContext(
+            num_partitions_=8,
+            config=DryadConfig(plan_fuse=True, exchange_window=w),
+        )
+        q = ctx.from_arrays(tbl).group_by(
+            ["k"], {"c": ("count", None), "sv": ("sum", "v")}
+        ).order_by([("c", True), ("k", False)])
+        out = q.collect()
+        rounds = [
+            e for e in ctx.events.events() if e["kind"] == "exchange_round"
+        ]
+        return out, rounds
+
+    out_staged, staged_rounds = run(2)
+    out_flat, _ = run(0)
+    assert any(e["window"] == 2 for e in staged_rounds)
+    _assert_byte_identical_rows(out_staged, out_flat, f"seed={seed}")

@@ -35,7 +35,7 @@ def test_registry_has_every_expected_rule():
         "operand-registry", "fuse-classification", "host-transfer",
         "layer-imports", "placement-snapshot", "coded-linearity",
         "event-schema", "kernel-determinism", "recompile-hazard",
-        "span-discipline", "config-key",
+        "span-discipline", "config-key", "collective-order",
     }
     assert expected == set(all_checkers())
     assert {"bad-suppression", "unused-suppression"} <= set(known_rules())

@@ -734,3 +734,105 @@ def test_config_key_ignores_jax_config():
     # jax.config.update is a different animal — never checked
     assert "jax.config.update" in CONFIG_FIXTURE[USER]
     assert _rules(CONFIG_FIXTURE, "config-key") == []
+
+
+# -- collective-order --------------------------------------------------------
+
+SHUFFLEPY = "dryad_tpu/ops/shuffle.py"
+
+COLLECTIVE_FIXTURE = {
+    SHUFFLEPY: '''\
+import jax
+
+
+def exchange(send, send_valid, overflow, axis_name):
+    recv = jax.lax.all_to_all(send, axis_name, 0, 0, tiled=True)
+    recv_valid = jax.lax.all_to_all(send_valid, axis_name, 0, 0, tiled=True)
+    overflow = jax.lax.psum(overflow, axis_name) > 0
+    return recv, recv_valid, overflow
+
+
+def exchange_staged(blocks, overflow, axis_name, schedule):
+    for perm in schedule:
+        blocks = [jax.lax.ppermute(b, axis_name, perm) for b in blocks]
+    overflow = jax.lax.psum(overflow, axis_name) > 0
+    return blocks, overflow
+
+
+def rank_column(local, axes):
+    counts = jax.lax.all_gather(local, axes)
+    total = jax.lax.psum(local, axes)
+    return counts, total
+
+
+def build_stage_fn(stage, axes):
+    def fn(inputs, replicated):
+        overflow = jax.lax.psum(stage.overflow, axes) > 0
+        return inputs, overflow
+
+    return fn
+''',
+}
+
+
+def test_collective_order_clean_fixture():
+    assert _rules(COLLECTIVE_FIXTURE, "collective-order") == []
+
+
+@pytest.mark.parametrize(
+    "old,new,n",
+    [
+        # flag reduction hoisted ahead of the data all_to_alls: two
+        # fused members disagreeing on this order is the TPU deadlock
+        # case (both later all_to_alls now trail the psum -> 2 findings)
+        (
+            "    recv = jax.lax.all_to_all(send, axis_name, 0, 0, tiled=True)\n",
+            "    recv = jax.lax.all_to_all(send, axis_name, 0, 0, tiled=True)\n"
+            "    early = jax.lax.psum(overflow, axis_name)\n"
+            "    recv2 = jax.lax.all_to_all(recv, axis_name, 0, 0, tiled=True)\n",
+            2,
+        ),
+        # a ppermute issued after the staged loop's psum
+        (
+            "    overflow = jax.lax.psum(overflow, axis_name) > 0\n"
+            "    return blocks, overflow",
+            "    overflow = jax.lax.psum(overflow, axis_name) > 0\n"
+            "    blocks = [jax.lax.ppermute(b, axis_name, None) for b in blocks]\n"
+            "    return blocks, overflow",
+            1,
+        ),
+        # gather after reduction inside one body
+        (
+            "    total = jax.lax.psum(local, axes)\n",
+            "    total = jax.lax.psum(local, axes)\n"
+            "    extra = jax.lax.all_gather(total, axes)\n",
+            1,
+        ),
+    ],
+)
+def test_collective_order_fires(old, new, n):
+    _assert_fires(
+        _mutate(COLLECTIVE_FIXTURE, SHUFFLEPY, old, new),
+        "collective-order", n=n,
+    )
+
+
+def test_collective_order_scopes_are_independent():
+    # the module mixes psum-last bodies with a nested fn issuing its own
+    # psum; nesting must never cross-contaminate the outer sequence
+    src = _mutate(
+        COLLECTIVE_FIXTURE, SHUFFLEPY,
+        "def build_stage_fn(stage, axes):",
+        '''\
+def outer_then_inner(x, axes):
+    x = jax.lax.psum(x, axes)
+
+    def inner(y):
+        return jax.lax.ppermute(y, axes, None)
+
+    return inner(x)
+
+
+def build_stage_fn(stage, axes):''',
+    )
+    assert _rules(src, "collective-order") == []

@@ -1,0 +1,252 @@
+"""Exchange planner tests: schedule shapes, byte accounting, the
+bounded-peak contract, and staged-vs-flat placement identity.
+
+The planner (``plan/xchgplan.py``) is pure trace-time Python, so the
+O(window * B) peak-HBM bound can be asserted exactly from the schedule
+accounting — including at mesh widths (P=16) wider than the 8-device
+test backend.  The op-level tests then prove the staged ``ppermute``
+lowering reproduces the flat ``all_to_all``'s placement bit-for-bit on
+both the 1-axis mesh and the 2-slice hybrid mesh, and the executor test
+checks the same accounting arrives as ``exchange_round`` events.
+"""
+
+import numpy as np
+import pytest
+
+from dryad_tpu import DryadConfig, DryadContext
+from dryad_tpu.columnar.schema import ColumnType, Schema
+from dryad_tpu.obs.metrics import JobMetrics
+from dryad_tpu.ops.hash import partition_ids
+from dryad_tpu.ops.shuffle import (
+    bucket_capacity,
+    exchange,
+    exchange_staged,
+)
+from dryad_tpu.parallel.distribute import from_host_table
+from dryad_tpu.parallel.mesh import AXIS, DCN_AXIS, make_hybrid_mesh
+from dryad_tpu.parallel.stage import compile_stage
+from dryad_tpu.plan.xchgplan import flat_accounting, plan_exchange
+
+SCHEMA = Schema([("k", ColumnType.INT32), ("v", ColumnType.FLOAT32)])
+
+
+# -- schedule shapes ---------------------------------------------------------
+
+def test_plan_single_axis_chunks_by_window():
+    s = plan_exchange(8, window=2)
+    assert s.dcn_slices == 1 and s.ici_partitions == 8
+    assert [r.width for r in s.rounds] == [2, 2, 2, 1]
+    assert s.dcn_rounds == 0 and s.peak_width == 2
+    # hops cover every non-local intra-slice offset exactly once, in order
+    assert [h for r in s.rounds for h in r.hops] == [
+        (0, sp) for sp in range(1, 8)
+    ]
+
+
+def test_plan_hybrid_ici_first_then_single_dcn_round():
+    s = plan_exchange(8, window=2, dcn_slices=2)
+    assert (s.dcn_slices, s.ici_partitions) == (2, 4)
+    assert [(r.width, r.dcn) for r in s.rounds] == [
+        (2, False), (1, False), (4, True)
+    ]
+    # a 2-slice mesh pays exactly ONE DCN round, carrying all ici offsets
+    assert s.dcn_rounds == 1
+    assert s.rounds[-1].hops == tuple((1, sp) for sp in range(4))
+
+
+@pytest.mark.parametrize(
+    "P,window,dcn", [(8, 1, 1), (8, 3, 1), (8, 2, 2), (16, 4, 4), (8, 7, 1)]
+)
+def test_plan_hops_cover_every_offset_once(P, window, dcn):
+    s = plan_exchange(P, window, dcn)
+    hops = [h for r in s.rounds for h in r.hops]
+    want = {
+        (sd, sp)
+        for sd in range(dcn)
+        for sp in range(P // dcn)
+        if (sd, sp) != (0, 0)
+    }
+    assert len(hops) == len(set(hops)) == len(want)
+    assert set(hops) == want
+    # ICI rounds respect the window; indices are consecutive
+    for i, r in enumerate(s.rounds):
+        assert r.index == i
+        if not r.dcn:
+            assert r.width <= window
+
+
+def test_plan_validates_inputs():
+    with pytest.raises(ValueError):
+        plan_exchange(0, 1)
+    with pytest.raises(ValueError):
+        plan_exchange(8, 0)
+    with pytest.raises(ValueError):
+        plan_exchange(8, 2, dcn_slices=3)
+
+
+# -- byte accounting ---------------------------------------------------------
+
+def test_accounting_splits_fabrics():
+    s = plan_exchange(8, window=3, dcn_slices=2)
+    block = 16 * 9
+    acct = s.accounting(bucket_rows=16, row_bytes=9)
+    assert acct == [
+        {"round": 0, "window": 3, "bytes": 3 * block,
+         "ici_bytes": 3 * block, "dcn_bytes": 0},
+        {"round": 1, "window": 3, "bytes": 4 * block,
+         "ici_bytes": 0, "dcn_bytes": 4 * block},
+    ]
+
+
+def test_flat_accounting_baseline():
+    block = 16 * 9
+    assert flat_accounting(8, 2, 16, 9) == {
+        "round": 0, "window": 0, "bytes": 8 * block,
+        "ici_bytes": 3 * block, "dcn_bytes": 4 * block,
+    }
+
+
+def test_peak_stays_flat_as_mesh_grows():
+    """THE bound: at fixed window and bucket size, staged peak bytes are
+    constant in P (= window * B * row_bytes) while the flat all_to_all
+    baseline grows linearly — 4x from P=4 to P=16."""
+    B, rb, W = 8, 13, 2
+    peak = {}
+    flat = {}
+    for P in (4, 16):
+        acct = plan_exchange(P, W).accounting(B, rb)
+        peak[P] = max(a["bytes"] for a in acct)
+        flat[P] = flat_accounting(P, 1, B, rb)["bytes"]
+    assert peak[4] == peak[16] == W * B * rb
+    assert flat[16] == 4 * flat[4] == 16 * B * rb
+
+
+# -- bucket_capacity clamp (regression) --------------------------------------
+
+def test_bucket_capacity_clamps_to_capacity():
+    # capacity below the 8-row floor: a 4-row source can never fill an
+    # 8-row bucket, so B must clamp to 4 (was 8 before the fix —
+    # padding the send buffer P x for nothing)
+    assert bucket_capacity(4, 16, 2.0) == 4
+    assert bucket_capacity(1, 8, 2.0) == 1
+    # floor binds when the uniform expectation is tiny but capacity isn't
+    assert bucket_capacity(100, 64, 1.0) == 8
+    # expectation binds on fat partitions
+    assert bucket_capacity(1000, 8, 2.0) == 250
+
+
+# -- staged vs flat placement identity (op level) ----------------------------
+
+def _mk_batch(mesh, n=400, seed=7, skew=False):
+    rng = np.random.default_rng(seed)
+    if skew:  # most rows target one destination
+        k = np.where(
+            rng.random(n) < 0.7, 3, rng.integers(0, 97, n)
+        ).astype(np.int32)
+    else:
+        k = rng.integers(0, 97, n).astype(np.int32)
+    v = rng.standard_normal(n).astype(np.float32)
+    return from_host_table(
+        SCHEMA, {"k": k, "v": v}, mesh, partition_capacity=128
+    )
+
+
+def _run_both(mesh, axes, P, window, dcn, **kw):
+    batch = _mk_batch(mesh, **kw)
+    B = bucket_capacity(batch.capacity, P, 2.0)
+    schedule = plan_exchange(P, window, dcn)
+
+    def flat(sharded, _):
+        (b,) = sharded
+        out, ovf = exchange(b, partition_ids([b["k"]], P), P, B, axes)
+        return (out,), (ovf,)
+
+    def staged(sharded, _):
+        (b,) = sharded
+        out, ovf = exchange_staged(
+            b, partition_ids([b["k"]], P), P, B, axes, schedule
+        )
+        return (out,), (ovf,)
+
+    (of,), (ovf_f,) = compile_stage(mesh, flat)((batch,), ())
+    (os_,), (ovf_s,) = compile_stage(mesh, staged)((batch,), ())
+    assert bool(ovf_f) == bool(ovf_s)
+    # placement identity is BYTE-exact, padding cells included
+    np.testing.assert_array_equal(np.asarray(of.valid), np.asarray(os_.valid))
+    for name in of.data:
+        np.testing.assert_array_equal(
+            np.asarray(of[name]), np.asarray(os_[name]), err_msg=name
+        )
+
+
+@pytest.mark.parametrize("window", [1, 2, 8])
+def test_staged_matches_flat_single_axis(mesh8, window):
+    _run_both(mesh8, (AXIS,), 8, window, 1)
+
+
+@pytest.mark.parametrize("window", [1, 2, 8])
+def test_staged_matches_flat_hybrid(window):
+    mesh = make_hybrid_mesh(2, 4)
+    _run_both(mesh, (DCN_AXIS, AXIS), 8, window, 2)
+
+
+def test_staged_matches_flat_skewed(mesh8):
+    _run_both(mesh8, (AXIS,), 8, 2, 1, skew=True, seed=11)
+
+
+# -- exchange_round events (executor level) ----------------------------------
+
+def _exchange_events(P, window, n=256):
+    rng = np.random.default_rng(3)
+    tbl = {
+        "k": rng.integers(0, 50, n).astype(np.int32),
+        "v": rng.standard_normal(n).astype(np.float32),
+    }
+    ctx = DryadContext(
+        num_partitions_=P, config=DryadConfig(exchange_window=window)
+    )
+    out = ctx.from_arrays(tbl).hash_partition("k").collect()
+    assert len(out["k"]) == n
+    evs = [
+        e for e in ctx.events.events() if e["kind"] == "exchange_round"
+    ]
+    assert evs, "every exchange must emit exchange_round accounting"
+    return evs, ctx.events.events()
+
+
+def test_exchange_round_events_peak_scales_with_window_not_P():
+    W = 2
+    staged, _ = _exchange_events(8, W)
+    flat, _ = _exchange_events(8, 0)
+    assert all(e["window"] == W for e in staged)
+    assert all(e["window"] == 0 for e in flat)
+    peak_staged = max(e["bytes"] for e in staged)
+    peak_flat = max(e["bytes"] for e in flat)
+    # flat peak = P * B * rb, staged peak = W * B * rb: exact ratio
+    assert peak_staged * 8 == peak_flat * W
+    # staged ships the same network bytes, just in bounded rounds
+    assert sum(e["ici_bytes"] for e in staged) == sum(
+        e["ici_bytes"] for e in flat
+    )
+
+
+def test_exchange_round_events_fold_into_metrics():
+    from dryad_tpu.obs.metrics import format_attribution
+
+    evs, all_evs = _exchange_events(8, 2)
+    m = JobMetrics.from_events(all_evs)
+    assert m.exchange_rounds == len(evs)
+    assert m.peak_exchange_bytes == max(e["bytes"] for e in evs)
+    assert m.exchange_ici_bytes == sum(e["ici_bytes"] for e in evs)
+    assert any("exchange:" in line for line in format_attribution(m))
+
+
+def test_jobview_renders_exchange_panel():
+    from dryad_tpu.tools.jobview import build_job, render
+
+    _, all_evs = _exchange_events(8, 2)
+    job = build_job(all_evs)
+    assert job.exchanges
+    text = render(job)
+    assert "exchanges:" in text
+    assert "window=2" in text
