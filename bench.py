@@ -549,6 +549,157 @@ def ooc_pipeline_speedup_metric(n: int, chunk_rows: int = 1 << 20):
     }
 
 
+def _asyncpipe_once(n: int, chunk_rows: int, depth: int):
+    """One timed ooc sort at an explicit ``dispatch_depth`` (depth 1 =
+    the serial pre-window baseline); prefetch pipelining is pinned OFF
+    so the dispatch window is the only overlap mechanism under test.
+    Returns (rows, wall_s, process_cpu_s, driver_thread_cpu_s,
+    JobMetrics)."""
+    import resource
+
+    from dryad_tpu import DryadConfig, DryadContext
+    from dryad_tpu.obs.metrics import JobMetrics
+
+    rng = np.random.default_rng(3)
+    nchunks = max(8, n // chunk_rows)
+    chunks = [
+        {"key": rng.integers(-(2 ** 31), 2 ** 31 - 1, chunk_rows).astype(
+            np.int32)}
+        for _ in range(nchunks)
+    ]
+    total = nchunks * chunk_rows
+    bucket_rows = max(chunk_rows, 1 << 20)
+    cfg = DryadConfig(
+        stream_bucket_rows=bucket_rows * 2,
+        stream_buckets=max(8, 2 * total // bucket_rows),
+        stream_pipeline_depth=1,
+        dispatch_depth=depth,
+    )
+    ctx = DryadContext(config=cfg)
+    ru0 = resource.getrusage(resource.RUSAGE_SELF)
+    tc0 = time.thread_time()
+    t0 = time.perf_counter()
+    q = ctx.from_stream(iter([dict(c) for c in chunks])).order_by(["key"])
+    out = q.collect()
+    wall = time.perf_counter() - t0
+    drv_cpu = time.thread_time() - tc0
+    ru1 = resource.getrusage(resource.RUSAGE_SELF)
+    proc_cpu = (ru1.ru_utime + ru1.ru_stime) - (ru0.ru_utime + ru0.ru_stime)
+    assert len(out["key"]) == total
+    assert (np.diff(out["key"]) >= 0).all()
+    return total, wall, proc_cpu, drv_cpu, JobMetrics.from_events(
+        ctx.events.events()
+    )
+
+
+def _asyncpipe_batching(nrows: int = 20_000, nqueries: int = 6):
+    """Batched vs one-command-per-round-trip gang submission of the
+    SAME ``nqueries`` jobs on a 2-worker gang: byte-identical results,
+    mailbox round trips counted on the driver side."""
+    from dryad_tpu import DryadContext
+    from dryad_tpu.cluster.localjob import LocalJobSubmission
+
+    rng = np.random.default_rng(5)
+    tbl = {
+        "k": rng.integers(0, 64, nrows).astype(np.int32),
+        "v": rng.integers(-1000, 1000, nrows).astype(np.int32),
+    }
+    with LocalJobSubmission(num_workers=2, devices_per_worker=1) as sub:
+        # the driver context only builds the plan; its partition count
+        # is capped by the devices THIS process can see (CPU fallback
+        # pins 1), independent of the 2-device worker mesh
+        import jax
+
+        ctx = DryadContext(
+            num_partitions_=min(2, len(jax.devices()))
+        )
+
+        def mkq():
+            return ctx.from_arrays(tbl).group_by(
+                "k", {"c": ("count", None), "s": ("sum", "v")}
+            )
+
+        sub.submit(mkq())  # warm package/compile caches on both workers
+        rt0 = sub.round_trips
+        t0 = time.perf_counter()
+        serial = sub.submit_many([mkq() for _ in range(nqueries)], batch=1)
+        t_serial = time.perf_counter() - t0
+        rt_serial = sub.round_trips - rt0
+        rt0 = sub.round_trips
+        t0 = time.perf_counter()
+        batched = sub.submit_many(
+            [mkq() for _ in range(nqueries)], batch=nqueries
+        )
+        t_batched = time.perf_counter() - t0
+        rt_batched = sub.round_trips - rt0
+        for a, b in zip(serial, batched):
+            for cname in a:
+                assert a[cname].tobytes() == b[cname].tobytes()
+    return {
+        "queries": nqueries,
+        "workers": 2,
+        "round_trips_unbatched": rt_serial,
+        "round_trips_batched": rt_batched,
+        "round_trip_reduction": round(
+            rt_serial / max(rt_batched, 1), 2
+        ),
+        "unbatched_s": round(t_serial, 3),
+        "batched_s": round(t_batched, 3),
+    }
+
+
+def asyncpipe_metric(n: int, chunk_rows: int = 1 << 17, nqueries: int = 6):
+    """Async device-paced dispatch matrix on the oocsort-shaped stream:
+    dispatch_depth {1, 2, 4} (1 = serial baseline), then gang command
+    batching on/off on a 2-worker cluster.  Per depth: rows/s, window
+    dispatches, summed device-idle gap between dispatches
+    (``dispatch_gap_s``), the window's driver-thread CPU fraction
+    (JobMetrics, thread_time-based), and whole-run driver-thread /
+    process CPU via ``time.thread_time`` + ``resource.getrusage``.
+    CPU-host caveat: the "device" compute shares the host with the
+    driver here, so absolute CPU fractions are upper bounds — the
+    depth-4-vs-1 DELTA is the signal, not the level."""
+    depths = {}
+    t_by_depth = {}
+    for depth in (1, 2, 4):
+        total, wall, proc_cpu, drv_cpu, m = _asyncpipe_once(
+            n, chunk_rows, depth
+        )
+        t_by_depth[depth] = wall
+        depths[str(depth)] = {
+            "rows_per_sec": round(total / max(wall, 1e-9), 1),
+            "wall_s": round(wall, 3),
+            "window_dispatches": m.window_dispatches,
+            "dispatch_gap_s": round(m.dispatch_gap_s, 4),
+            "driver_cpu_fraction": round(m.driver_cpu_fraction, 4),
+            "dispatch_retries": m.dispatch_retries,
+            "driver_thread_cpu_fraction": round(
+                min(drv_cpu / max(wall, 1e-9), 1.0), 4
+            ),
+            "process_cpu_s": round(proc_cpu, 3),
+        }
+    batching = _asyncpipe_batching(nqueries=nqueries)
+    total = max(8, n // chunk_rows) * chunk_rows
+    return {
+        "metric": "asyncpipe_rows_per_sec",
+        "value": round(total / max(t_by_depth[4], 1e-9), 1),
+        "unit": "rows/s",
+        "baseline": "dispatch_depth=1 serial driver loop",
+        "speedup_vs_serial": round(
+            t_by_depth[1] / max(t_by_depth[4], 1e-9), 3
+        ),
+        "rows": total,
+        "chunk_rows": chunk_rows,
+        "depths": depths,
+        "command_batching": batching,
+        "cores": os.cpu_count(),
+        "platform": _PLATFORM,
+        "contended": False,
+        "spread": 1.0,
+        "reps_s": [round(t_by_depth[4], 3)],
+    }
+
+
 # Child body for aggtree_metric: the hybrid (DCN x ICI) mesh needs 8
 # virtual devices, and the parent process may already have initialized
 # its backend with a different device count (CPU fallback pins 1), so
@@ -1375,6 +1526,13 @@ def child_main() -> None:
              1 << 24 if accel else 1 << 20,
              chunk_rows=1 << 22 if accel else 1 << 17),
          200 if accel else 75, False),
+        # async device-paced dispatch: depth {1,2,4} window matrix on
+        # the ooc sort + gang command batching on/off (round-trip count)
+        ("asyncpipe_rows_per_sec",
+         lambda: asyncpipe_metric(
+             1 << 23 if accel else 1 << 20,
+             chunk_rows=1 << 20 if accel else 1 << 17),
+         240 if accel else 90, False),
         # combine tree vs flat merge over a hybrid DCN x ICI mesh
         # (8 virtual CPU devices in a subprocess on any backend:
         # merge structure and byte accounting are platform-free)

@@ -217,6 +217,7 @@ def _load_builtin() -> None:
     from dryad_tpu.analysis import (  # noqa: F401
         checks_collectives,
         checks_determinism,
+        checks_dispatch,
         checks_events,
         checks_fusion,
         checks_layering,

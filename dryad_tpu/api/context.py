@@ -732,6 +732,64 @@ class DryadContext:
 
         return fetch
 
+    def run_many_to_host_async(self, queries):
+        """Dispatch SEVERAL independent queries as ONE lowered program
+        (cross-chunk plan fusion, ``config.chunk_fuse``): the roots
+        lower together, their stage chains land consecutively in the
+        graph, and ``plan_fuse`` folds them into a single dispatched
+        region — K dispatch round trips collapse into one.  Each query
+        stays its own computation inside the region (its reduction
+        order is untouched), so results are byte-identical to K
+        separate dispatches.
+
+        Returns one zero-arg ``fetch`` closure per query, resolving
+        that query's outputs from the shared execution.  The deferred
+        dict-miss check rides the FIRST fetch's transfer (a miss
+        anywhere in the group raises there, before any result of the
+        group is committed)."""
+        graph = lower(
+            [q.node for q in queries], self.config, self.dictionary,
+            P=num_partitions(self.mesh) if self.mesh is not None else None,
+        )
+        bindings = {
+            nid: self._bind_device(n) for nid, n in graph.inputs.items()
+        }
+        binding_fps = None
+        if self.config.checkpoint_dir:
+            binding_fps = {
+                nid: self._binding_fp(n) for nid, n in graph.inputs.items()
+            }
+        results, deferred = self.executor.execute(
+            graph, bindings, binding_fps, defer_miss=True
+        )
+        state = {"deferred_done": False}
+
+        def make_fetch(query, batch):
+            def fetch() -> Dict[str, np.ndarray]:
+                if not state["deferred_done"]:
+                    valid, host_cols = _fetch_with_miss(batch, deferred)
+                    state["deferred_done"] = True
+                else:
+                    valid, host_cols, _ = batch.fetch_host(extra=[])
+                self._account_d2h(valid, host_cols)
+                table = batch.to_numpy(
+                    query.schema, self.dictionary,
+                    _host=(valid, host_cols),
+                )
+                if self._codecs:
+                    from dryad_tpu.columnar.codecs import collapse_table
+
+                    table = collapse_table(table, self._codecs)
+                return table
+
+            return fetch
+
+        fetches = []
+        for q in queries:
+            sid, oidx = graph.outputs[q.node.id]
+            fetches.append(make_fetch(q, results[(sid, oidx)]))
+        return fetches
+
     def submit(self, query: Query) -> JobHandle:
         return JobHandle(self.run_to_host(query))
 

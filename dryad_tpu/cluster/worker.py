@@ -221,6 +221,41 @@ def _run_command(cmd: Dict, args, client, cp, wlog=None) -> Dict:
         os.unlink(pkg_path)
 
 
+def _exec_one(cmd: Dict, args, client, cp, pkgs, delay, wtracer, wlog) -> Dict:
+    """Execute one run/runpart/runcoded command and return its status
+    dict (no cseq — the caller stamps the mailbox echo).  Failures are
+    classified per command: a failed status carries the error, and the
+    worker keeps serving (report-and-continue, never crash the loop)."""
+    try:
+        with wtracer.span(
+            cmd["kind"], cat="worker", seq=cmd.get("seq"),
+            part=cmd.get("part", cmd.get("coded")),
+        ):
+            if cmd["kind"] in ("runpart", "runcoded"):
+                # injected straggler applies to coded vertices too, so
+                # coded-vs-duplicate comparisons stall the same way
+                if delay["count"] > 0:
+                    delay["count"] -= 1
+                    time.sleep(delay["seconds"])
+                status = (
+                    _run_part(cmd, args, client, pkgs)
+                    if cmd["kind"] == "runpart"
+                    else _run_coded(cmd, args, client, pkgs)
+                )
+                _absorb_ctx_events(
+                    wlog,
+                    pkgs.query.ctx if pkgs.query is not None else None,
+                )
+            else:
+                status = _run_command(cmd, args, client, cp, wlog=wlog)
+    except Exception as e:  # noqa: BLE001 — report, keep serving
+        traceback.print_exc()
+        info = {"error": f"{type(e).__name__}: {e}", "cmd": cmd}
+        cp.report_failure(info)
+        status = {"state": "failed", "error": info["error"]}
+    return status
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--service-host", default="127.0.0.1")
@@ -350,49 +385,44 @@ def main(argv=None) -> int:
                 json.dumps({"state": "delay_set", "cseq": cseq}).encode(),
             )
             continue
-        if cmd["kind"] in ("run", "runpart", "runcoded"):
-            try:
-                with wtracer.span(
-                    cmd["kind"], cat="worker", seq=cmd.get("seq"),
-                    part=cmd.get("part", cmd.get("coded")),
-                ):
-                    if cmd["kind"] in ("runpart", "runcoded"):
-                        # injected straggler applies to coded vertices
-                        # too, so coded-vs-duplicate comparisons stall
-                        # the same way
-                        if delay["count"] > 0:
-                            delay["count"] -= 1
-                            time.sleep(delay["seconds"])
-                        status = (
-                            _run_part(cmd, args, client, pkgs)
-                            if cmd["kind"] == "runpart"
-                            else _run_coded(cmd, args, client, pkgs)
-                        )
-                        _absorb_ctx_events(
-                            wlog,
-                            pkgs.query.ctx if pkgs.query is not None
-                            else None,
-                        )
-                    else:
-                        status = _run_command(
-                            cmd, args, client, cp, wlog=wlog
-                        )
-            except Exception as e:  # noqa: BLE001 — report, keep serving
-                traceback.print_exc()
-                info = {"error": f"{type(e).__name__}: {e}", "cmd": cmd}
-                cp.report_failure(info)
-                status = {"state": "failed", "error": info["error"]}
-            # telemetry ships BEFORE the status post: the driver drains
-            # right after it sees the status, so shipping after would
-            # race the batch against the drain
-            try:
-                cp.ship_telemetry(wlog.drain())
-            except Exception:  # noqa: BLE001 — telemetry is best-effort
-                pass
-            status["cseq"] = cseq
-            client.set_prop(
-                args.job, f"status/{args.pid}", json.dumps(status).encode()
-            )
+        if cmd["kind"] == "runbatch":
+            # Batched command stream: execute the sub-commands
+            # back-to-back and ship ONE aggregated status — K mailbox
+            # round trips become one (the cseq echo covers the batch).
+            # A failed sub-command does NOT stop the batch: every gang
+            # member executes the same list in the same order, keeping
+            # the per-command start/done barriers aligned, and the
+            # per-command statuses preserve fault classification.
+            results = []
+            first_error = None
+            for sub in cmd["cmds"]:
+                st = _exec_one(sub, args, client, cp, pkgs, delay,
+                               wtracer, wlog)
+                results.append(st)
+                if st.get("state") == "failed" and first_error is None:
+                    first_error = st.get("error")
+            status = {
+                "state": "failed" if first_error else "completed",
+                "results": results,
+            }
+            if first_error:
+                status["error"] = first_error
+        elif cmd["kind"] in ("run", "runpart", "runcoded"):
+            status = _exec_one(cmd, args, client, cp, pkgs, delay,
+                               wtracer, wlog)
+        else:
+            continue  # unknown command kind: ignore, keep serving
+        # telemetry ships BEFORE the status post: the driver drains
+        # right after it sees the status, so shipping after would
+        # race the batch against the drain
+        try:
+            cp.ship_telemetry(wlog.drain())
+        except Exception:  # noqa: BLE001 — telemetry is best-effort
+            pass
+        status["cseq"] = cseq
+        client.set_prop(
+            args.job, f"status/{args.pid}", json.dumps(status).encode()
+        )
 
 
 if __name__ == "__main__":

@@ -85,6 +85,10 @@ EVENT_KINDS: Dict[str, str] = {
                       "plus level/ici_bytes/dcn_bytes collective split",
     "stream_combine_policy": "combine degrade/reprobe decision; mode",
     "stream_group_done": "streaming group_by finished; chunks/groups",
+    "dispatch_gap": "device-idle gap between consecutive async chunk "
+                    "dispatches; gap_s, in_flight at submit",
+    "dispatch_window": "async dispatch window close summary; depth/"
+                       "dispatches/retries/gap_s/driver_cpu_s",
     # -- combine tree (exec.combinetree / outofcore / localjob) -----------
     "combine_tree_level": "one tree merge; level/group/fan_in/cap_rows/"
                           "bytes/ici_bytes/dcn_bytes/device",
@@ -110,6 +114,8 @@ EVENT_KINDS: Dict[str, str] = {
     "worker_started": "worker process launched; worker",
     "worker_joined": "worker announced on the control plane; worker",
     "worker_dead": "worker process died; worker",
+    "command_batch": "batched worker command stream posted; worker/"
+                     "commands/round_trips_saved",
     "gang_run_start": "gang SPMD submission began; seq/workers",
     "gang_run_complete": "gang SPMD submission finished; seconds",
     "gang_straggler": "gang run duration beyond the outlier threshold",
@@ -216,6 +222,11 @@ EVENT_PAYLOADS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
     ),
     "stream_combine_policy": (("chunks", "mode"), ("reprobe", "static")),
     "stream_group_done": (("chunks", "groups"), ()),
+    "dispatch_gap": (("gap_s",), ("in_flight", "pipeline")),
+    "dispatch_window": (
+        ("depth", "dispatches", "gap_s", "retries"),
+        ("driver_cpu_s", "pipeline", "wall_s"),
+    ),
     "combine_tree_level": (
         ("bytes", "cap_rows", "dcn_bytes", "device", "fan_in",
          "ici_bytes", "level"),
@@ -240,6 +251,9 @@ EVENT_PAYLOADS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
     "worker_started": (("worker",), ()),
     "worker_joined": (("worker",), ()),
     "worker_dead": (("worker",), ()),
+    "command_batch": (
+        ("commands", "round_trips_saved", "worker"), ("seqs",),
+    ),
     "gang_run_start": (("seq", "workers"), ()),
     "gang_run_complete": (("seconds", "seq"), ()),
     "gang_straggler": (("seconds", "seq", "threshold"), ()),

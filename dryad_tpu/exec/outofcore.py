@@ -46,7 +46,9 @@ from dryad_tpu.exec.partial import (
     merge_agg_spec,
     partial_plan,
 )
-from dryad_tpu.exec.pipeline import prefetched
+from dryad_tpu.exec.failure import JobFailedError, StageFailedError
+from dryad_tpu.exec.faults import InjectedFault
+from dryad_tpu.exec.pipeline import DispatchWindow, prefetched
 from dryad_tpu.exec.spill import SpillDir, SpillWriter
 from dryad_tpu.obs.metrics import KeyRangeHistogram, MetricsRegistry
 from dryad_tpu.obs.span import Tracer
@@ -92,9 +94,15 @@ class _IngestScope:
     pipeline; without it, a widened vocab baked into the coding tables
     forces a fresh XLA compile per chunk)."""
 
-    def __init__(self, ctx, cache_plans: bool = False):
+    def __init__(self, ctx, cache_plans: bool = False, slots: int = 1):
         self.ctx = ctx
         self.cap: Optional[int] = None
+        # With cross-chunk fusion, K chunks are lowered into ONE
+        # multi-root program — each needs its OWN input node (and
+        # binding) alive at dispatch, so the reuse cache round-robins
+        # over `slots` cached nodes instead of rebinding a single one.
+        self.slots = max(1, int(slots))
+        self._slot_counter = 0
         self.vocab: Dict[str, np.ndarray] = {}
         self.stats: Dict[str, Tuple[int, int]] = {}
         self.cache_plans = cache_plans
@@ -209,7 +217,9 @@ class _IngestScope:
         binding = ctx._bindings.get(node.id)
         if binding is None:
             return q
-        key = (self.cap, binding[0])
+        slot = self._slot_counter % self.slots
+        self._slot_counter += 1
+        key = (self.cap, binding[0], slot)
         cached = self._cached_input.get(key)
         if cached is not None and cached[0] == self.version:
             cnode = cached[1]
@@ -256,6 +266,92 @@ class _IngestScope:
         )
         ctx._bindings[node.id] = ("host_physical", table, self.cap)
         return Query(ctx, node)
+
+
+class _AsyncDispatcher:
+    """Driver-side async chunk dispatcher: marries the
+    :class:`~dryad_tpu.exec.pipeline.DispatchWindow` with cross-chunk
+    plan fusion.
+
+    Queries queue up to ``fuse`` deep and dispatch in submit order —
+    a fused batch lowers as ONE multi-root program
+    (``run_many_to_host_async``), collapsing K dispatch round trips
+    into one — and each chunk's readback fetch hands off to the
+    window's collector thread.  Outcomes are delivered strictly in
+    submit order, so the caller's commit body (spill / accumulate /
+    combine) observes the exact serial sequence and results stay
+    byte-identical with the ``dispatch_depth=1`` loop.
+
+    A fetch error surfacing at the drain site re-executes that chunk
+    serially via the caller's ``retry`` callback — the retried result
+    re-enters the stream at the failed chunk's commit position.
+    Terminal failures (:class:`JobFailedError` — the executor already
+    burned its attempt budget) and non-stage errors propagate; the
+    caller's ``finally`` closes the window, which never deadlocks.
+    """
+
+    def __init__(self, ctx, depth, fuse, events=None, name="chunks",
+                 retry=None):
+        self.ctx = ctx
+        self.fuse = max(1, int(fuse))
+        self.retry = retry
+        # a fused batch enters the window whole, so the window must
+        # admit at least `fuse` in-flight fetches
+        self.win = DispatchWindow(
+            max(1, int(depth), self.fuse), events=events, name=name,
+        )
+        self._queued: List[Tuple[Any, Any]] = []  # awaiting fused dispatch
+
+    def submit(self, tag, query) -> None:
+        self._queued.append((tag, query))
+        if len(self._queued) >= self.fuse:
+            self._dispatch()
+
+    def _dispatch(self) -> None:
+        queued, self._queued = self._queued, []
+        if not queued:
+            return
+        if len(queued) == 1:
+            fetches = [self.ctx.run_to_host_async(queued[0][1])]
+        else:
+            fetches = self.ctx.run_many_to_host_async(
+                [q for _tag, q in queued]
+            )
+        for (tag, _q), fetch in zip(queued, fetches):
+            self.win.submit(tag, fetch)
+
+    def ready(self):
+        """Completed (tag, table) pairs, non-blocking — the driver's
+        between-dispatches commit opportunity."""
+        return self._deliver(self.win.ready())
+
+    def drain(self):
+        """Flush the fused queue and deliver every remaining outcome
+        in submit order (blocking)."""
+        self._dispatch()
+        return self._deliver(self.win.drain())
+
+    def _deliver(self, outcomes):
+        for tag, value, error in outcomes:
+            if error is not None:
+                value = self._retry_one(tag, error)
+            yield tag, value
+
+    def _retry_one(self, tag, error):
+        transient = isinstance(
+            error, (StageFailedError, InjectedFault)
+        ) and not isinstance(error, JobFailedError)
+        if self.retry is None or not transient:
+            raise error
+        self.win.note_retry()
+        log.warning(
+            "async chunk fetch failed (%s: %s); retrying serially at "
+            "the drain site", type(error).__name__, error,
+        )
+        return self.retry(tag)
+
+    def close(self) -> None:
+        self.win.close()
 
 
 class _Stream:
@@ -443,6 +539,13 @@ class StreamExecutor:
             1, int(getattr(cfg, "stream_pipeline_depth", 1))
         )
         self.writer_queue = int(getattr(cfg, "stream_writer_queue", 8))
+        # async device-paced dispatch: how many chunk dispatches stay
+        # in flight (readbacks drained by the DispatchWindow collector
+        # thread); 1 = today's serial driver, the differential baseline
+        self.dispatch_depth = max(1, int(getattr(cfg, "dispatch_depth", 1)))
+        # cross-chunk fusion: K chunk partial-plans lowered as one
+        # multi-root program, collapsing K dispatch RTTs into one
+        self.chunk_fuse = max(1, int(getattr(cfg, "chunk_fuse", 1)))
         self.max_split_depth = 3
         self.events = ctx.executor.events if ctx.executor else None
         # driver-loop spans (cat=chunk structural, engine jobs land on
@@ -459,8 +562,16 @@ class StreamExecutor:
     def _pipelined(self) -> bool:
         return self.pipeline_depth > 1
 
-    def _scope(self) -> _IngestScope:
-        return _IngestScope(self.ctx, cache_plans=self._pipelined)
+    def _scope(self, slots: int = 1) -> _IngestScope:
+        return _IngestScope(
+            self.ctx, cache_plans=self._pipelined or slots > 1, slots=slots,
+        )
+
+    @property
+    def _async_dispatch(self) -> bool:
+        """Async drain path: window the chunk dispatches when the
+        driver is NOT already device-resident pipelining partials."""
+        return self.dispatch_depth > 1 or self.chunk_fuse > 1
 
     def _spill_writer(self) -> Optional[SpillWriter]:
         if not self._pipelined:
@@ -709,9 +820,17 @@ class StreamExecutor:
                 scope.chain_cache[key] = pq
         return pq
 
+    def _dispatcher(self, name: str, retry=None) -> _AsyncDispatcher:
+        return _AsyncDispatcher(
+            self.ctx, self.dispatch_depth, self.chunk_fuse,
+            events=self.events, name=name, retry=retry,
+        )
+
     def _group_partial(self, node, stream, keys, agg_list):
         if self._pipelined:
             return self._group_partial_device(node, stream, keys, agg_list)
+        if self._async_dispatch:
+            return self._group_partial_async(node, stream, keys, agg_list)
         return self._group_partial_serial(node, stream, keys, agg_list)
 
     def _group_partial_serial(self, node, stream, keys, agg_list):
@@ -765,6 +884,91 @@ class StreamExecutor:
             return "small", _empty_table(node.schema)
         out = combine(acc, final=True)
         self._emit("stream_group_done", chunks=nchunks,
+                   groups=len(next(iter(out.values()))) if out else 0)
+        return "small", out
+
+    def _group_partial_async(self, node, stream, keys, agg_list):
+        """Async serial driver (``dispatch_depth``/``chunk_fuse`` > 1
+        without the device-resident pipeline): the exact
+        ``_group_partial_serial`` accumulate/combine body, but chunk
+        partial dispatches stay in flight through the
+        :class:`DispatchWindow` and readbacks drain on the collector
+        thread.  Commits run strictly in submit order, so the host
+        accumulator (and its float reduction order) matches the serial
+        loop bit-for-bit."""
+        partial, plan = partial_plan(agg_list)
+        merge_spec = merge_agg_spec(plan)
+        # one cached-input slot per fused chunk: a fused batch needs
+        # all K input nodes bound simultaneously at dispatch
+        scope = self._scope(slots=self.chunk_fuse)
+        mscope = self._scope()
+        acc: List[Dict[str, np.ndarray]] = []
+        st = {"acc_rows": 0, "nchunks": 0, "pschema": None}
+        shape = TreeShape(self.ctx.mesh, self.ctx.config)
+
+        def combine(tables, final: bool):
+            cat = _concat_tables(tables, st["pschema"])
+            q = mscope.ingest(cat, st["pschema"]).group_by(keys, merge_spec)
+            if final:
+                q = self._finalize_query(q, plan, keys, node.schema)
+            return self.ctx.run_to_host(q)
+
+        def retry(tag):
+            # serial re-execution of ONE chunk: the original cached
+            # input node may have been rebound to a later chunk by
+            # slot reuse, so re-ingest the retained host table through
+            # a fresh uncached scope
+            _n, table = tag
+            rscope = _IngestScope(self.ctx)
+            rq = self._chunk_partial_query(
+                rscope, stream, table, node, keys, partial
+            )
+            return self.ctx.run_to_host(rq)
+
+        def commit(tag, pt):
+            n, _table = tag
+            rows = len(next(iter(pt.values()))) if pt else 0
+            acc.append(pt)
+            st["acc_rows"] += rows
+            st["nchunks"] += 1
+            self._emit("stream_chunk", rows=n, partial_rows=rows)
+            if st["acc_rows"] > self.combine_rows and len(acc) > 1:
+                in_bytes = sum(
+                    int(np.asarray(v).nbytes)
+                    for t in acc for v in t.values()
+                )
+                merged = combine(acc, final=False)
+                acc[:] = [merged]
+                st["acc_rows"] = (
+                    len(next(iter(merged.values()))) if merged else 0
+                )
+                out_bytes = sum(
+                    int(np.asarray(v).nbytes) for v in merged.values()
+                )
+                ici, dcn = shape.exchange_split(in_bytes, out_bytes)
+                self._emit("stream_combine", rows_out=st["acc_rows"],
+                           level=0, ici_bytes=ici, dcn_bytes=dcn)
+
+        dsp = self._dispatcher("grouppartial", retry=retry)
+        try:
+            for table in self._iter_base(stream):
+                n = _chunk_rows(table)
+                pq = self._chunk_partial_query(
+                    scope, stream, table, node, keys, partial
+                )
+                if st["pschema"] is None:
+                    st["pschema"] = pq.schema
+                dsp.submit((n, table), pq)
+                for tag, pt in dsp.ready():
+                    commit(tag, pt)
+            for tag, pt in dsp.drain():
+                commit(tag, pt)
+        finally:
+            dsp.close()
+        if st["pschema"] is None:  # empty stream
+            return "small", _empty_table(node.schema)
+        out = combine(acc, final=True)
+        self._emit("stream_group_done", chunks=st["nchunks"],
                    groups=len(next(iter(out.values()))) if out else 0)
         return "small", out
 
@@ -1133,7 +1337,9 @@ class StreamExecutor:
             )
         partial, plan = partial_plan(agg_list)
         merge_spec = merge_agg_spec(plan)
-        scope = self._scope()
+        scope = self._scope(
+            slots=1 if self._pipelined else self.chunk_fuse
+        )
         fin = finalize_fn(plan)
         pschema = None
 
@@ -1182,26 +1388,58 @@ class StreamExecutor:
         # threshold as _group_partial — a long stream must not grow the
         # accumulator one partial row per chunk without bound
         acc_t: List[Dict[str, np.ndarray]] = []
-        acc_rows = 0
+        st = {"rows": 0}
         mscope = self._scope()
-        for table in self._iter_base(stream):
-            pq = chunk_query(table)
-            if pschema is None:
-                pschema = pq.schema
-            pt = self.ctx.run_to_host(pq)
+
+        def commit(pt):
             acc_t.append(pt)
-            acc_rows += len(next(iter(pt.values()))) if pt else 0
-            if acc_rows > self.combine_rows and len(acc_t) > 1:
+            st["rows"] += len(next(iter(pt.values()))) if pt else 0
+            if st["rows"] > self.combine_rows and len(acc_t) > 1:
                 cat = _concat_tables(acc_t, pschema)
                 merged = self.ctx.run_to_host(
                     mscope.ingest(cat, pschema).aggregate_as_query(merge_spec)
                 )
-                acc_t = [merged]
-                acc_rows = len(next(iter(merged.values()))) if merged else 0
+                acc_t[:] = [merged]
+                st["rows"] = len(next(iter(merged.values()))) if merged else 0
                 self._emit(
-                    "stream_combine", rows_out=acc_rows,
+                    "stream_combine", rows_out=st["rows"],
                     level=0, ici_bytes=0, dcn_bytes=0,
                 )
+
+        if self._async_dispatch:
+            # async serial driver: partial dispatches stay in flight
+            # through the window; the host accumulator commits at the
+            # drain site in submit order (same body, same float order)
+            def retry(table):
+                rscope = _IngestScope(self.ctx)
+                rq = Query(
+                    self.ctx,
+                    self._chain_root(
+                        rscope, rscope.ingest(table, stream.base_schema),
+                        stream.pending,
+                    ),
+                ).aggregate_as_query(partial)
+                return self.ctx.run_to_host(rq)
+
+            dsp = self._dispatcher("aggpartial", retry=retry)
+            try:
+                for table in self._iter_base(stream):
+                    pq = chunk_query(table)
+                    if pschema is None:
+                        pschema = pq.schema
+                    dsp.submit(table, pq)
+                    for _tag, pt in dsp.ready():
+                        commit(pt)
+                for _tag, pt in dsp.drain():
+                    commit(pt)
+            finally:
+                dsp.close()
+        else:
+            for table in self._iter_base(stream):
+                pq = chunk_query(table)
+                if pschema is None:
+                    pschema = pq.schema
+                commit(self.ctx.run_to_host(pq))
         if pschema is None:
             raise StreamNotSupported("scalar aggregate over an empty stream")
         cat = _concat_tables(acc_t, pschema)
@@ -1415,7 +1653,7 @@ class StreamExecutor:
         primary, _pdesc = keys[0]
         # one scope for all buckets: the pow2 capacity palette keeps
         # repeated bucket sizes on the same compiled program
-        bscope = self._scope()
+        bscope = self._scope(slots=self.chunk_fuse)
 
         def reads():
             for b in order:
@@ -1441,6 +1679,29 @@ class StreamExecutor:
             spill.drop_bucket(b)
             return out
 
+        def retry(tag):
+            # serial re-run of one bucket through a fresh scope (the
+            # shared bscope's cached node may have been rebound to a
+            # later bucket by the time the drain site sees the error)
+            b, rows, t = tag
+            rscope = _IngestScope(self.ctx)
+            rscope.cap = self._bucket_cap(rows)
+            return self._run_engine(
+                self._clone(node, [rscope.ingest(t, node.schema).node])
+            )
+
+        dsp = (
+            self._dispatcher(f"sortdrain{depth}", retry=retry)
+            if self._async_dispatch else None
+        )
+
+        def committed(outcomes):
+            for (db, drows, _dt), out in outcomes:
+                self._emit("stream_bucket", bucket=db, rows=drows,
+                           depth=depth)
+                spill.drop_bucket(db)
+                yield out
+
         try:
             for b, rows, t in src:
                 if t is not None:
@@ -1448,7 +1709,12 @@ class StreamExecutor:
                     cur = self._clone(
                         node, [bscope.ingest(t, node.schema).node]
                     )
-                    if self._pipelined:
+                    if dsp is not None:
+                        # async drain path: the collector owns the
+                        # readback, the driver commits in key order
+                        dsp.submit((b, rows, t), Query(self.ctx, cur))
+                        yield from committed(dsp.ready())
+                    elif self._pipelined:
                         fetch = self.ctx.run_to_host_async(
                             Query(self.ctx, cur)
                         )
@@ -1464,6 +1730,8 @@ class StreamExecutor:
                     continue
                 # oversized: results must stay in key order, so the
                 # dispatch window drains before the re-split recursion
+                if dsp is not None:
+                    yield from committed(dsp.drain())
                 while inflight:
                     yield drain_one()
                 if depth >= self.max_split_depth:
@@ -1504,9 +1772,13 @@ class StreamExecutor:
                     depth=depth + 1, splitters=sub,
                 )
                 spill.drop_bucket(b)
+            if dsp is not None:
+                yield from committed(dsp.drain())
             while inflight:
                 yield drain_one()
         finally:
+            if dsp is not None:
+                dsp.close()
             if hasattr(src, "close"):
                 src.close()
 

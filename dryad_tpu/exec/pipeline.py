@@ -24,6 +24,10 @@ TPU streaming driver (``exec.outofcore``):
 
 The spill half of the pipeline (background bucket writes) lives next
 to the format it serializes: ``exec.spill.SpillWriter``.
+
+The dispatch half — the driver keeping N device dispatches in flight
+while a background collector drains readbacks in submit order — is
+:class:`DispatchWindow` (``config.dispatch_depth``).
 """
 
 from __future__ import annotations
@@ -35,7 +39,9 @@ from typing import Any, Iterator, Optional
 from dryad_tpu.obs import flightrec
 from dryad_tpu.obs.span import Tracer
 
-__all__ = ["ChunkPrefetcher", "PipelineStats", "prefetched"]
+__all__ = [
+    "ChunkPrefetcher", "DispatchWindow", "PipelineStats", "prefetched",
+]
 
 
 class PipelineStats:
@@ -229,6 +235,199 @@ class ChunkPrefetcher:
                 failure_kind=classify(error, []).value,
                 error=f"{type(error).__name__}: {error}",
             )
+
+
+class DispatchWindow:
+    """Async device-paced dispatch: the driver only FEEDS.
+
+    The driver thread dispatches device work itself (``dispatch``
+    returns immediately under JAX async dispatch — the executor is
+    driver-owned and not thread-safe) and hands the blocking half — the
+    zero-arg ``fetch`` closure from
+    ``api.context.DryadContext.run_to_host_async`` — to ONE background
+    collector thread via :meth:`submit`.  The collector drains fetches
+    strictly in submit order, so chunk commit order (and therefore the
+    float accumulation order of everything downstream) is exactly the
+    serial loop's and results stay byte-identical.
+
+    Window invariants:
+
+    - at most ``depth`` fetches are in flight (handed to the collector
+      and not yet drained): :meth:`submit` blocks past that, the flow
+      control that bounds host result memory.  The block waits on the
+      COLLECTOR's progress, never the driver's own — a full window can
+      always drain itself;
+    - the collector ONLY calls fetch closures — device dispatch, chunk
+      ingest, combines, and retries all stay on the driver thread;
+    - outcomes surface in submit order as ``(tag, value, error)``
+      triples from :meth:`ready` / :meth:`drain`; a fetch exception is
+      delivered at the drain site (never raised on the collector
+      thread), where the driver may re-dispatch the chunk — the retry
+      re-enters the window at the failed chunk's commit position;
+    - :meth:`close` always joins the collector, also mid-error: a
+      poisoned window can never deadlock the driver's ``finally``.
+
+    ``dispatch_gap`` events sample the device-idle seconds between the
+    previous drain going idle and the next submit (the metric async
+    dispatch exists to drive to ~0); one ``dispatch_window`` summary at
+    close carries totals plus the driver thread's CPU seconds over the
+    window's life (``driver_cpu_fraction`` in JobMetrics).
+    """
+
+    def __init__(self, depth: int, events=None, name: str = "dispatch"):
+        if depth < 1:
+            raise ValueError("dispatch depth must be >= 1")
+        self.depth = depth
+        self.name = name
+        self.events = events
+        self.dispatches = 0
+        self.retries = 0
+        self.gap_s = 0.0
+        self._t0_wall = time.monotonic()
+        # driver CPU over the window's life: __init__/close both run on
+        # the driver thread, so thread_time deltas are driver-only
+        self._t0_cpu = time.thread_time()
+        self._pending: list = []  # (tag, fetch) awaiting the collector
+        self._done: list = []  # (tag, value, error) in submit order
+        self._outstanding = 0  # submitted - consumed by the driver
+        self._cv = threading.Condition()
+        self._closed = False
+        # None until the first drain-to-empty: the span between window
+        # creation and the first submit is ingest warmup, not a
+        # dispatch gap — counting it would drown the between-dispatch
+        # signal the metric exists for
+        self._idle_since: Optional[float] = None
+        self._thread = threading.Thread(
+            target=self._collect, name=f"dryad-{name}", daemon=True
+        )
+        flightrec.probe(
+            f"dispatch:{name}",
+            lambda: {
+                "in_flight": len(self._pending),
+                "outstanding": self._outstanding,
+                "depth": self.depth,
+            },
+        )
+        self._thread.start()
+
+    # -- collector thread --------------------------------------------------
+
+    def _collect(self) -> None:
+        while True:
+            with self._cv:
+                while not self._pending and not self._closed:
+                    self._cv.wait(0.1)
+                if not self._pending:
+                    return  # closed and drained
+                tag, fetch = self._pending[0]
+            value, error = None, None
+            try:
+                value = fetch()
+            except BaseException as e:  # noqa: BLE001 - delivered at drain
+                error = e
+            with self._cv:
+                if self._pending:  # close() may have dropped the queue
+                    self._pending.pop(0)
+                self._done.append((tag, value, error))
+                if not self._pending:
+                    self._idle_since = time.monotonic()
+                self._cv.notify_all()
+
+    # -- driver side -------------------------------------------------------
+
+    def submit(self, tag, fetch) -> None:
+        """Hand one dispatched chunk's fetch closure to the collector.
+        Call immediately after the async dispatch; blocks while the
+        window is full (``depth`` outstanding)."""
+        now = time.monotonic()
+        with self._cv:
+            if self._closed:
+                raise RuntimeError(f"dispatch window {self.name} closed")
+            if not self._pending and self._idle_since is not None:
+                gap = now - self._idle_since
+                self.gap_s += gap
+                in_flight = len(self._pending)
+            else:
+                gap = None
+            # flow control on UN-FETCHED work only: the collector makes
+            # progress independently, so this wait always resolves (a
+            # wait on driver-consumed counts would deadlock — the
+            # driver is the one blocked here)
+            while len(self._pending) >= self.depth and not self._closed:
+                self._cv.wait(0.1)
+            self._pending.append((tag, fetch))
+            self._outstanding += 1
+            self.dispatches += 1
+            self._idle_since = None
+            self._cv.notify_all()
+        if gap is not None and self.events is not None:
+            self.events.emit(
+                "dispatch_gap", pipeline=self.name,
+                gap_s=round(gap, 6), in_flight=in_flight,
+            )
+
+    def note_retry(self) -> None:
+        """Record one drain-time chunk retry (re-entered the window)."""
+        self.retries += 1
+
+    def ready(self):
+        """Yield completed ``(tag, value, error)`` triples in submit
+        order WITHOUT blocking — the driver's between-dispatches
+        commit opportunity."""
+        while True:
+            with self._cv:
+                if not self._done:
+                    return
+                item = self._done.pop(0)
+                self._outstanding -= 1
+                self._cv.notify_all()
+            yield item
+
+    def drain(self):
+        """Yield every remaining outcome in submit order, blocking
+        until the collector delivers each."""
+        while True:
+            with self._cv:
+                while not self._done:
+                    if not self._pending and self._outstanding == 0:
+                        return
+                    self._cv.wait(0.1)
+                item = self._done.pop(0)
+                self._outstanding -= 1
+                self._cv.notify_all()
+            yield item
+
+    def close(self) -> None:
+        """Join the collector.  Safe from ``finally`` and repeatedly;
+        undelivered fetches are abandoned (their device work completes
+        harmlessly — readback never happens)."""
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            # abandoned pending fetches would block the join on device
+            # readbacks nobody will consume; the collector checks
+            # _closed only between fetches, so drop the queue here
+            self._pending.clear()
+            self._cv.notify_all()
+        self._thread.join(timeout=30.0)
+        flightrec.unprobe(f"dispatch:{self.name}")
+        if self.events is not None:
+            self.events.emit(
+                "dispatch_window", pipeline=self.name, depth=self.depth,
+                dispatches=self.dispatches, retries=self.retries,
+                gap_s=round(self.gap_s, 6),
+                wall_s=round(time.monotonic() - self._t0_wall, 6),
+                driver_cpu_s=round(
+                    time.thread_time() - self._t0_cpu, 6
+                ),
+            )
+
+    def __enter__(self) -> "DispatchWindow":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 def prefetched(source, depth: int, events=None, name: str = "prefetch"):

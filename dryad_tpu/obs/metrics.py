@@ -307,6 +307,33 @@ class JobMetrics:
     peak_exchange_bytes: int = 0
     exchange_ici_bytes: int = 0
     exchange_dcn_bytes: int = 0
+    # async device-paced dispatch (exec.pipeline DispatchWindow):
+    # device-idle seconds between consecutive dispatches (the number
+    # the window exists to drive to ~0), drain-time chunk retries, and
+    # the driver thread's CPU vs wall occupancy over the windows'
+    # lives (surfaced as ``driver_cpu_fraction``)
+    dispatch_windows: int = 0
+    window_dispatches: int = 0
+    dispatch_gap_s: float = 0.0
+    dispatch_retries: int = 0
+    driver_cpu_s: float = 0.0
+    driver_wall_s: float = 0.0
+    # batched worker command streams (cluster.localjob submit_many):
+    # runbatch envelopes shipped and the mailbox round trips they
+    # saved vs one command per trip
+    command_batches: int = 0
+    batched_commands: int = 0
+    round_trips_saved: int = 0
+
+    @property
+    def driver_cpu_fraction(self) -> float:
+        """Driver-thread CPU seconds per wall second across dispatch
+        windows (0 when no window summaries were recorded) — the
+        driver-off-the-hot-path signal: asynchronous dispatch should
+        push this well below 1 while the device stays busy."""
+        if self.driver_wall_s <= 0:
+            return 0.0
+        return min(1.0, self.driver_cpu_s / self.driver_wall_s)
 
     @property
     def padding_waste(self) -> float:
@@ -344,6 +371,11 @@ class JobMetrics:
             "degraded_fraction": round(self.degraded_fraction, 4),
             "exchange_rounds": self.exchange_rounds,
             "peak_exchange_bytes": self.peak_exchange_bytes,
+            "dispatch_gap_s": round(self.dispatch_gap_s, 4),
+            "driver_cpu_fraction": round(self.driver_cpu_fraction, 4),
+            "dispatch_retries": self.dispatch_retries,
+            "command_batches": self.command_batches,
+            "round_trips_saved": self.round_trips_saved,
         }
 
     # counter names folded from ``metrics`` snapshot events into the
@@ -426,6 +458,23 @@ class JobMetrics:
                 )
                 m.exchange_dcn_bytes += int(ev.get("dcn_bytes", 0) or 0)
                 m.exchange_ici_bytes += int(ev.get("ici_bytes", 0) or 0)
+            elif kind == "dispatch_window":
+                # the close-time summary carries the cumulative gap_s
+                # of its per-gap ``dispatch_gap`` events, so ONLY the
+                # summary is folded — the per-gap events feed the
+                # trace/jobview timelines instead of this snapshot
+                m.dispatch_windows += 1
+                m.window_dispatches += int(ev.get("dispatches", 0) or 0)
+                m.dispatch_gap_s += float(ev.get("gap_s", 0.0) or 0.0)
+                m.dispatch_retries += int(ev.get("retries", 0) or 0)
+                m.driver_cpu_s += float(ev.get("driver_cpu_s", 0.0) or 0.0)
+                m.driver_wall_s += float(ev.get("wall_s", 0.0) or 0.0)
+            elif kind == "command_batch":
+                m.command_batches += 1
+                m.batched_commands += int(ev.get("commands", 0) or 0)
+                m.round_trips_saved += int(
+                    ev.get("round_trips_saved", 0) or 0
+                )
             elif kind == "combine_tree_degrade":
                 m.degraded_ranges = max(
                     m.degraded_ranges, int(ev.get("degraded", 0) or 0)
@@ -471,6 +520,20 @@ def format_attribution(m: JobMetrics) -> List[str]:
                 if m.fused_dispatches else ""
             )
         )
+    if m.dispatch_windows:
+        # the dispatch-occupancy line: device-idle gap between
+        # dispatches and the driver thread's CPU share of the window's
+        # wall time — both should fall as dispatch_depth rises
+        lines.append(
+            f"dispatch: {m.window_dispatches} async over "
+            f"{m.dispatch_windows} window(s)  "
+            f"gap={m.dispatch_gap_s:.3f}s  "
+            f"driver_cpu={m.driver_cpu_fraction:.0%}"
+            + (
+                f"  retries={m.dispatch_retries}"
+                if m.dispatch_retries else ""
+            )
+        )
     parts = []
     if m.spill_bytes:
         parts.append(f"spill_bytes={m.spill_bytes}")
@@ -503,6 +566,12 @@ def format_attribution(m: JobMetrics) -> List[str]:
             f"exchange: rounds={m.exchange_rounds} "
             f"peak={m.peak_exchange_bytes}B "
             f"dcn={m.exchange_dcn_bytes}B ici={m.exchange_ici_bytes}B"
+        )
+    if m.command_batches:
+        parts.append(
+            f"cmd_batch: {m.batched_commands} cmds in "
+            f"{m.command_batches} batches "
+            f"(saved {m.round_trips_saved} round trips)"
         )
     if m.workers:
         parts.append(f"worker_telemetry={m.workers} workers")

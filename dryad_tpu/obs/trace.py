@@ -9,6 +9,8 @@ Renders the framework's event log in the Trace Event Format that
   separate swim lanes, and the PR 2 overlap is visually inspectable;
 - ``stream_prefetch`` events become an ``in_flight`` counter track
   (pipeline occupancy over time);
+- ``dispatch_gap`` events become an ``in_flight_dispatches`` counter
+  track (async-dispatch window occupancy — dips mark device idle);
 - every other event becomes an instant marker on a per-process
   "events" track, so state transitions (stage_failed, quarantine,
   combine-policy flips) line up against the slices that caused them;
@@ -92,6 +94,17 @@ def chrome_trace(
         elif kind == "stream_prefetch":
             out.append({
                 "ph": "C", "name": f"in_flight:{ev.get('pipeline', '?')}",
+                "pid": pid, "tid": 0,
+                "ts": round((ev["ts"] - base) * 1e6, 1),
+                "args": {"in_flight": ev.get("in_flight", 0)},
+            })
+        elif kind == "dispatch_gap":
+            # async-dispatch occupancy: each gap event samples the
+            # window going idle, so the counter dips to the sampled
+            # in-flight count exactly where the device starved
+            out.append({
+                "ph": "C",
+                "name": f"in_flight_dispatches:{ev.get('pipeline', '?')}",
                 "pid": pid, "tid": 0,
                 "ts": round((ev["ts"] - base) * 1e6, 1),
                 "args": {"in_flight": ev.get("in_flight", 0)},

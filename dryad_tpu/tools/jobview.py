@@ -219,6 +219,42 @@ def _fold_job(events: List[Dict[str, Any]]) -> JobInfo:
                 stream_stats.get("degraded_fraction", 0.0),
                 float(ev.get("fraction", 0.0) or 0.0),
             )
+        elif kind == "dispatch_gap":
+            # async-dispatch occupancy sample: the window went idle
+            # (device starved) before this submit
+            stream_stats["dispatch_gaps"] = (
+                stream_stats.get("dispatch_gaps", 0) + 1
+            )
+        elif kind == "dispatch_window":
+            # close-time window summary: cumulative device-idle gap,
+            # drain retries, and the driver thread's CPU over the
+            # window's wall time (the off-the-hot-path signal)
+            stream_stats["dispatch_windows"] = (
+                stream_stats.get("dispatch_windows", 0) + 1
+            )
+            stream_stats["dispatch_depth"] = max(
+                stream_stats.get("dispatch_depth", 0), ev.get("depth", 0)
+            )
+            stream_stats["dispatches"] = (
+                stream_stats.get("dispatches", 0)
+                + ev.get("dispatches", 0)
+            )
+            stream_stats["dispatch_retries"] = (
+                stream_stats.get("dispatch_retries", 0)
+                + ev.get("retries", 0)
+            )
+            stream_stats["dispatch_gap_s"] = round(
+                stream_stats.get("dispatch_gap_s", 0.0)
+                + ev.get("gap_s", 0.0), 4,
+            )
+            stream_stats["_disp_cpu_s"] = (
+                stream_stats.get("_disp_cpu_s", 0.0)
+                + ev.get("driver_cpu_s", 0.0)
+            )
+            stream_stats["_disp_wall_s"] = (
+                stream_stats.get("_disp_wall_s", 0.0)
+                + ev.get("wall_s", 0.0)
+            )
         elif kind.startswith("stream_"):
             if kind == "stream_chunk":
                 stream_stats["chunks"] = stream_stats.get("chunks", 0) + 1
@@ -444,6 +480,29 @@ def render(job: JobInfo) -> str:
                 f"errors={st.get('pipeline_errors', 0)}"
                 + (f"  combine_policy={st['combine_policy']}"
                    if st.get("combine_policy") else "")
+            )
+        if st.get("dispatch_windows"):
+            # dispatch-occupancy line: how much of the windows' wall
+            # time the device had work queued (1 - gap/wall), and the
+            # driver thread's CPU share of it — depth>1 should push
+            # occupancy up and driver_cpu down vs the serial baseline
+            wall = st.get("_disp_wall_s", 0.0)
+            gap = st.get("dispatch_gap_s", 0.0)
+            occ = max(0.0, 1.0 - gap / wall) if wall > 0 else 0.0
+            cpu = (
+                st.get("_disp_cpu_s", 0.0) / wall if wall > 0 else 0.0
+            )
+            lines.append(
+                "dispatch: "
+                f"depth={st.get('dispatch_depth', 0)}  "
+                f"async={st.get('dispatches', 0)} "
+                f"over {st.get('dispatch_windows', 0)} window(s)  "
+                f"occupancy={occ:.0%} (gap {gap:.3f}s)  "
+                f"driver_cpu={min(cpu, 1.0):.0%}"
+                + (
+                    f"  retries={st.get('dispatch_retries', 0)}"
+                    if st.get("dispatch_retries") else ""
+                )
             )
     if job.exchanges:
         # exchange planner panel: one line per repartitioning stage —

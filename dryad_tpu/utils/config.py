@@ -310,6 +310,40 @@ class DryadConfig:
     diagnose_cooldown_s: float = _env_float(
         "DRYAD_TPU_DIAGNOSE_COOLDOWN_S", 5.0
     )
+    # Async device-paced dispatch (exec.pipeline.DispatchWindow): how
+    # many out-of-core chunk dispatches may be in flight before the
+    # streaming driver blocks on its oldest readback.  The driver
+    # thread only FEEDS (dispatch returns immediately); a background
+    # collector thread drains readbacks strictly in submit order, so
+    # chunk commit order — and therefore float accumulation order —
+    # is identical to the serial loop and results stay byte-identical.
+    # Overflow retries are detected at drain time and the retried
+    # chunk re-enters the window.  1 = the serial dispatch-then-drain
+    # legacy driver, kept as the differential baseline.
+    dispatch_depth: int = _env_int("DRYAD_TPU_DISPATCH_DEPTH", 2)
+    # Cross-chunk plan fusion: the streaming driver lowers up to this
+    # many chunk partial-plans as ONE multi-root program per dispatch
+    # (api.context.DryadContext.run_many_to_host_async) — the chunk
+    # chains land consecutively in the stage graph, so plan_fuse folds
+    # them into a single dispatched region and K chunk round trips
+    # collapse into one.  Each chunk remains its own computation inside
+    # the region (per-chunk reduction order unchanged -> byte
+    # identical).  1 = one chunk per dispatch (legacy).
+    chunk_fuse: int = _env_int("DRYAD_TPU_CHUNK_FUSE", 1)
+    # Device-side do_while routing: attempt the lax.while_loop lowering
+    # for EVERY fixed-point stage (not only device=True plans), keeping
+    # iteration on the chip instead of paying one dispatch round trip
+    # per driver-loop iteration; lowering refusals fall back to the
+    # driver loop exactly as the explicit device path does.
+    do_while_device_auto: bool = _env_bool(
+        "DRYAD_TPU_DO_WHILE_DEVICE_AUTO", True
+    )
+    # Batched worker command streams (cluster.localjob/worker): up to
+    # this many gang run commands ship per worker as ONE ``runbatch``
+    # mailbox command with one aggregated status round trip (per-
+    # command fault classification preserved in the aggregate).
+    # 0 disables batching (one mailbox round trip per command).
+    command_batch: int = _env_int("DRYAD_TPU_COMMAND_BATCH", 8)
 
     def __post_init__(self) -> None:
         self.validate()
@@ -400,6 +434,12 @@ class DryadConfig:
             )
         if self.stream_host_reprobe < 0:
             raise ValueError("stream_host_reprobe must be >= 0")
+        if self.dispatch_depth < 1:
+            raise ValueError("dispatch_depth must be >= 1")
+        if self.chunk_fuse < 1:
+            raise ValueError("chunk_fuse must be >= 1")
+        if self.command_batch < 0:
+            raise ValueError("command_batch must be >= 0")
 
 
 # Every ``DryadConfig`` field, one line each — THE documented key
@@ -466,4 +506,8 @@ CONFIG_KEYS = {
     "diagnose_skew_ratio": "partition-skew max/mean row-ratio trigger",
     "diagnose_recompile_burst": "per-tier compiles in window = storm",
     "diagnose_cooldown_s": "per-(rule, subject) re-diagnosis cooldown",
+    "dispatch_depth": "ooc chunk dispatches in flight; 1 = serial driver",
+    "chunk_fuse": "chunk partial-plans lowered per dispatch; 1 = legacy",
+    "do_while_device_auto": "try lax.while_loop for every fixed point",
+    "command_batch": "gang run commands per runbatch round trip; 0 off",
 }

@@ -21,6 +21,7 @@ from dryad_tpu import DryadConfig, DryadContext
 from dryad_tpu.exec.failure import JobFailedError
 from dryad_tpu.exec.faults import (
     FaultPlan,
+    InjectedStageFailure,
     install_plan,
     set_fake_checkpoint_corruption,
     set_fake_stage_failure,
@@ -317,6 +318,121 @@ def test_chaos_budget_exhaustion_carries_history(mesh8):
         ).collect()
     assert len(ei.value.attempts) == 3
     assert all(a.kind == "transient" for a in ei.value.attempts)
+
+
+# -- async dispatch window under chaos (exec/outofcore + exec/pipeline) ------
+
+
+def test_chaos_async_dispatch_window_matches_serial(mesh8):
+    """FaultPlan stage failures land while the dispatch window holds
+    chunks in flight: the executor retries each injected failure inside
+    its budget at dispatch time, the window drains cleanly (no
+    collector deadlock, no terminal failure), and the committed stream
+    stays byte-identical to the ``dispatch_depth=1`` serial driver."""
+    from tests.test_fuzz_differential import _assert_byte_identical_rows
+
+    rng = np.random.default_rng(6)
+    chunks = [
+        {
+            "k": rng.integers(0, 13, 600).astype(np.int32),
+            "v": rng.standard_normal(600).astype(np.float32),
+        }
+        for _ in range(4)
+    ]
+
+    def run(depth, fuse):
+        ctx = DryadContext(
+            num_partitions_=8,
+            config=DryadConfig(
+                stream_pipeline_depth=1, dispatch_depth=depth,
+                chunk_fuse=fuse, stream_combine_rows=20,
+                **CHAOS_CONFIG,
+            ),
+        )
+        install_plan(_plan(2))
+        try:
+            out = (
+                ctx.from_stream(
+                    iter([{c: v.copy() for c, v in ch.items()}
+                          for ch in chunks])
+                )
+                .group_by("k", {"s": ("sum", "v"), "n": ("count", None)})
+                .collect()
+            )
+        finally:
+            install_plan(None)
+        return out, ctx
+
+    on, ctx_on = run(3, 2)
+    off, _ = run(1, 1)
+    kinds = [e["kind"] for e in ctx_on.executor.events.events()]
+    assert "dispatch_window" in kinds
+    assert "stage_failed" in kinds, "the chaos plan should have fired"
+    assert "job_failed" not in kinds
+    _assert_byte_identical_rows(on, off, "async chaos vs serial")
+
+
+def test_chaos_drain_site_retry_and_terminal_error_no_deadlock():
+    """The window's drain-site contract, exercised directly: a fetch
+    that dies with a transient injected fault is re-executed via the
+    dispatcher's retry callback AT ITS COMMIT POSITION (submit order is
+    preserved around it), a terminal ``JobFailedError`` propagates to
+    the caller, and ``close()`` joins the collector in both cases."""
+    from dryad_tpu.exec.outofcore import _AsyncDispatcher
+
+    class _FakeCtx:
+        # the dispatcher hands each query straight back as its fetch
+        def run_to_host_async(self, fetch):
+            return fetch
+
+        def run_many_to_host_async(self, fetches):
+            return list(fetches)
+
+    def ok(i):
+        return lambda: {"i": np.array([i])}
+
+    def boom(exc):
+        def fetch():
+            raise exc
+
+        return fetch
+
+    retried = []
+
+    def retry(tag):
+        retried.append(tag)
+        return {"i": np.array([tag])}
+
+    got = []
+    dsp = _AsyncDispatcher(_FakeCtx(), 3, 2, retry=retry)
+    try:
+        for i in range(7):
+            dsp.submit(
+                i,
+                boom(InjectedStageFailure("mid-window")) if i == 3
+                else ok(i),
+            )
+            # interleaved non-blocking commits, like the driver loop
+            got.extend(dsp.ready())
+        got.extend(dsp.drain())
+    finally:
+        dsp.close()
+    # ready() committed a prefix, drain() the rest — together they must
+    # cover 0..6 in submit order, with chunk 3 served by the retry
+    assert retried == [3]
+    assert [(tag, int(t["i"][0])) for tag, t in got] == [
+        (i, i) for i in range(7)
+    ]
+    assert dsp.win.retries == 1
+
+    dsp2 = _AsyncDispatcher(_FakeCtx(), 3, 1, retry=retry)
+    try:
+        dsp2.submit(0, boom(JobFailedError("retry budget burned")))
+        with pytest.raises(JobFailedError):
+            list(dsp2.drain())
+    finally:
+        dsp2.close()  # a poisoned window must still join cleanly
+    assert retried == [3], "terminal failures must not re-dispatch"
 
 
 # -- flight recorder forensics (obs.flightrec + tools.blackbox) --------------

@@ -600,3 +600,167 @@ def test_exchange_staged_fused_matches_flat(seed):
     out_flat, _ = run(0)
     assert any(e["window"] == 2 for e in staged_rounds)
     _assert_byte_identical_rows(out_staged, out_flat, f"seed={seed}")
+
+
+# -- async dispatch vs serial driver oracle sweep (exec/outofcore.py) --------
+#
+# dispatch_depth / chunk_fuse window the streaming driver's chunk
+# dispatches (and fuse K partial plans into one multi-root program) but
+# the DispatchWindow delivers outcomes strictly in submit order, so the
+# host accumulator — and therefore every float reduction order
+# downstream of it — must match the ``dispatch_depth=1`` serial loop
+# BIT-for-bit.  ``stream_pipeline_depth=1`` on both sides pins the
+# host-driver path (the device-resident pipeline is a different engine
+# with its own differential above).
+
+_ASYNC_SEEDS = (2, 9, 17)
+# (dispatch_depth, chunk_fuse): a deep unfused window, and a shallow
+# window whose admission is widened by cross-chunk fusion
+_ASYNC_WINDOWS = ((4, 1), (2, 3))
+
+
+def _async_chunks(rng, nchunks=5, n=700):
+    """Chunks with an exact int64 payload, a float32 payload whose sum
+    order the differential guards, and a modest key space so mid-stream
+    combines actually fire."""
+    return [
+        {
+            "k": rng.integers(0, 60, n).astype(np.int64),
+            "w": rng.integers(-(2 ** 52), 2 ** 52, n).astype(np.int64),
+            "v": rng.standard_normal(n).astype(np.float32),
+        }
+        for _ in range(nchunks)
+    ]
+
+
+def _async_pipeline(op, q):
+    if op == "group":  # _group_partial_async: accumulate + combine
+        return q.group_by(
+            "k", {"c": ("count", None), "ws": ("sum", "w"),
+                  "sv": ("sum", "v")}
+        )
+    if op == "sort":  # _sort_buckets: per-bucket sortdrain window
+        return q.where(_where_pos).order_by([("k", False), ("w", False)])
+    # scalar aggregate: the aggpartial window
+    return q.aggregate_as_query(
+        {"n": ("count", None), "ws": ("sum", "w"), "sv": ("sum", "v"),
+         "hi": ("max", "w")}
+    )
+
+
+def _run_stream_async(chunks, op, depth, fuse, nparts=8, **cfg_kw):
+    from dryad_tpu import DryadConfig
+
+    cfg_kw.setdefault("stream_combine_rows", 100)  # force mid-stream combines
+    cfg_kw.setdefault("stream_buckets", 8)
+    # size the bucket palette to the data: the default (1<<21 rows) pads
+    # every phase-2 sort bucket ~500x at these test sizes
+    cfg_kw.setdefault("stream_bucket_rows", 4096)
+    ctx = DryadContext(
+        num_partitions_=nparts,
+        config=DryadConfig(
+            stream_pipeline_depth=1, dispatch_depth=depth,
+            chunk_fuse=fuse, **cfg_kw,
+        ),
+    )
+    q = ctx.from_stream(
+        iter([{c: v.copy() for c, v in ch.items()} for ch in chunks])
+    )
+    out = _async_pipeline(op, q).collect()
+    return out, ctx
+
+
+def _assert_async_matches_serial(
+    chunks, op, depth, fuse, ctxmsg, nparts=8, **cfg_kw
+):
+    on, ctx_on = _run_stream_async(
+        chunks, op, depth, fuse, nparts=nparts, **cfg_kw
+    )
+    off, _ = _run_stream_async(chunks, op, 1, 1, nparts=nparts, **cfg_kw)
+    wins = [
+        e for e in ctx_on.executor.events.events()
+        if e["kind"] == "dispatch_window"
+    ]
+    assert wins and sum(e["dispatches"] for e in wins) >= 2, (
+        f"{ctxmsg}: dispatch window should have engaged"
+    )
+    _assert_byte_identical_rows(on, off, ctxmsg)
+    return ctx_on
+
+
+@pytest.mark.parametrize(
+    "window", _ASYNC_WINDOWS, ids=lambda w: f"depth{w[0]}-fuse{w[1]}"
+)
+@pytest.mark.parametrize("op", ("group", "sort", "agg"))
+def test_async_dispatch_matches_serial(op, window):
+    depth, fuse = window
+    rng = np.random.default_rng(2)
+    chunks = _async_chunks(rng)
+    _assert_async_matches_serial(
+        chunks, op, depth, fuse, f"op={op} depth={depth} fuse={fuse}"
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", _ASYNC_SEEDS)
+@pytest.mark.parametrize("op", ("group", "sort", "agg"))
+def test_async_dispatch_matches_serial_sweep(op, seed):
+    rng = np.random.default_rng(seed)
+    chunks = _async_chunks(rng, nchunks=6, n=1500)
+    _assert_async_matches_serial(
+        chunks, op, 3, 2, f"op={op} seed={seed}"
+    )
+
+
+def test_async_dispatch_overflow_retry_matches_serial():
+    """Near-distinct keys at slack=1.0 force bucket overflows INSIDE
+    windowed chunk dispatches: the executor's palette retry re-runs the
+    stage at a larger B while later chunks are already in flight, and
+    the committed stream must still match the serial driver exactly."""
+    rng = np.random.default_rng(7)
+    n, nchunks = 512, 4
+    ks = rng.permutation(n * nchunks).astype(np.int32)
+    chunks = [
+        {
+            "k": ks[i * n:(i + 1) * n],
+            "w": rng.integers(-(2 ** 40), 2 ** 40, n).astype(np.int64),
+            "v": rng.standard_normal(n).astype(np.float32),
+        }
+        for i in range(nchunks)
+    ]
+    ctx_on = _assert_async_matches_serial(
+        chunks, "group", 2, 2, "overflow-retry", shuffle_slack=1.0
+    )
+    assert any(
+        e["kind"] == "stage_overflow"
+        for e in ctx_on.executor.events.events()
+    ), "slack=1.0 sweep should exercise the overflow retry"
+
+
+def test_async_dispatch_staged_exchange_matches_serial():
+    """Async windows over staged exchanges: every windowed chunk
+    partial (and every mid-stream combine) routes its repartition
+    through the ppermute planner; commit order must keep results
+    byte-identical to the serial driver under the same window."""
+    rng = np.random.default_rng(11)
+    chunks = _async_chunks(rng)
+    ctx_on = _assert_async_matches_serial(
+        chunks, "group", 4, 1, "staged-exchange", exchange_window=2
+    )
+    rounds = [
+        e for e in ctx_on.executor.events.events()
+        if e["kind"] == "exchange_round"
+    ]
+    assert rounds and all(e["window"] == 2 for e in rounds)
+
+
+def test_async_dispatch_fused_matches_serial():
+    """Cross-chunk fusion under whole-DAG fusion: chunk_fuse lowers K
+    chunk partials as one multi-root program and plan_fuse folds each
+    chain into one region — the K results must stay byte-identical to
+    K serial dispatches."""
+    rng = np.random.default_rng(13)
+    chunks = _async_chunks(rng)
+    _assert_async_matches_serial(
+        chunks, "group", 2, 3, "chunk-fuse+plan-fuse", plan_fuse=True
+    )

@@ -566,6 +566,84 @@ def test_recompile_hazard_traced_bodies():
     assert _rules(ok, "recompile-hazard") == []
 
 
+# -- sync-in-dispatch-loop ---------------------------------------------------
+
+PIPE = "dryad_tpu/exec/pipeline.py"
+
+PIPE_CLEAN = '''\
+class DispatchWindow:
+    def submit(self, tag, fetch):
+        self.pending.append((tag, fetch))
+
+    def _collect(self):
+        tag, fetch = self.pending.pop(0)
+        value = fetch()
+        self.done.append((tag, value))
+
+    def drain(self):
+        return list(self.done)
+'''
+
+DISPATCH_HELPER = "dryad_tpu/exec/hostutil.py"
+
+DISPATCH_FIXTURE = {
+    PIPE: PIPE_CLEAN,
+    # np.asarray OUTSIDE a dispatch class is ordinary host-side code
+    DISPATCH_HELPER: '''\
+import numpy as np
+
+
+def to_host(x):
+    return np.asarray(x)
+''',
+}
+
+
+def test_sync_in_dispatch_loop_clean_fixture():
+    # fetch() at the collector is the sanctioned blocking point, and
+    # the helper module's np.asarray lives outside any dispatch class
+    assert _rules(DISPATCH_FIXTURE, "sync-in-dispatch-loop") == []
+
+
+@pytest.mark.parametrize(
+    "old,new",
+    [
+        # the literal re-serializer on the collector thread
+        ("value = fetch()",
+         "value = fetch()\n        value.block_until_ready()"),
+        # inline D2H inside the collect loop
+        ("value = fetch()", "value = jax.device_get(fetch())"),
+        # scalar readback while draining
+        ("return list(self.done)",
+         "return [v.item() for _t, v in self.done]"),
+        # the sneaky blocking copy on the submit path
+        ("self.pending.append((tag, fetch))",
+         "self.pending.append((tag, np.asarray(fetch)))"),
+    ],
+    ids=["block-until-ready", "device-get", "item", "np-asarray"],
+)
+def test_sync_in_dispatch_loop_fires(old, new):
+    _assert_fires(
+        _mutate(DISPATCH_FIXTURE, PIPE, old, new),
+        "sync-in-dispatch-loop", n=1,
+    )
+
+
+def test_sync_in_dispatch_loop_exempts_traced_asarray():
+    # jnp.asarray is a trace op: device-side, non-blocking, legal
+    ok = _mutate(DISPATCH_FIXTURE, PIPE, "value = fetch()",
+                 "value = jnp.asarray(fetch())")
+    assert _rules(ok, "sync-in-dispatch-loop") == []
+
+
+def test_sync_in_dispatch_loop_lost_anchor_is_a_finding():
+    # pipeline.py without a DispatchWindow class = structural drift
+    mutated = _mutate(
+        DISPATCH_FIXTURE, PIPE, "class DispatchWindow:", "class Window:"
+    )
+    _assert_fires(mutated, "sync-in-dispatch-loop", n=1)
+
+
 # -- determinism audit pin (exec/failure.py) ---------------------------------
 
 
