@@ -840,6 +840,193 @@ def aggtree_metric(n: int, chunk_rows: int = 1 << 14):
     )
 
 
+# Child body for serve_metric: closed-loop multi-tenant clients
+# multiplexed on ONE resident engine (serve/service.py).  Runs on 8
+# virtual CPU devices in a fresh subprocess like the aggtree matrix:
+# the parent's probed backend may pin a different device count, and
+# admission / fair-share / cache behavior is platform-free anyway.
+_SERVE_CHILD = r"""
+import json, os, sys, threading, time
+import numpy as np
+
+from dryad_tpu.parallel.mesh import force_cpu_backend
+
+force_cpu_backend(8)
+
+import jax
+
+try:  # persistent compile cache: reruns skip the plan-shape compiles
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get("DRYAD_BENCH_JAX_CACHE", "/tmp/dryad_jax_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+except Exception:
+    pass
+
+from dryad_tpu import DryadContext
+from dryad_tpu.serve import QueryRejected, QueryService
+
+n, per_client = int(sys.argv[1]), int(sys.argv[2])
+cells = [int(c) for c in sys.argv[3].split(",")]
+TENANTS = 4
+
+rng = np.random.default_rng(11)
+ctx = DryadContext(num_partitions_=8)
+
+plans = []
+for t in range(TENANTS):
+    words = np.asarray(
+        [f"t{t}w{i:04d}" for i in rng.integers(0, 1024, n)], object
+    )
+    tab = ctx.from_arrays({
+        "k": words,
+        "v": rng.integers(0, 1000, n).astype(np.int64),
+        "w": rng.random(n).astype(np.float32),
+    })
+    # mixed prepared shapes, all value-hashable params: repeated
+    # submissions share compile keys AND result-cache keys
+    plans.append([
+        tab.group_by("k", {"s": ("sum", "v")}),
+        tab.group_by("k", {"c": ("count", None), "m": ("mean", "w")}),
+        tab.distinct("k"),
+        tab.order_by("v").take(64),
+    ])
+
+for ps in plans:  # warm: pay every compile before the timed cells
+    for q in ps:
+        ctx.run_to_host(q)
+
+
+def run_cell(clients, cache_on):
+    ctx.config.serve_result_cache_bytes = (256 << 20) if cache_on else 0
+    svc = QueryService(ctx)
+    lat = [[] for _ in range(clients)]
+    fin = [0.0] * clients
+    errors = []
+
+    def client(i):
+        tenant = i % TENANTS
+        sess = svc.session(f"tenant{tenant}")
+        try:
+            for j in range(per_client):
+                q = plans[tenant][(i // TENANTS + j) % len(plans[tenant])]
+                t0 = time.perf_counter()
+                while True:
+                    try:
+                        sess.run(q, timeout=600)
+                        break
+                    except QueryRejected:
+                        time.sleep(0.002)  # closed loop: back off on quota
+                lat[i].append(time.perf_counter() - t0)
+            fin[i] = time.perf_counter()
+        except BaseException as e:
+            errors.append(repr(e))
+
+    t_start = time.perf_counter()
+    ths = [
+        threading.Thread(target=client, args=(i,)) for i in range(clients)
+    ]
+    for th in ths:
+        th.start()
+    for th in ths:
+        th.join()
+    elapsed = time.perf_counter() - t_start
+    stats = svc.stats()
+    svc.close()
+    if errors:
+        raise RuntimeError(errors[0])
+    all_lat = sorted(x for ls in lat for x in ls)
+    queries = clients * per_client
+    tput = []
+    per_tenant = {}
+    for t in range(TENANTS):
+        done = stats["tenants"][f"tenant{t}"]["completed"]
+        el = max(
+            fin[i] for i in range(clients) if i % TENANTS == t
+        ) - t_start
+        per_tenant[f"tenant{t}"] = {
+            "completed": done, "seconds": round(el, 3),
+        }
+        tput.append(done / max(el, 1e-9))
+    cache = stats["cache"]
+    looked = cache["hits"] + cache["misses"]
+    return {
+        "clients": clients,
+        "queries": queries,
+        "seconds": round(elapsed, 3),
+        "queries_per_sec": round(queries / elapsed, 1),
+        "rows_per_sec": round(queries * n / elapsed, 1),
+        "p50_ms": round(1e3 * all_lat[len(all_lat) // 2], 3),
+        "p99_ms": round(
+            1e3 * all_lat[min(len(all_lat) - 1, int(len(all_lat) * 0.99))],
+            3,
+        ),
+        "cache_hit_rate": (
+            round(cache["hits"] / looked, 4) if looked else 0.0
+        ),
+        "fairness_spread": round(max(tput) / max(min(tput), 1e-9), 3),
+        "rejected": sum(
+            s["rejected"] for s in stats["tenants"].values()
+        ),
+        "per_tenant": per_tenant,
+    }
+
+
+res = {"n": n, "per_client": per_client, "cells": []}
+for clients in cells:
+    res["cells"].append({"cache": "off", **run_cell(clients, False)})
+    res["cells"].append({"cache": "on", **run_cell(clients, True)})
+print(json.dumps(res))
+"""
+
+
+def serve_metric(n: int, per_client: int = 6, cells=(16, 64)):
+    """Serving tier (serve/service.py): 4 tenants x {16, 64} concurrent
+    closed-loop clients over one resident DryadContext, mixed prepared
+    plan shapes.  Each concurrency cell runs twice — result cache OFF
+    (every query really dispatches through the shared window: p50/p99
+    latency, rows/s, DRR fairness spread) and ON (hit rate and
+    cached-serving speedup).  Runs on 8 virtual CPU devices in a
+    subprocess; scheduling, admission, and cache behavior are
+    platform-free, rows/s is host-relative."""
+    import subprocess
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-c", _SERVE_CHILD,
+         str(n), str(per_client), ",".join(str(c) for c in cells)],
+        capture_output=True, text=True, timeout=max(remaining(), 120),
+        env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"serve child rc={out.returncode}: {out.stderr[-2000:]}"
+        )
+    res = json.loads(out.stdout.strip().splitlines()[-1])
+    # headline: the widest cache-off cell (every query dispatches)
+    wide = [c for c in res["cells"] if c["cache"] == "off"][-1]
+    cached = [c for c in res["cells"] if c["cache"] == "on"][-1]
+    extra = {
+        "cells": res["cells"], "tenants": 4, "devices": 8,
+        "clients": wide["clients"], "queries": wide["queries"],
+        "p50_ms": wide["p50_ms"], "p99_ms": wide["p99_ms"],
+        "queries_per_sec": wide["queries_per_sec"],
+        "fairness_spread": wide["fairness_spread"],
+        "cache_hit_rate": cached["cache_hit_rate"],
+        "cached_p50_ms": cached["p50_ms"],
+        "cached_speedup": round(
+            cached["queries_per_sec"]
+            / max(wide["queries_per_sec"], 1e-9), 3
+        ),
+    }
+    return rep_record(
+        "serve_rows_per_sec", wide["queries"] * res["n"],
+        [wide["seconds"]], extra,
+    )
+
+
 # Child body for ooc_exchange_metric: the staged exchange only does
 # anything on a multi-device mesh (P=1 short-circuits to the flat
 # path), so the window sweep runs on 8 virtual CPU devices in a fresh
@@ -1544,6 +1731,13 @@ def child_main() -> None:
         # subprocess; peak-byte accounting is platform-free)
         ("oocxchg_rows_per_sec",
          lambda: ooc_exchange_metric(1 << 18, chunk_rows=1 << 14),
+         300, False),
+        # serving tier: 4 tenants x {16,64} closed-loop clients
+        # multiplexed on one resident engine, cache off/on per cell
+        # (8 virtual CPU devices in a subprocess; admission,
+        # fair-share, and cache behavior are platform-free)
+        ("serve_rows_per_sec",
+         lambda: serve_metric(1 << 13),
          300, False),
     ]
     if platform in ("tpu", "axon"):

@@ -644,6 +644,80 @@ class DryadContext:
         self._binding_fp_cache[node.id] = fp
         return fp
 
+    # -- serving-tier surface ----------------------------------------------
+    def is_stream_query(self, query: Query) -> bool:
+        """True when the plan draws on a chunk-stream binding — such
+        plans route through the StreamExecutor and are not valid for
+        the async dispatch path (or the serving result cache)."""
+        from dryad_tpu.exec.outofcore import has_stream_input
+
+        return has_stream_input(self, query.node)
+
+    def query_fingerprint(self, query: Query):
+        """Stable identity of (plan structure, output position, ingest
+        content) — the serving tier's result-cache key, or None when
+        the query is uncacheable (local_debug, stream inputs, or any
+        device-resident binding whose content can't be fingerprinted
+        without a host transfer).
+
+        Plan structure comes from the executor's ``graph_key`` (the
+        compile-cache machinery), so the key inherits its reference
+        semantics: closure-bearing plans (select/where lambdas) match
+        only when re-run from the same Query object — prepared
+        statements — while value-hashable params match across rebuilt
+        queries.  The output is identified by its stage's POSITION in
+        the lowered graph (stage ids are fresh per lowering and would
+        defeat every repeat).  Ingest content is the per-binding SHA-1
+        fingerprint (``_binding_fp``) of every plan input, in plan
+        creation order."""
+        if self.local_debug or self.is_stream_query(query):
+            return None
+        graph = lower(
+            [query.node], self.config, self.dictionary,
+            P=num_partitions(self.mesh) if self.mesh is not None else None,
+        )
+        fps = []
+        for nid in sorted(graph.inputs):
+            fp = self._binding_fp(graph.inputs[nid])
+            if fp is None:
+                return None
+            fps.append(fp)
+        sid, oidx = graph.outputs[query.node.id]
+        pos = {s.id: i for i, s in enumerate(graph.stages)}[sid]
+        return (self.executor.graph_key(graph), (pos, oidx), tuple(fps))
+
+    def query_input_bytes(self, query: Query) -> int:
+        """Host bytes bound under the plan — the admission-control cost
+        of a query (device-resident and stream bindings count zero: no
+        host copy is admitted on their behalf)."""
+        total = 0
+        seen = set()
+        stack = [query.node]
+        while stack:
+            node = stack.pop()
+            if node.id in seen:
+                continue
+            seen.add(node.id)
+            stack.extend(node.inputs)
+            binding = self._bindings.get(node.id)
+            if binding is None:
+                continue
+            kind, *rest = binding
+            if kind == "host":
+                arrays, _cap = rest
+                total += sum(np.asarray(v).nbytes for v in arrays.values())
+            elif kind == "host_physical":
+                phys = rest[0]
+                total += sum(np.asarray(v).nbytes for v in phys.values())
+            elif kind == "store":
+                parts, _schema = rest
+                total += sum(
+                    np.asarray(v).nbytes
+                    for cols in parts
+                    for v in cols.values()
+                )
+        return total
+
     def _execute_device(self, query: Query, defer_miss: bool = False):
         graph = lower(
             [query.node], self.config, self.dictionary,

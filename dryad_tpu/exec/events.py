@@ -148,6 +148,12 @@ EVENT_KINDS: Dict[str, str] = {
     # -- multihost shared quarantine (obs.gang / cluster.scheduler) -------
     "quarantine_delta": "local failure deltas shipped to peer drivers",
     "quarantine_absorbed": "peer failure delta folded into local blacklist",
+    # -- serving tier (serve.service) -------------------------------------
+    "query_admitted": "tenant query passed admission; tenant/query/cost",
+    "query_rejected": "admission refused past quota; tenant/reason/limit",
+    "query_complete": "tenant query resolved; tenant/query/seconds/ok",
+    "result_cache_hit": "repeat query served from the result cache",
+    "tenant_quota": "tenant quota state transition; saturated or ok",
 }
 
 # ``kind`` -> (required payload keys, optional payload keys).  The
@@ -301,6 +307,17 @@ EVENT_PAYLOADS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
         ("evidence", "hint", "rule", "severity"), ("name", "stage"),
     ),
     "events_dropped": (("dropped",), ()),
+    "query_admitted": (("cost_bytes", "query", "tenant"), ("queued",)),
+    "query_rejected": (
+        ("current", "limit", "query", "reason", "tenant"), (),
+    ),
+    "query_complete": (
+        ("ok", "query", "seconds", "tenant"), ("cached", "error"),
+    ),
+    "result_cache_hit": (("query", "tenant"), ("rows",)),
+    "tenant_quota": (
+        ("inflight", "limit", "state", "tenant"), ("bytes",),
+    ),
 }
 
 

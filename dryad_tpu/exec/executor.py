@@ -345,6 +345,18 @@ class GraphExecutor:
             parts.append((op.kind, tuple(items)))
         return (tuple(parts), tuple(stage.out_slots))
 
+    def graph_key(self, graph) -> Tuple:
+        """Public structural identity of a LOWERED stage graph — one
+        ``_stage_key`` per stage, in graph order.  The serving tier's
+        result-cache keying surface: built on the exact machinery the
+        compile cache uses, so two lowerings share a graph key iff
+        their stages would share compiled programs.  fn-valued params
+        key BY REFERENCE (see ``_stage_key``), so closure-bearing plans
+        match only when re-run from the same Query object — prepared-
+        statement semantics — while value-hashable params (group_by
+        agg tuples, take counts, ...) match across rebuilt queries."""
+        return tuple(self._stage_key(s) for s in graph.stages)
+
     def _stage_rep(self, stage: Stage) -> Tuple:
         """Call-time replicated operand arrays for a dispatch of
         ``stage`` — the flattened device buffers of every OPERAND

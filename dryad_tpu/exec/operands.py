@@ -28,6 +28,7 @@ leading axis = scatter axis), ``operand_sha()`` (content digest), and
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
@@ -61,6 +62,11 @@ class DeviceOperandPool:
         self.full_uploads = 0
         self.delta_scatters = 0
         self.hits = 0
+        # The serving tier multiplexes many tenants' dispatches over
+        # ONE executor, and the DispatchWindow collector may fetch
+        # while the driver dispatches — get() must be safe under that
+        # concurrency (tier residency + counters mutate together).
+        self._lock = threading.Lock()
 
     # -- accounting --------------------------------------------------------
     def _account(self, nbytes: int) -> None:
@@ -83,7 +89,10 @@ class DeviceOperandPool:
 
     # -- the one entry point -----------------------------------------------
     def get(self, obj) -> Tuple:
-        """Device arrays for ``obj`` (uploading/scattering as needed)."""
+        """Device arrays for ``obj`` (uploading/scattering as needed).
+        Thread-safe: concurrent sessions racing one tier serialize on
+        the pool lock, so residency can never interleave into a state
+        where the stored sha and device buffers disagree."""
         sha = obj.operand_sha()
         host = tuple(
             np.ascontiguousarray(a) for a in obj.operand_arrays()
@@ -95,21 +104,22 @@ class DeviceOperandPool:
         tier = (type(obj).__name__,) + tuple(
             (a.shape, str(a.dtype)) for a in host
         )
-        cur = self._tiers.get(tier)
-        if cur is not None and cur[0] == sha:
-            self.hits += 1
-            return cur[2]
-        dev: Optional[Tuple] = None
-        if cur is not None:
-            dev = self._scatter_delta(cur[1], cur[2], host)
-        if dev is None:
-            dev = tuple(self._put(a) for a in host)
-            self._account(sum(a.nbytes for a in host))
-            self.full_uploads += 1
-        else:
-            self.delta_scatters += 1
-        self._tiers[tier] = (sha, host, dev)
-        return dev
+        with self._lock:
+            cur = self._tiers.get(tier)
+            if cur is not None and cur[0] == sha:
+                self.hits += 1
+                return cur[2]
+            dev: Optional[Tuple] = None
+            if cur is not None:
+                dev = self._scatter_delta(cur[1], cur[2], host)
+            if dev is None:
+                dev = tuple(self._put(a) for a in host)
+                self._account(sum(a.nbytes for a in host))
+                self.full_uploads += 1
+            else:
+                self.delta_scatters += 1
+            self._tiers[tier] = (sha, host, dev)
+            return dev
 
     def _scatter_delta(self, prev_host, prev_dev, host) -> Optional[Tuple]:
         """Update resident buffers row-wise to the new content; None

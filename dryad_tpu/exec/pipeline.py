@@ -370,6 +370,25 @@ class DispatchWindow:
         """Record one drain-time chunk retry (re-entered the window)."""
         self.retries += 1
 
+    def wait(self, timeout: float = 0.1) -> bool:
+        """Block until at least one outcome is ready for :meth:`ready`
+        (True), or the window is idle/closed or ``timeout`` elapses
+        (False).  The serving driver's sleep primitive: with nothing
+        runnable in its tenant queues, the multiplexed loop parks here
+        instead of spinning on ``ready()``."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while not self._done:
+                if self._closed or (
+                    not self._pending and self._outstanding == 0
+                ):
+                    return False
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cv.wait(min(remaining, 0.1))
+            return True
+
     def ready(self):
         """Yield completed ``(tag, value, error)`` triples in submit
         order WITHOUT blocking — the driver's between-dispatches

@@ -30,6 +30,9 @@ Rules (``rule`` field of the emitted event):
   oscillates between host and device modes.
 - ``overflow_loop`` — one stage overflows its shuffle capacity
   repeatedly, walking the bounded palette instead of fitting.
+- ``quota_pressure`` — one tenant's admissions are rejected
+  repeatedly inside the sliding window (the serving tier is shedding
+  that tenant's load, not absorbing a one-off burst).
 
 Each (rule, subject) pair re-announces at most once per
 ``diagnose_cooldown_s`` — a persistent pathology must not flood the
@@ -100,6 +103,12 @@ RULES: Dict[str, Tuple[str, str]] = {
         "repeated shuffle overflow on one stage — raise shuffle_slack "
         "or fix the skew the partition_skew rule is pointing at",
     ),
+    "quota_pressure": (
+        "warn",
+        "one tenant keeps hitting admission rejection — raise its "
+        "serve_max_inflight/serve_max_bytes quota or DRR weight, or "
+        "shed load client-side with backoff on QueryRejected",
+    ),
 }
 
 _WINDOW_S = 60.0  # sliding window for rate-based rules
@@ -152,6 +161,8 @@ class DiagnosisEngine:
         self._mode_flips = 0
         # overflow_loop: stage name -> count
         self._overflows: Dict[str, int] = {}
+        # quota_pressure: tenant -> deque[mono] of rejections
+        self._rejections: Dict[str, deque] = {}
 
     # -- public fold surface -------------------------------------------------
 
@@ -278,6 +289,8 @@ class DiagnosisEngine:
             self._fold_mode(ev)
         elif kind == "stage_overflow":
             self._fold_overflow(ev)
+        elif kind == "query_rejected":
+            self._fold_rejection(ev)
 
     def _fold_compile(self, ev: Dict[str, Any]) -> None:
         stage = str(ev.get("stage", "?"))
@@ -425,6 +438,27 @@ class DiagnosisEngine:
                 evidence={"overflows": n, "boost": ev.get("boost")},
                 stage=ev.get("stage"),
                 name=name,
+            )
+
+
+    def _fold_rejection(self, ev: Dict[str, Any]) -> None:
+        tenant = str(ev.get("tenant", "?"))
+        now = time.monotonic()
+        dq = self._rejections.setdefault(tenant, deque(maxlen=128))
+        dq.append(now)
+        while dq and now - dq[0] > _WINDOW_S:
+            dq.popleft()
+        if len(dq) >= 3:
+            self._diagnose(
+                "quota_pressure",
+                tenant,
+                evidence={
+                    "tenant": tenant,
+                    "rejections": len(dq),
+                    "window_s": _WINDOW_S,
+                    "reason": ev.get("reason"),
+                    "limit": ev.get("limit"),
+                },
             )
 
 

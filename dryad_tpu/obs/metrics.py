@@ -324,6 +324,17 @@ class JobMetrics:
     command_batches: int = 0
     batched_commands: int = 0
     round_trips_saved: int = 0
+    # serving tier (serve.service): service-level admission/cache
+    # totals plus per-tenant attribution — tenant -> counter dict
+    # (admitted/completed/rejected/cache_hits/failed/seconds plus the
+    # latest quota_state), the fold the jobview tenant panel renders
+    queries_admitted: int = 0
+    queries_completed: int = 0
+    queries_rejected: int = 0
+    result_cache_hits: int = 0
+    tenants: Dict[str, Dict[str, Any]] = dataclasses.field(
+        default_factory=dict
+    )
 
     @property
     def driver_cpu_fraction(self) -> float:
@@ -376,7 +387,23 @@ class JobMetrics:
             "dispatch_retries": self.dispatch_retries,
             "command_batches": self.command_batches,
             "round_trips_saved": self.round_trips_saved,
+            "queries_admitted": self.queries_admitted,
+            "queries_completed": self.queries_completed,
+            "queries_rejected": self.queries_rejected,
+            "result_cache_hits": self.result_cache_hits,
         }
+
+    def _tenant(self, ev: Dict[str, Any]) -> Dict[str, Any]:
+        """The per-tenant counter record for an event's tenant label,
+        created on first contact."""
+        t = self.tenants.get(ev.get("tenant", "?"))
+        if t is None:
+            t = self.tenants[ev.get("tenant", "?")] = {
+                "admitted": 0, "completed": 0, "rejected": 0,
+                "cache_hits": 0, "failed": 0, "seconds": 0.0,
+                "quota_state": "ok",
+            }
+        return t
 
     # counter names folded from ``metrics`` snapshot events into the
     # scalar fields above
@@ -475,6 +502,25 @@ class JobMetrics:
                 m.round_trips_saved += int(
                     ev.get("round_trips_saved", 0) or 0
                 )
+            elif kind == "query_admitted":
+                m.queries_admitted += 1
+                m._tenant(ev)["admitted"] += 1
+            elif kind == "query_rejected":
+                m.queries_rejected += 1
+                m._tenant(ev)["rejected"] += 1
+            elif kind == "query_complete":
+                m.queries_completed += 1
+                t = m._tenant(ev)
+                t["completed"] += 1
+                t["seconds"] += float(ev.get("seconds", 0.0) or 0.0)
+                if not ev.get("ok", True):
+                    t["failed"] += 1
+            elif kind == "result_cache_hit":
+                m.result_cache_hits += 1
+                m._tenant(ev)["cache_hits"] += 1
+            elif kind == "tenant_quota":
+                # state TRANSITIONS, so the last one is the live state
+                m._tenant(ev)["quota_state"] = ev.get("state", "ok")
             elif kind == "combine_tree_degrade":
                 m.degraded_ranges = max(
                     m.degraded_ranges, int(ev.get("degraded", 0) or 0)
@@ -572,6 +618,16 @@ def format_attribution(m: JobMetrics) -> List[str]:
             f"cmd_batch: {m.batched_commands} cmds in "
             f"{m.command_batches} batches "
             f"(saved {m.round_trips_saved} round trips)"
+        )
+    if m.queries_admitted or m.queries_rejected:
+        hit_rate = (
+            m.result_cache_hits / m.queries_completed
+            if m.queries_completed else 0.0
+        )
+        parts.append(
+            f"serve: {m.queries_completed}/{m.queries_admitted} queries "
+            f"over {len(m.tenants)} tenant(s) "
+            f"cache_hit={hit_rate:.0%} rejected={m.queries_rejected}"
         )
     if m.workers:
         parts.append(f"worker_telemetry={m.workers} workers")

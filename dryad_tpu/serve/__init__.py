@@ -1,0 +1,31 @@
+"""Serving tier — multiplex concurrent tenant queries on one resident
+engine.
+
+Everything below ``serve/`` is one-driver-one-job; this package is the
+long-lived front end that turns the engine into a service.  A
+:class:`QueryService` owns ONE driver thread (the executor is
+driver-owned and not thread-safe), admits queries from many logical
+tenants under per-tenant quotas, schedules them fair-share
+(weighted deficit round robin) onto a single shared
+:class:`~dryad_tpu.exec.pipeline.DispatchWindow`, and serves repeat
+queries from a plan-fingerprint result cache.  Client threads only
+build plans, submit, and block on :class:`QueryFuture` — they never
+touch devices.
+
+Layering: ``serve/`` reaches devices exclusively through the ``api``
+and ``exec`` public entry points; engine layers never import
+``serve/`` (enforced by graftlint's ``serve-layering`` rule).
+"""
+
+from dryad_tpu.serve.admission import QueryRejected, TenantQuota
+from dryad_tpu.serve.cache import ResultCache
+from dryad_tpu.serve.service import QueryFuture, QueryService, TenantSession
+
+__all__ = [
+    "QueryFuture",
+    "QueryRejected",
+    "QueryService",
+    "ResultCache",
+    "TenantSession",
+    "TenantQuota",
+]

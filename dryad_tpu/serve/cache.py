@@ -1,0 +1,105 @@
+"""Plan-fingerprint result cache — repeat queries without re-dispatch.
+
+Keys are ``(tenant, DryadContext.query_fingerprint(query))``: plan
+structure via the executor's ``graph_key`` (the compile-cache
+machinery), output position, and the content SHA-1 of every ingest
+binding.  A query whose fingerprint is None (local_debug, stream
+inputs, device-resident bindings) is simply uncacheable.
+
+Invalidation is EPOCH-based: every entry records the tenant's ingest
+epoch at insert, and a lookup whose epoch has moved on misses (stale
+entries are dropped on contact, so a bumped epoch also reclaims their
+bytes).  ``TenantSession.bump_epoch`` — called by the session ingest
+helpers — is therefore the ONLY invalidation signal; no cross-thread
+cache surgery.  Content changes need no epoch at all: a new binding
+fingerprints differently and misses cleanly (likewise a vocabulary
+widening that moves the plan to a new operand tier changes the graph
+key — a recompute, never a stale hit).
+
+Eviction is LRU by byte budget.  Hits hand back per-client array
+copies so a caller mutating its result cannot poison the cached
+master.  NOT thread-safe on its own: the service driver thread is the
+only caller (lookups, inserts, and eviction all happen between
+dispatches).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+
+def table_nbytes(table: Dict[str, np.ndarray]) -> int:
+    """Budget accounting for one host result table.  Object (string)
+    columns count pointer width only — an approximation, but a stable
+    one, and string-heavy results still evict in insertion order."""
+    return sum(np.asarray(v).nbytes for v in table.values())
+
+
+def _copy_table(table: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    return {k: np.asarray(v).copy() for k, v in table.items()}
+
+
+class ResultCache:
+    """LRU-by-bytes map: (tenant, fingerprint) -> host result table."""
+
+    def __init__(self, budget_bytes: int):
+        self.budget = int(budget_bytes)
+        # key -> (master table, nbytes, tenant epoch at insert)
+        self._entries: "OrderedDict[Tuple, Tuple[Dict, int, int]]" = (
+            OrderedDict()
+        )
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.bytes = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def get(self, key, epoch: int) -> Optional[Dict[str, np.ndarray]]:
+        """The cached table (a fresh copy) when ``key`` is live at
+        ``epoch``; None otherwise.  A stale-epoch entry is dropped on
+        contact — the bump already invalidated it, this reclaims it."""
+        if self.budget <= 0 or key is None:
+            return None
+        ent = self._entries.get(key)
+        if ent is not None and ent[2] != epoch:
+            self._drop(key)
+            ent = None
+        if ent is None:
+            self.misses += 1
+            return None
+        self._entries.move_to_end(key)
+        self.hits += 1
+        return _copy_table(ent[0])
+
+    def put(self, key, table: Dict[str, np.ndarray], epoch: int) -> None:
+        if self.budget <= 0 or key is None:
+            return
+        nbytes = table_nbytes(table)
+        if nbytes > self.budget:
+            return  # would evict everything and still not fit
+        if key in self._entries:
+            self._drop(key)
+        self._entries[key] = (_copy_table(table), nbytes, epoch)
+        self.bytes += nbytes
+        while self.bytes > self.budget:
+            _, (_t, nb, _e) = self._entries.popitem(last=False)
+            self.bytes -= nb
+            self.evictions += 1
+
+    def _drop(self, key) -> None:
+        _t, nb, _e = self._entries.pop(key)
+        self.bytes -= nb
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "entries": len(self._entries),
+            "bytes": self.bytes,
+            "hits": self.hits,
+            "misses": self.misses,
+            "evictions": self.evictions,
+        }

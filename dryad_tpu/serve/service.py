@@ -1,0 +1,522 @@
+"""QueryService — one resident engine, many concurrent tenants.
+
+The reference Dryad's GraphManager multiplexed vertices from many
+stages onto one shared cluster; this is the same move one level up:
+many tenants' PLANS multiplexed onto one resident
+:class:`~dryad_tpu.api.context.DryadContext` (mesh, gang, compile
+cache, operand pool all shared).
+
+Threading model — the executor is driver-owned and NOT thread-safe, so
+the service owns exactly ONE driver thread and everything device-
+facing happens there:
+
+- client threads build plans, pass admission (quota check + enqueue,
+  under the service lock), and block on :class:`QueryFuture`;
+- the driver thread picks the next query fair-share (weighted deficit
+  round robin over the tenant ring), computes its result-cache
+  fingerprint, and either resolves it from the cache (zero dispatches)
+  or dispatches it through the ONE shared
+  :class:`~dryad_tpu.exec.pipeline.DispatchWindow` — whose collector
+  drains fetches strictly in submit order, so interleaved tenants
+  still commit deterministically and results stay byte-identical to
+  serial one-at-a-time execution;
+- session ingest (which mutates the shared StringDictionary and
+  binding table) serializes against driver-side lowering on
+  ``_ctx_lock``, never held while blocked on the window.
+
+Fair share is classic weighted deficit round robin: each visit to a
+tenant with queued work earns ``weight`` quantum units, a query costs
+``1 + input_bytes // config.serve_drr_quantum_bytes`` units, and an
+idle tenant forfeits its credit — so a heavy tenant cannot starve a
+light one, and a returning tenant cannot burst on banked idle time.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Optional, Tuple
+
+from dryad_tpu.exec.pipeline import DispatchWindow
+from dryad_tpu.serve.admission import QueryRejected, TenantQuota
+from dryad_tpu.serve.cache import ResultCache
+from dryad_tpu.utils.logging import get_logger
+
+log = get_logger("dryad_tpu.serve")
+
+
+class QueryFuture:
+    """Resolution handle for one admitted query.  ``result()`` blocks
+    until the driver resolves it — with the host table, the execution
+    error, or a :class:`QueryRejected` if the service closed first."""
+
+    def __init__(self, tenant: str, qid: str):
+        self.tenant = tenant
+        self.qid = qid
+        self.cached = False  # set at resolve: served from the result cache
+        self._ev = threading.Event()
+        self._result: Optional[Dict] = None
+        self._error: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> Dict:
+        if not self._ev.wait(timeout):
+            raise TimeoutError(
+                f"query {self.qid} unresolved after {timeout}s"
+            )
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    def _resolve(self, result=None, error=None) -> None:
+        self._result = result
+        self._error = error
+        self._ev.set()
+
+
+class _Queued:
+    """One admitted query riding the tenant queue."""
+
+    __slots__ = (
+        "state", "qid", "query", "future", "cost_bytes", "cost_units",
+        "epoch", "t_submit",
+    )
+
+    def __init__(self, state, qid, query, future, cost_bytes, cost_units,
+                 epoch, t_submit):
+        self.state = state
+        self.qid = qid
+        self.query = query
+        self.future = future
+        self.cost_bytes = cost_bytes
+        self.cost_units = cost_units
+        self.epoch = epoch  # tenant ingest epoch at ADMISSION
+        self.t_submit = t_submit
+
+
+class _TenantState:
+    """Service-internal per-tenant record (queues, quota, counters).
+    All mutation under the service lock."""
+
+    def __init__(self, name: str, weight: int, quota: TenantQuota):
+        self.name = name
+        self.weight = weight
+        self.quota = quota
+        self.queue: "deque[_Queued]" = deque()
+        self.deficit = 0
+        self.visited = False  # earned this visit's refill already
+        self.epoch = 0  # ingest epoch: result-cache invalidation signal
+        self.saturated = False
+        self.inflight = 0  # admitted and not yet resolved
+        self.inflight_bytes = 0
+        self.seq = 0
+        self.admitted = 0
+        self.completed = 0
+        self.rejected = 0
+        self.cache_hits = 0
+        self.failed = 0
+
+
+class TenantSession:
+    """A tenant's handle on the service: submit plans, ingest data,
+    bump the ingest epoch.  Cheap — open one per logical client."""
+
+    def __init__(self, service: "QueryService", state: _TenantState):
+        self._service = service
+        self._state = state
+
+    @property
+    def name(self) -> str:
+        return self._state.name
+
+    @property
+    def epoch(self) -> int:
+        return self._state.epoch
+
+    def submit(self, query) -> QueryFuture:
+        """Admit ``query`` (raises :class:`QueryRejected` past quota)
+        and return its future.  Never blocks on device work."""
+        return self._service._submit(self._state, query)
+
+    def run(self, query, timeout: Optional[float] = None) -> Dict:
+        """Submit and block for the result."""
+        return self.submit(query).result(timeout)
+
+    def ingest(self, arrays, **kw):
+        """Bind a host table through the shared context and bump the
+        ingest epoch (invalidates this tenant's cached results)."""
+        svc = self._service
+        with svc._ctx_lock:
+            q = svc.ctx.from_arrays(arrays, **kw)
+        self.bump_epoch()
+        return q
+
+    def bump_epoch(self) -> None:
+        """Advance the ingest epoch: every cached result this tenant
+        inserted before now is invalid (epoch-mismatch miss)."""
+        with self._service._lock:
+            self._state.epoch += 1
+
+
+class QueryService:
+    """Long-lived multiplexing front end over one DryadContext."""
+
+    def __init__(self, ctx, start: bool = True):
+        self.ctx = ctx
+        self.config = ctx.config
+        self.events = ctx.events
+        self._cache = ResultCache(self.config.serve_result_cache_bytes)
+        self._window = DispatchWindow(
+            depth=self.config.dispatch_depth, events=self.events,
+            name="serve",
+        )
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        # ingest (client threads) vs lowering/dispatch (driver thread)
+        # both touch the shared dictionary and binding table; RLock so
+        # the driver's fingerprint+dispatch pair stays one critical
+        # section.  NEVER held while blocked on the window.
+        self._ctx_lock = threading.RLock()
+        self._tenants: Dict[str, _TenantState] = {}
+        self._rr = 0  # deficit-round-robin ring pointer
+        self._queued = 0  # total across tenant queues
+        self._inflight_items: Dict[str, Tuple[_Queued, Any]] = {}
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            self.start()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "QueryService":
+        """Spawn the driver thread (idempotent).  A service built with
+        ``start=False`` queues admissions until started — the fairness
+        tests preload competing tenants this way."""
+        with self._lock:
+            if self._thread is not None or self._closed:
+                return self
+            self._thread = threading.Thread(
+                target=self._drive, name="dryad-serve", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def close(self, timeout: float = 60.0) -> None:
+        """Stop admitting, drain everything already admitted, join the
+        driver, close the window.  Safe to call repeatedly."""
+        with self._lock:
+            already = self._closed
+            self._closed = True
+            self._work.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        elif not already:
+            # never started: unblock queued clients with a structured
+            # rejection instead of letting them wait forever
+            self._cancel_queued()
+        self._window.close()
+
+    def __enter__(self) -> "QueryService":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- tenants -----------------------------------------------------------
+
+    def session(self, tenant: str, weight: int = 1,
+                quota: Optional[TenantQuota] = None) -> TenantSession:
+        """Open (or re-open) a tenant session.  ``weight`` is the DRR
+        share; ``quota`` defaults to the config budgets."""
+        if weight < 1:
+            raise ValueError("tenant weight must be >= 1")
+        with self._lock:
+            st = self._tenants.get(tenant)
+            if st is None:
+                st = _TenantState(
+                    tenant, weight,
+                    quota or TenantQuota(
+                        max_inflight=self.config.serve_max_inflight,
+                        max_bytes=self.config.serve_max_bytes,
+                    ),
+                )
+                self._tenants[tenant] = st
+            else:
+                st.weight = weight
+                if quota is not None:
+                    st.quota = quota
+        return TenantSession(self, st)
+
+    # -- admission (client threads) ----------------------------------------
+
+    def _submit(self, st: _TenantState, query) -> QueryFuture:
+        with self._ctx_lock:
+            cost = self.ctx.query_input_bytes(query)
+        rejection = None
+        quota_event = None
+        with self._lock:
+            if self._closed:
+                rejection = QueryRejected(st.name, "closed", 0, 0)
+                st.rejected += 1
+                rej_id = f"{st.name}:rej{st.rejected}"
+            else:
+                try:
+                    st.quota.check(
+                        st.name, st.inflight, st.inflight_bytes, cost
+                    )
+                except QueryRejected as e:
+                    rejection = e
+                    st.rejected += 1
+                    rej_id = f"{st.name}:rej{st.rejected}"
+            if rejection is None:
+                qid = f"{st.name}:{st.seq}"
+                st.seq += 1
+                item = _Queued(
+                    st, qid, query, QueryFuture(st.name, qid), cost,
+                    1 + cost // self.config.serve_drr_quantum_bytes,
+                    st.epoch, time.monotonic(),
+                )
+                st.inflight += 1
+                st.inflight_bytes += cost
+                st.admitted += 1
+                st.queue.append(item)
+                self._queued += 1
+                queued = len(st.queue)
+                if (not st.saturated
+                        and st.inflight >= st.quota.max_inflight):
+                    st.saturated = True
+                    quota_event = dict(
+                        tenant=st.name, state="saturated",
+                        inflight=st.inflight,
+                        limit=st.quota.max_inflight,
+                        bytes=st.inflight_bytes,
+                    )
+                self._work.notify_all()
+        if rejection is not None:
+            self.events.emit(
+                "query_rejected", tenant=st.name, query=rej_id,
+                reason=rejection.reason, limit=rejection.limit,
+                current=rejection.current,
+            )
+            raise rejection
+        self.events.emit(
+            "query_admitted", tenant=st.name, query=qid,
+            cost_bytes=cost, queued=queued,
+        )
+        if quota_event is not None:
+            self.events.emit(
+                "tenant_quota", tenant=quota_event["tenant"],
+                state=quota_event["state"],
+                inflight=quota_event["inflight"],
+                limit=quota_event["limit"], bytes=quota_event["bytes"],
+            )
+        return item.future
+
+    # -- fair-share scheduling (driver thread) -----------------------------
+
+    def _pick_locked(self) -> Optional[_Queued]:
+        """Weighted deficit round robin over the tenant ring.  None
+        when nothing is runnable (all queues empty, or the window is
+        at depth — dispatching more would block the driver)."""
+        if len(self._inflight_items) >= self._window.depth:
+            return None
+        ring = list(self._tenants.values())
+        if not ring or not any(st.queue for st in ring):
+            return None
+        while True:
+            st = ring[self._rr % len(ring)]
+            if not st.queue:
+                # idle tenants forfeit credit: no bursting on banked
+                # idle time when they return
+                st.deficit = 0
+                st.visited = False
+                self._rr += 1
+                continue
+            if not st.visited:
+                st.deficit += st.weight
+                st.visited = True
+            head = st.queue[0]
+            if st.deficit >= head.cost_units:
+                st.deficit -= head.cost_units
+                st.queue.popleft()
+                self._queued -= 1
+                if not st.queue:
+                    st.visited = False
+                return head
+            # deficit exhausted: next tenant (credit carries over, so
+            # an expensive head eventually accumulates its cost)
+            st.visited = False
+            self._rr += 1
+
+    # -- driver loop -------------------------------------------------------
+
+    def _drive(self) -> None:
+        try:
+            while True:
+                with self._lock:
+                    item = self._pick_locked()
+                    if (item is None and self._closed
+                            and self._queued == 0
+                            and not self._inflight_items):
+                        break
+                if item is not None:
+                    self._dispatch(item)
+                for out in self._window.ready():
+                    self._commit(out)
+                if item is None:
+                    # park: wakes immediately on a window outcome, and
+                    # within one short tick of a new submission (two
+                    # wait targets, one thread — bounded poll)
+                    if not self._window.wait(0.02):
+                        with self._work:
+                            if self._queued == 0 and not self._closed:
+                                self._work.wait(0.02)
+        except BaseException as e:  # noqa: BLE001 - fail every future
+            log.exception("serve driver died: %r", e)
+            self._abort(e)
+
+    def _dispatch(self, item: _Queued) -> None:
+        """Resolve ``item`` from the cache, or dispatch it.  Any
+        lowering/compile error resolves the future — the loop never
+        dies on one tenant's bad plan."""
+        st = item.state
+        key = None
+        try:
+            with self._ctx_lock:
+                if self.ctx.is_stream_query(item.query):
+                    # stream plans route through the StreamExecutor —
+                    # no async fetch to window; run inline (rare on a
+                    # serving path, still correct)
+                    table = self.ctx.run_to_host(item.query)
+                    self._finish(item, table=table)
+                    return
+                if self._cache.budget > 0:
+                    fp = self.ctx.query_fingerprint(item.query)
+                    if fp is not None:
+                        key = (st.name, fp)
+                        table = self._cache.get(key, item.epoch)
+                        if table is not None:
+                            rows = (
+                                len(next(iter(table.values())))
+                                if table else 0
+                            )
+                            self.events.emit(
+                                "result_cache_hit", tenant=st.name,
+                                query=item.qid, rows=rows,
+                            )
+                            self._finish(item, table=table, cached=True)
+                            return
+                fetch = self.ctx.run_to_host_async(item.query)
+        except Exception as e:
+            self._finish(item, error=e)
+            return
+        with self._lock:
+            self._inflight_items[item.qid] = (item, key)
+        self._window.submit(item.qid, fetch)
+
+    def _commit(self, out) -> None:
+        tag, value, error = out
+        with self._lock:
+            item, key = self._inflight_items.pop(tag)
+        if error is None and key is not None:
+            self._cache.put(key, value, item.epoch)
+        if isinstance(error, BaseException) and not isinstance(
+            error, Exception
+        ):
+            raise error  # KeyboardInterrupt etc: don't swallow
+        self._finish(item, table=value, error=error)
+
+    def _finish(self, item: _Queued, table=None, cached: bool = False,
+                error: Optional[BaseException] = None) -> None:
+        st = item.state
+        ok = error is None
+        quota_event = None
+        with self._lock:
+            st.inflight -= 1
+            st.inflight_bytes -= item.cost_bytes
+            st.completed += 1
+            if cached:
+                st.cache_hits += 1
+            if not ok:
+                st.failed += 1
+            if st.saturated and st.inflight < st.quota.max_inflight:
+                st.saturated = False
+                quota_event = dict(
+                    tenant=st.name, inflight=st.inflight,
+                    limit=st.quota.max_inflight, bytes=st.inflight_bytes,
+                )
+        seconds = round(time.monotonic() - item.t_submit, 6)
+        if ok:
+            self.events.emit(
+                "query_complete", tenant=st.name, query=item.qid,
+                ok=True, seconds=seconds, cached=cached,
+            )
+        else:
+            self.events.emit(
+                "query_complete", tenant=st.name, query=item.qid,
+                ok=False, seconds=seconds, cached=False,
+                error=repr(error),
+            )
+        if quota_event is not None:
+            self.events.emit(
+                "tenant_quota", tenant=quota_event["tenant"], state="ok",
+                inflight=quota_event["inflight"],
+                limit=quota_event["limit"], bytes=quota_event["bytes"],
+            )
+        item.future.cached = cached
+        item.future._resolve(result=table, error=error)
+
+    # -- failure teardown --------------------------------------------------
+
+    def _cancel_queued(self) -> None:
+        with self._lock:
+            items = []
+            for st in self._tenants.values():
+                items.extend(st.queue)
+                st.queue.clear()
+            self._queued = 0
+            for it in items:
+                it.state.inflight -= 1
+                it.state.inflight_bytes -= it.cost_bytes
+        for it in items:
+            it.future._resolve(
+                error=QueryRejected(it.state.name, "closed", 0, 0)
+            )
+
+    def _abort(self, exc: BaseException) -> None:
+        """Driver-death last resort: every unresolved future gets the
+        error instead of a hang."""
+        self._cancel_queued()
+        with self._lock:
+            inflight = list(self._inflight_items.values())
+            self._inflight_items.clear()
+        for item, _key in inflight:
+            item.future._resolve(error=exc)
+
+    # -- observability -----------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        """Point-in-time counters for benchmarks and panels."""
+        with self._lock:
+            tenants = {
+                st.name: {
+                    "admitted": st.admitted,
+                    "completed": st.completed,
+                    "rejected": st.rejected,
+                    "cache_hits": st.cache_hits,
+                    "failed": st.failed,
+                    "in_flight": st.inflight,
+                    "queued": len(st.queue),
+                    "epoch": st.epoch,
+                    "saturated": st.saturated,
+                }
+                for st in self._tenants.values()
+            }
+        return {
+            "tenants": tenants,
+            "cache": self._cache.stats(),
+            "dispatches": self._window.dispatches,
+        }

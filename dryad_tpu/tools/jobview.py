@@ -1114,6 +1114,32 @@ def render_health(events: List[Dict[str, Any]]) -> str:
     return "\n".join(lines)
 
 
+def render_tenants(events: List[Dict[str, Any]]) -> str:
+    """Serving-tier panel: one line per tenant (queries in flight,
+    cache hits, quota state) folded from the ``query_*`` /
+    ``result_cache_hit`` / ``tenant_quota`` events the QueryService
+    emits.  Empty for non-serving streams."""
+    from dryad_tpu.obs.metrics import JobMetrics
+
+    m = JobMetrics.from_events(events)
+    if not m.tenants:
+        return ""
+    lines = ["-- tenants --"]
+    for name in sorted(m.tenants):
+        t = m.tenants[name]
+        in_flight = t["admitted"] - t["completed"]
+        done = t["completed"]
+        hit_rate = t["cache_hits"] / done if done else 0.0
+        mean_s = t["seconds"] / done if done else 0.0
+        lines.append(
+            f"  {name}: in_flight={in_flight}  done={done} "
+            f"(mean {mean_s:.3f}s)  cache_hits={t['cache_hits']} "
+            f"({hit_rate:.0%})  rejected={t['rejected']}  "
+            f"failed={t['failed']}  quota={t['quota_state']}"
+        )
+    return "\n".join(lines)
+
+
 def _render_stream(events: List[Dict[str, Any]]) -> str:
     """Render whichever job model the stream holds."""
     kinds = {e["kind"] for e in events}
@@ -1122,10 +1148,12 @@ def _render_stream(events: List[Dict[str, Any]]) -> str:
     else:
         text = render(build_job(events))
     attr = render_attribution(events)
+    tenants = render_tenants(events)
     health = render_health(events)
     return (
         text
         + ("\n" + attr if attr else "")
+        + ("\n\n" + tenants if tenants else "")
         + ("\n\n" + health if health else "")
     )
 

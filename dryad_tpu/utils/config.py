@@ -344,6 +344,32 @@ class DryadConfig:
     # command fault classification preserved in the aggregate).
     # 0 disables batching (one mailbox round trip per command).
     command_batch: int = _env_int("DRYAD_TPU_COMMAND_BATCH", 8)
+    # Serving tier (dryad_tpu.serve.QueryService): default per-tenant
+    # admission quotas — max queries a tenant may have admitted-and-
+    # unresolved at once, and the summed host-input bytes those admitted
+    # queries may bind (0 = no byte budget).  Both are per-TENANT
+    # defaults a session() call can override; admission past either
+    # fails fast with a structured QueryRejected.
+    serve_max_inflight: int = _env_int("DRYAD_TPU_SERVE_MAX_INFLIGHT", 32)
+    serve_max_bytes: int = _env_int(
+        "DRYAD_TPU_SERVE_MAX_BYTES", 1 << 30
+    )
+    # Plan-fingerprint result cache budget in host bytes (0 disables):
+    # repeat queries whose lowered stage keys AND ingest binding
+    # fingerprints match a resident entry resolve with ZERO device
+    # dispatches; entries LRU-evict by size and invalidate on the
+    # owning session's ingest-epoch bump.
+    serve_result_cache_bytes: int = _env_int(
+        "DRYAD_TPU_SERVE_CACHE_BYTES", 256 * 1024 * 1024
+    )
+    # Weighted deficit-round-robin cost quantum: one scheduling cost
+    # unit per this many host-input bytes (a query always costs at
+    # least one unit; each visit refills weight units), so a heavy
+    # tenant's big-input queries consume deficit proportionally and
+    # cannot starve a light tenant.
+    serve_drr_quantum_bytes: int = _env_int(
+        "DRYAD_TPU_SERVE_DRR_QUANTUM", 1 << 22
+    )
 
     def __post_init__(self) -> None:
         self.validate()
@@ -440,6 +466,14 @@ class DryadConfig:
             raise ValueError("chunk_fuse must be >= 1")
         if self.command_batch < 0:
             raise ValueError("command_batch must be >= 0")
+        if self.serve_max_inflight < 1:
+            raise ValueError("serve_max_inflight must be >= 1")
+        if self.serve_max_bytes < 0:
+            raise ValueError("serve_max_bytes must be >= 0")
+        if self.serve_result_cache_bytes < 0:
+            raise ValueError("serve_result_cache_bytes must be >= 0")
+        if self.serve_drr_quantum_bytes < 1:
+            raise ValueError("serve_drr_quantum_bytes must be >= 1")
 
 
 # Every ``DryadConfig`` field, one line each — THE documented key
@@ -510,4 +544,8 @@ CONFIG_KEYS = {
     "chunk_fuse": "chunk partial-plans lowered per dispatch; 1 = legacy",
     "do_while_device_auto": "try lax.while_loop for every fixed point",
     "command_batch": "gang run commands per runbatch round trip; 0 off",
+    "serve_max_inflight": "per-tenant admitted-query cap (QueryRejected)",
+    "serve_max_bytes": "per-tenant admitted host-input byte budget; 0 off",
+    "serve_result_cache_bytes": "plan-fingerprint result cache; 0 off",
+    "serve_drr_quantum_bytes": "input bytes per fair-share cost unit",
 }

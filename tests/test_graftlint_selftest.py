@@ -914,3 +914,78 @@ def outer_then_inner(x, axes):
 def build_stage_fn(stage, axes):''',
     )
     assert _rules(src, "collective-order") == []
+
+
+# -- serve-layering ----------------------------------------------------------
+
+PIPELINE = "dryad_tpu/exec/pipeline.py"
+SERVICE = "dryad_tpu/serve/service.py"
+
+PIPELINE_CLEAN = '''\
+import threading
+
+
+class DispatchWindow:
+    def __init__(self, depth):
+        self.depth = depth
+
+    def submit(self, tag, fetch):
+        pass
+'''
+
+SERVICE_CLEAN = '''\
+from dryad_tpu.api.context import DryadContext
+from dryad_tpu.exec.pipeline import DispatchWindow
+from dryad_tpu.utils.logging import get_logger
+
+
+class QueryService:
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self.window = DispatchWindow(depth=ctx.config.dispatch_depth)
+'''
+
+SERVE_FIXTURE = {PIPELINE: PIPELINE_CLEAN, SERVICE: SERVICE_CLEAN}
+
+
+def test_serve_layering_clean_fixture():
+    assert _rules(SERVE_FIXTURE, "serve-layering") == []
+
+
+@pytest.mark.parametrize(
+    "path,old,new",
+    [
+        # the engine growing a dependency on the service inverts the
+        # whole tier: the window must never know tenants exist
+        (
+            PIPELINE,
+            "import threading",
+            "import threading\nfrom dryad_tpu.serve.service import QueryService",
+        ),
+        # direct jax from serve/ bypasses the driver-thread ownership
+        # the api/exec entry points enforce
+        (
+            SERVICE,
+            "from dryad_tpu.api.context import DryadContext",
+            "import jax\nfrom dryad_tpu.api.context import DryadContext",
+        ),
+        # reaching into the planner skips the public surface
+        (
+            SERVICE,
+            "from dryad_tpu.exec.pipeline import DispatchWindow",
+            "from dryad_tpu.plan.lower import lower",
+        ),
+        # anchor drift: the scan must notice QueryService moving away
+        (
+            SERVICE,
+            "class QueryService:",
+            "class QuerySvc:",
+        ),
+    ],
+    ids=["engine-imports-serve", "serve-imports-jax",
+         "serve-imports-plan", "anchor-drift"],
+)
+def test_serve_layering_fires(path, old, new):
+    _assert_fires(
+        _mutate(SERVE_FIXTURE, path, old, new), "serve-layering"
+    )

@@ -1,0 +1,441 @@
+"""Serving-tier tests: concurrent byte-identity, result-cache
+correctness (fingerprint keying, epoch invalidation, widening vocab),
+quota fail-fast, DRR fairness, and concurrent-session safety of the
+shared DeviceOperandPool / EventLog under the multiplexed driver.
+
+The byte-identity contract is the serving analog of the engine's
+determinism invariant: N clients multiplexed through one window must
+see exactly the bytes a serial one-at-a-time loop would have produced.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from dryad_tpu.api.context import DryadContext
+from dryad_tpu.obs.diagnose import DiagnosisEngine
+from dryad_tpu.obs.metrics import JobMetrics
+from dryad_tpu.serve import QueryRejected, QueryService, TenantQuota
+from dryad_tpu.utils.config import DryadConfig
+
+
+def _tables_equal(a, b):
+    assert set(a) == set(b), (set(a), set(b))
+    for k in a:
+        va, vb = np.asarray(a[k]), np.asarray(b[k])
+        if va.dtype == object or vb.dtype == object:
+            assert [str(x) for x in va] == [str(x) for x in vb], k
+        else:
+            assert va.dtype == vb.dtype, k
+            assert va.tobytes() == vb.tobytes(), k
+
+
+def _mk_data(rng, n=256, vocab=8):
+    words = np.asarray(
+        [f"w{i:03d}" for i in rng.integers(0, vocab, n)], object
+    )
+    return {
+        "k": words,
+        "v": rng.integers(0, 1000, n).astype(np.int32),
+        "w": rng.random(n).astype(np.float32),
+    }
+
+
+def _shapes(t):
+    """Six distinct plan shapes over one table — all value-hashable
+    params, so rebuilt queries share compiled programs AND result-cache
+    keys (prepared-statement reuse is tested separately)."""
+    return [
+        t.group_by("k", aggs={"s": ("sum", "v")}),
+        t.group_by("k", aggs={"c": ("count", None)}),
+        t.group_by("k", aggs={"m": ("mean", "w")}),
+        t.group_by("k", aggs={"mx": ("max", "v"), "mn": ("min", "v")}),
+        t.distinct("k"),
+        t.order_by("v").take(16),
+    ]
+
+
+# -- concurrent byte-identity -------------------------------------------------
+
+
+def test_32_clients_byte_identical_to_serial(rng):
+    # cache OFF: every client query really dispatches through the
+    # shared window, interleaved across 4 tenants by the DRR scheduler
+    ctx = DryadContext(
+        num_partitions_=8,
+        config=DryadConfig(serve_result_cache_bytes=0),
+    )
+    t = ctx.from_arrays(_mk_data(rng))
+    queries = _shapes(t)
+    reference = [ctx.run_to_host(q) for q in queries]
+
+    with QueryService(ctx) as svc:
+        sessions = [svc.session(f"tenant{i}") for i in range(4)]
+        results = [None] * 32
+        errors = []
+
+        def client(i):
+            try:
+                q = queries[i % len(queries)]
+                results[i] = sessions[i % 4].run(q, timeout=120)
+            except BaseException as e:  # noqa: BLE001
+                errors.append((i, e))
+
+        threads = [
+            threading.Thread(target=client, args=(i,)) for i in range(32)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=180)
+        assert not errors, errors
+        for i in range(32):
+            _tables_equal(results[i], reference[i % len(queries)])
+        stats = svc.stats()
+    assert sum(t["completed"] for t in stats["tenants"].values()) == 32
+    assert stats["cache"]["hits"] == 0  # cache was off
+
+
+# -- result cache -------------------------------------------------------------
+
+
+def test_repeat_query_served_from_cache_zero_dispatches(rng):
+    ctx = DryadContext(num_partitions_=8)
+    t = ctx.from_arrays(_mk_data(rng))
+    q = t.group_by("k", aggs={"s": ("sum", "v")})
+    with QueryService(ctx) as svc:
+        s = svc.session("alpha")
+        first = s.run(q, timeout=120)
+        before = JobMetrics.from_events(ctx.events.events()).dispatch_count
+        fut = s.submit(q)
+        second = fut.result(timeout=120)
+        after = JobMetrics.from_events(ctx.events.events()).dispatch_count
+        assert fut.cached
+        assert after == before, "cache hit must add ZERO dispatches"
+        _tables_equal(first, second)
+        kinds = [e["kind"] for e in ctx.events.events()]
+        assert "result_cache_hit" in kinds
+        # the cached copy is the client's own: mutating it must not
+        # poison the next hit
+        second["s"][:] = -1
+        third = s.run(q, timeout=120)
+    _tables_equal(first, third)
+
+
+def test_cache_differential_widening_and_epoch_invalidation(rng):
+    data1 = _mk_data(rng, vocab=8)
+    data2 = _mk_data(rng, n=512, vocab=200)  # widens the dictionary tier
+    ctx = DryadContext(num_partitions_=8)
+    with QueryService(ctx) as svc:
+        s = svc.session("alpha")
+        t1 = s.ingest(data1)
+        q1 = t1.group_by("k", aggs={"s": ("sum", "v")})
+        r1a = s.run(q1, timeout=120)
+        r1b = s.run(q1, timeout=120)  # hit
+        assert svc.stats()["cache"]["hits"] == 1
+        # widening ingest bumps the epoch: the old entry is invalid
+        t2 = s.ingest(data2)
+        q2 = t2.group_by("k", aggs={"s": ("sum", "v")})
+        r2 = s.run(q2, timeout=120)
+        r1c = s.run(q1, timeout=120)  # recompute, NOT a stale hit
+        assert svc.stats()["cache"]["hits"] == 1
+    _tables_equal(r1a, r1b)
+    _tables_equal(r1a, r1c)
+    # cache-off differential: a fresh serial context over the same data
+    # (operand deltas and all) must produce the same bytes
+    ref = DryadContext(
+        num_partitions_=8,
+        config=DryadConfig(serve_result_cache_bytes=0),
+    )
+    rt1 = ref.from_arrays(data1)
+    rt2 = ref.from_arrays(data2)
+    _tables_equal(
+        r1a, ref.run_to_host(rt1.group_by("k", aggs={"s": ("sum", "v")}))
+    )
+    _tables_equal(
+        r2, ref.run_to_host(rt2.group_by("k", aggs={"s": ("sum", "v")}))
+    )
+
+
+# -- admission ----------------------------------------------------------------
+
+
+def test_quota_fail_fast_and_window_never_wedges(rng):
+    ctx = DryadContext(num_partitions_=8)
+    t = ctx.from_arrays(_mk_data(rng))
+    q = t.group_by("k", aggs={"s": ("sum", "v")})
+    svc = QueryService(ctx, start=False)  # queue up WITHOUT draining
+    try:
+        s = svc.session("alpha", quota=TenantQuota(max_inflight=4))
+        futs = [s.submit(q) for _ in range(4)]
+        with pytest.raises(QueryRejected) as ei:
+            s.submit(q)
+        assert ei.value.tenant == "alpha"
+        assert ei.value.reason == "inflight"
+        assert ei.value.limit == 4
+        assert ei.value.current == 4
+        # a structured rejection, never a wedge: starting the service
+        # drains everything admitted, and the tenant can submit again
+        svc.start()
+        for f in futs:
+            f.result(timeout=120)
+        again = s.run(q, timeout=120)
+        assert again is not None
+        kinds = [e["kind"] for e in ctx.events.events()]
+        assert "query_rejected" in kinds
+        assert "tenant_quota" in kinds  # saturated transition recorded
+    finally:
+        svc.close()
+
+
+def test_byte_budget_rejection(rng):
+    ctx = DryadContext(num_partitions_=8)
+    t = ctx.from_arrays(_mk_data(rng))
+    q = t.group_by("k", aggs={"s": ("sum", "v")})
+    with QueryService(ctx) as svc:
+        s = svc.session(
+            "tiny", quota=TenantQuota(max_inflight=100, max_bytes=16)
+        )
+        with pytest.raises(QueryRejected) as ei:
+            s.submit(q)
+        assert ei.value.reason == "bytes"
+        assert ei.value.limit == 16
+
+
+def test_failed_query_resolves_future_and_service_survives(rng):
+    ctx = DryadContext(num_partitions_=8)
+    t = ctx.from_arrays(_mk_data(rng))
+
+    def boom(cols):
+        raise ValueError("bad plan")
+
+    with QueryService(ctx) as svc:
+        s = svc.session("alpha")
+        bad = s.submit(t.select(boom, schema=t.schema))
+        with pytest.raises(Exception):
+            bad.result(timeout=120)
+        # one tenant's bad plan never kills the loop
+        ok = s.run(t.group_by("k", aggs={"s": ("sum", "v")}), timeout=120)
+        assert ok is not None
+
+
+# -- fairness -----------------------------------------------------------------
+
+
+def _completion_order(ctx, tenants):
+    return [
+        e["tenant"]
+        for e in ctx.events.events()
+        if e["kind"] == "query_complete" and e["tenant"] in tenants
+    ]
+
+
+def test_equal_weight_fair_share_interleaves(rng):
+    ctx = DryadContext(
+        num_partitions_=8,
+        config=DryadConfig(serve_result_cache_bytes=0),
+    )
+    ta = ctx.from_arrays(_mk_data(rng))
+    tb = ctx.from_arrays(_mk_data(rng))
+    svc = QueryService(ctx, start=False)
+    try:
+        sa, sb = svc.session("a"), svc.session("b")
+        futs = []
+        for _ in range(8):
+            futs.append(sa.submit(ta.group_by("k", aggs={"s": ("sum", "v")})))
+            futs.append(sb.submit(tb.group_by("k", aggs={"s": ("sum", "v")})))
+        svc.start()
+        for f in futs:
+            f.result(timeout=120)
+    finally:
+        svc.close()
+    order = _completion_order(ctx, {"a", "b"})
+    assert len(order) == 16
+    # equal weights, equal costs: DRR must interleave — at no prefix
+    # may one tenant run more than 2 ahead (throughput spread well
+    # inside the 2x acceptance bound)
+    for i in range(1, len(order) + 1):
+        na = order[:i].count("a")
+        nb = i - na
+        assert abs(na - nb) <= 2, (i, order)
+
+
+def test_weighted_tenant_gets_proportional_share(rng):
+    ctx = DryadContext(
+        num_partitions_=8,
+        config=DryadConfig(serve_result_cache_bytes=0),
+    )
+    ta = ctx.from_arrays(_mk_data(rng))
+    tb = ctx.from_arrays(_mk_data(rng))
+    svc = QueryService(ctx, start=False)
+    try:
+        sa = svc.session("heavy", weight=2)
+        sb = svc.session("light", weight=1)
+        futs = []
+        for _ in range(6):
+            futs.append(sa.submit(ta.group_by("k", aggs={"s": ("sum", "v")})))
+            futs.append(sb.submit(tb.group_by("k", aggs={"s": ("sum", "v")})))
+        svc.start()
+        for f in futs:
+            f.result(timeout=120)
+    finally:
+        svc.close()
+    order = _completion_order(ctx, {"heavy", "light"})
+    assert len(order) == 12
+    # weight 2:1 with equal queue depths: the heavy tenant stays ahead
+    # at every prefix and drains its queue first
+    for i in range(2, len(order) + 1):
+        assert order[:i].count("heavy") >= order[:i].count("light"), order
+    assert (
+        order.index("heavy") < order.index("light")
+        or order.count("heavy") == 0
+    )
+    last_heavy = max(i for i, t in enumerate(order) if t == "heavy")
+    last_light = max(i for i, t in enumerate(order) if t == "light")
+    assert last_heavy < last_light, order
+
+
+# -- concurrent-session safety of shared engine state -------------------------
+
+
+class _FakeOperand:
+    """Minimal operand-protocol object (exec.operands) for hammering
+    the pool without a device mesh dependency on content."""
+
+    operand_arity = 1
+
+    def __init__(self, content: int):
+        self.content = content
+        self._arr = np.full(64, content, np.int32)
+
+    def operand_signature(self):
+        return ("fake", self._arr.shape, "int32")
+
+    def operand_arrays(self):
+        return (self._arr,)
+
+    def operand_sha(self):
+        return f"sha-{self.content}"
+
+
+def test_operand_pool_concurrent_sessions():
+    from dryad_tpu.exec.operands import DeviceOperandPool
+
+    pool = DeviceOperandPool(mesh=None)
+    errors = []
+
+    def hammer(seed):
+        r = np.random.default_rng(seed)
+        try:
+            for _ in range(40):
+                content = int(r.integers(0, 4))
+                dev = pool.get(_FakeOperand(content))
+                got = np.asarray(dev[0])
+                # the returned buffers always match the REQUESTED
+                # content, even while other sessions retarget the tier
+                if not (got == content).all():
+                    errors.append((content, got[:4]))
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=hammer, args=(i,)) for i in range(8)
+    ]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=120)
+    assert not errors, errors[:3]
+    assert pool.hits + pool.full_uploads + pool.delta_scatters == 8 * 40
+
+
+def test_event_log_concurrent_emit():
+    from dryad_tpu.exec.events import EventLog
+
+    log = EventLog(None)
+    n, per = 8, 100
+
+    def emitter(i):
+        for j in range(per):
+            log.emit(
+                "query_admitted", tenant=f"t{i}", query=f"{i}:{j}",
+                cost_bytes=0, queued=1,
+            )
+
+    threads = [
+        threading.Thread(target=emitter, args=(i,)) for i in range(n)
+    ]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=60)
+    evs = [e for e in log.events() if e["kind"] == "query_admitted"]
+    assert len(evs) == n * per
+
+
+# -- obs folds ----------------------------------------------------------------
+
+
+def _synthetic_serve_events():
+    return [
+        {"kind": "query_admitted", "tenant": "a", "query": "a:0",
+         "cost_bytes": 100, "queued": 1},
+        {"kind": "query_admitted", "tenant": "a", "query": "a:1",
+         "cost_bytes": 100, "queued": 2},
+        {"kind": "query_complete", "tenant": "a", "query": "a:0",
+         "ok": True, "seconds": 0.5, "cached": False},
+        {"kind": "result_cache_hit", "tenant": "a", "query": "a:1",
+         "rows": 3},
+        {"kind": "query_complete", "tenant": "a", "query": "a:1",
+         "ok": True, "seconds": 0.01, "cached": True},
+        {"kind": "query_admitted", "tenant": "b", "query": "b:0",
+         "cost_bytes": 9, "queued": 1},
+        {"kind": "query_rejected", "tenant": "b", "query": "b:rej1",
+         "reason": "inflight", "limit": 1, "current": 1},
+        {"kind": "tenant_quota", "tenant": "b", "state": "saturated",
+         "inflight": 1, "limit": 1, "bytes": 9},
+    ]
+
+
+def test_jobmetrics_per_tenant_folds():
+    m = JobMetrics.from_events(_synthetic_serve_events())
+    assert m.queries_admitted == 3
+    assert m.queries_completed == 2
+    assert m.queries_rejected == 1
+    assert m.result_cache_hits == 1
+    assert m.tenants["a"]["admitted"] == 2
+    assert m.tenants["a"]["completed"] == 2
+    assert m.tenants["a"]["cache_hits"] == 1
+    assert m.tenants["a"]["quota_state"] == "ok"
+    assert m.tenants["b"]["rejected"] == 1
+    assert m.tenants["b"]["quota_state"] == "saturated"
+    attr = m.attribution()
+    assert attr["queries_admitted"] == 3
+    assert attr["result_cache_hits"] == 1
+
+
+def test_jobview_tenant_panel():
+    from dryad_tpu.tools.jobview import render_tenants
+
+    text = render_tenants(_synthetic_serve_events())
+    assert "-- tenants --" in text
+    assert "a: in_flight=0" in text
+    assert "cache_hits=1" in text
+    assert "quota=saturated" in text
+    # non-serving streams render nothing
+    assert render_tenants([{"kind": "stage_start", "ts": 0.0}]) == ""
+
+
+def test_quota_pressure_diagnosis():
+    eng = DiagnosisEngine(config=None, events=None)
+    for i in range(3):
+        eng.observe({
+            "kind": "query_rejected", "tenant": "hot", "query": f"r{i}",
+            "reason": "inflight", "limit": 4, "current": 4,
+        })
+    rules = [d["rule"] for d in eng.diagnoses()]
+    assert "quota_pressure" in rules
+    d = next(d for d in eng.diagnoses() if d["rule"] == "quota_pressure")
+    assert d["subject"] == "hot"
+    assert d["evidence"]["rejections"] >= 3
