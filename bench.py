@@ -840,6 +840,208 @@ def aggtree_metric(n: int, chunk_rows: int = 1 << 14):
     )
 
 
+# Child body for rewrite_metric: the runtime plan rewriter only pays
+# off against genuinely adversarial inputs — a stream whose key
+# distribution drifts AFTER the range splitters were sampled (the hot
+# bucket then eats most rows), and an overflow-prone skewed join rerun
+# on one context (the static plan re-discovers the overflow every run;
+# the rewriter's boost floor pre-widens from run 2).  8 virtual CPU
+# devices in a subprocess; both runs assert byte-identity first.
+_REWRITE_CHILD = r"""
+import json, os, sys, time
+import numpy as np
+
+from dryad_tpu.parallel.mesh import force_cpu_backend
+
+force_cpu_backend(8)
+
+import jax
+
+try:
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get("DRYAD_BENCH_JAX_CACHE", "/tmp/dryad_jax_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+except Exception:
+    pass
+
+from dryad_tpu import DryadConfig, DryadContext
+from dryad_tpu.obs.metrics import JobMetrics
+
+nchunks, chunk_rows = int(sys.argv[1]), int(sys.argv[2])
+
+
+def sort_chunks():
+    # chunk 0 is uniform (splitters sample it); the rest collapse onto
+    # 1/50th of the key range — the static partition's low bucket goes
+    # hot and must be recursively re-spilled at phase 2, while the
+    # rewriter splits it mid-stream off the live spill histogram
+    rng = np.random.default_rng(7)
+    out = [{
+        "x": rng.integers(0, 1_000_000, chunk_rows).astype(np.int64),
+        "v": rng.random(chunk_rows).astype(np.float32),
+    }]
+    for _ in range(nchunks - 1):
+        out.append({
+            "x": rng.integers(0, 20_000, chunk_rows).astype(np.int64),
+            "v": rng.random(chunk_rows).astype(np.float32),
+        })
+    return out
+
+
+SORT = sort_chunks()
+
+
+def sort_ctx(rw):
+    return DryadContext(config=DryadConfig(
+        stream_bucket_rows=2 * chunk_rows, stream_buckets=8,
+        plan_rewrite=rw, diagnose_cooldown_s=0.0,
+    ))
+
+
+def sort_once(ctx):
+    out = ctx.from_stream(
+        iter([{k: v.copy() for k, v in c.items()} for c in SORT])
+    ).order_by(["x", "v"]).collect()
+    assert len(out["x"]) == nchunks * chunk_rows
+    return out
+
+
+def sort_leg(rw):
+    sort_once(sort_ctx(rw))  # warm: pays the shape-palette compiles
+    ctx = sort_ctx(rw)  # fresh controller state for the measured run
+    t0 = time.perf_counter()
+    out = sort_once(ctx)
+    dt = time.perf_counter() - t0
+    ev = ctx.executor.events.events()
+    return out, {
+        "seconds": round(dt, 3),
+        "rows_per_sec": round(nchunks * chunk_rows / dt, 1),
+        "rewrites_applied": sum(
+            1 for e in ev
+            if e["kind"] == "plan_rewrite" and e["phase"] == "applied"
+        ),
+        "spill_bytes": JobMetrics.from_events(ev).spill_bytes,
+    }
+
+
+def join_tables():
+    rng = np.random.default_rng(11)
+    n = nchunks * chunk_rows
+    k = rng.integers(0, n, n).astype(np.int32)
+    k[rng.random(n) < 0.3] = 7  # hot probe key: one partition overloads
+    return (
+        {"k": k, "a": rng.integers(0, 1000, n).astype(np.int32)},
+        {"k": np.arange(n, dtype=np.int32),
+         "b": rng.integers(0, 1000, n).astype(np.int32)},
+    )
+
+
+LTBL, RTBL = join_tables()
+
+
+def join_leg(rw):
+    # ONE context reused: the adaptive run learns the overflow on the
+    # first query and pre-widens every later dispatch
+    ctx = DryadContext(config=DryadConfig(
+        shuffle_slack=1.0, plan_rewrite=rw, diagnose_cooldown_s=0.0,
+    ))
+
+    def once():
+        return ctx.from_arrays(
+            {k: v.copy() for k, v in LTBL.items()}
+        ).join(
+            ctx.from_arrays({k: v.copy() for k, v in RTBL.items()}),
+            ["k"], ["k"],
+        ).collect()
+
+    once(); once()  # warm compiles AND let the overflow loop be seen
+    mark = len(ctx.executor.events.events())
+    t0 = time.perf_counter()
+    out = once()
+    dt = time.perf_counter() - t0
+    ev = ctx.executor.events.events()[mark:]
+    return out, {
+        "seconds": round(dt, 3),
+        "rows_per_sec": round(len(LTBL["k"]) / dt, 1),
+        "overflow_retries": sum(
+            1 for e in ev if e["kind"] == "stage_overflow"
+        ),
+        "prewidened": any(
+            e["kind"] == "plan_rewrite" and e["phase"] == "applied"
+            and e["action"] == "prewiden_palette"
+            for e in ctx.executor.events.events()
+        ),
+    }
+
+
+def canon(t):
+    names = sorted(t)
+    order = np.lexsort([np.asarray(t[n]) for n in names])
+    return {n: np.asarray(t[n])[order] for n in names}
+
+
+res = {}
+for leg, fn, ordered in (("sort", sort_leg, True),
+                         ("join", join_leg, False)):
+    out_off, static = fn(False)
+    out_on, adaptive = fn(True)
+    a = out_on if ordered else canon(out_on)
+    b = out_off if ordered else canon(out_off)
+    assert set(a) == set(b)
+    for c in a:  # the rewrite changed shape, never bytes
+        assert a[c].tobytes() == b[c].tobytes(), (leg, c)
+    res[leg] = {
+        "static": static, "adaptive": adaptive, "byte_identical": True,
+        "speedup": round(
+            static["seconds"] / max(adaptive["seconds"], 1e-9), 3
+        ),
+    }
+print(json.dumps(res))
+"""
+
+
+def rewrite_metric(n: int, chunk_rows: int = 1 << 14):
+    """Runtime plan rewriter (dryad_tpu/rewrite) on adversarial inputs:
+    a drift-skewed out-of-core sort (splitters sampled before the
+    distribution collapses -> partition_skew -> mid-stream hot-bucket
+    split) and an overflow-prone skewed join rerun on one context
+    (overflow_loop -> pre-widened boost palette).  Static plan vs
+    rewriter per leg, byte-identity asserted in the child; headline is
+    the adaptive sort leg, speedups ride extra."""
+    import subprocess
+
+    nchunks = max(4, n // chunk_rows)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-c", _REWRITE_CHILD,
+         str(nchunks), str(chunk_rows)],
+        capture_output=True, text=True, timeout=max(remaining(), 120),
+        env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"rewrite child rc={out.returncode}: {out.stderr[-2000:]}"
+        )
+    res = json.loads(out.stdout.strip().splitlines()[-1])
+    srt, jn = res["sort"], res["join"]
+    extra = {
+        "legs": res, "devices": 8, "chunks": nchunks,
+        "chunk_rows": chunk_rows,
+        "sort_speedup": srt["speedup"],
+        "join_speedup": jn["speedup"],
+        "rewrites_applied": srt["adaptive"]["rewrites_applied"],
+        "static_overflow_retries": jn["static"]["overflow_retries"],
+        "adaptive_overflow_retries": jn["adaptive"]["overflow_retries"],
+    }
+    return rep_record(
+        "rewrite_rows_per_sec", nchunks * chunk_rows,
+        [srt["adaptive"]["seconds"]], extra,
+    )
+
+
 # Child body for serve_metric: closed-loop multi-tenant clients
 # multiplexed on ONE resident engine (serve/service.py).  Runs on 8
 # virtual CPU devices in a fresh subprocess like the aggtree matrix:
@@ -1731,6 +1933,12 @@ def child_main() -> None:
         # subprocess; peak-byte accounting is platform-free)
         ("oocxchg_rows_per_sec",
          lambda: ooc_exchange_metric(1 << 18, chunk_rows=1 << 14),
+         300, False),
+        # runtime plan rewriter vs static plan on adversarial inputs
+        # (drift-skewed ooc sort + overflow-prone skewed join; 8
+        # virtual CPU devices in a subprocess, byte-identity asserted)
+        ("rewrite_rows_per_sec",
+         lambda: rewrite_metric(1 << 17, chunk_rows=1 << 13),
          300, False),
         # serving tier: 4 tenants x {16,64} closed-loop clients
         # multiplexed on one resident engine, cache off/on per cell

@@ -224,6 +224,7 @@ def _load_builtin() -> None:
         checks_obs,
         checks_operands,
         checks_recompile,
+        checks_rewrite,
         checks_serve,
     )
 

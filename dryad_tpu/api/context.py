@@ -30,6 +30,7 @@ from dryad_tpu.exec.events import EventLog
 from dryad_tpu.exec.executor import GraphExecutor
 from dryad_tpu.obs import flightrec
 from dryad_tpu.obs.diagnose import DiagnosisEngine
+from dryad_tpu.rewrite.controller import RewriteController
 from dryad_tpu.parallel import distribute as D
 from dryad_tpu.parallel.mesh import make_mesh, num_partitions
 from dryad_tpu.plan.lower import lower
@@ -126,6 +127,7 @@ class DryadContext:
         # NOT tracked — inputs snapshot at first execution.
         self._device_cache: "OrderedDict[int, tuple]" = OrderedDict()
         self.diagnosis: Optional[DiagnosisEngine] = None
+        self.rewriter = None
         if local_debug:
             self.mesh = None
             self.executor = None
@@ -195,11 +197,21 @@ class DryadContext:
                     config=self.config, events=self.events
                 )
                 self.events.add_tap(self.diagnosis.observe)
+            # Runtime plan rewriter: folds the diagnoses above into
+            # pending rewrite actions the execution drivers poll at
+            # safe boundaries (rewrite.controller).  Rides the same
+            # tap mechanism; needs the diagnosis engine upstream.
+            if self.config.obs_diagnosis and self.config.plan_rewrite:
+                self.rewriter = RewriteController(
+                    config=self.config, events=self.events
+                )
+                self.events.add_tap(self.rewriter.observe)
             self.executor = GraphExecutor(
                 self.mesh, self.config, self.events,
                 subquery_runner=self._run_subquery,
                 loop_lowerer=self._lower_loop_stage,
             )
+            self.executor.rewriter = self.rewriter
 
     def rebuild_mesh(self, exclude_device_ids) -> None:
         """Elastic recovery: shrink the mesh past failed devices and
@@ -220,6 +232,7 @@ class DryadContext:
             subquery_runner=self._run_subquery,
             loop_lowerer=self._lower_loop_stage,
         )
+        self.executor.rewriter = self.rewriter
 
     # -- ingestion ----------------------------------------------------------
     def from_arrays(

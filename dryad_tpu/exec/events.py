@@ -102,6 +102,8 @@ EVENT_KINDS: Dict[str, str] = {
     "telemetry_merged": "driver absorbed worker span/counter batches",
     # -- diagnosis / flight recorder (obs.diagnose / exec.events) ---------
     "diagnosis": "online pathology detected; rule/severity/evidence/hint",
+    "plan_rewrite": "runtime plan rewrite decided/applied; "
+                    "action/rule/phase (rewrite.controller)",
     "events_dropped": "in-memory ring evicted events; dropped total",
     # -- cluster: scheduler / quarantine (cluster.scheduler) --------------
     "process_failed": "a scheduled process failed; computer/error",
@@ -226,7 +228,9 @@ EVENT_PAYLOADS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
         ("dcn_bytes", "ici_bytes", "level"),
         ("cap_rows", "device", "fan_in", "rows_out"),
     ),
-    "stream_combine_policy": (("chunks", "mode"), ("reprobe", "static")),
+    "stream_combine_policy": (
+        ("chunks", "mode"), ("pinned", "reprobe", "static"),
+    ),
     "stream_group_done": (("chunks", "groups"), ()),
     "dispatch_gap": (("gap_s",), ("in_flight", "pipeline")),
     "dispatch_window": (
@@ -305,6 +309,11 @@ EVENT_PAYLOADS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
     "quarantine_absorbed": (("deltas", "source"), ()),
     "diagnosis": (
         ("evidence", "hint", "rule", "severity"), ("name", "stage"),
+    ),
+    "plan_rewrite": (
+        ("action", "phase", "rule"),
+        ("boost", "bucket", "depth", "fan", "mode", "ratio", "rows",
+         "stage", "subject", "tree", "window"),
     ),
     "events_dropped": (("dropped",), ()),
     "query_admitted": (("cost_bytes", "query", "tenant"), ("queued",)),

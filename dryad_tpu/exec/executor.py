@@ -62,6 +62,7 @@ from dryad_tpu.plan.fuse import (
     fuse as fuse_plan,
 )
 from dryad_tpu.plan.lower import Stage, StageGraph, StageOp
+from dryad_tpu.plan.xchgplan import resolve_window
 from dryad_tpu.utils.config import DryadConfig
 from dryad_tpu.utils.logging import get_logger
 
@@ -259,6 +260,13 @@ class GraphExecutor:
                 "delta_scatters": self.operand_pool.delta_scatters,
             },
         )
+        # Runtime plan rewriter (dryad_tpu.rewrite), wired by the
+        # context AFTER construction — the engine never imports the
+        # policy layer, it only consults the handle.  Consulted for
+        # per-stage starting-boost floors (overflow pre-widening) and
+        # the auto exchange-window hint.
+        self.rewriter = None
+        self._rewrites_applied: set = set()
         # do_while loop-state compaction programs (see _compact_loop_state)
         self._compact_cache: Dict[Tuple, Any] = {}
         self.stats: Dict[str, StageStatistics] = {}
@@ -383,7 +391,11 @@ class GraphExecutor:
         run_stage = stage
         if fan:
             run_stage = self._fan_adapted_stage(stage, fan)
-        key = (self._stage_key(run_stage), boost, shape_key)
+        window = self._resolve_window(shape_key, boost)
+        # the resolved window shapes the lowered exchange: it must be
+        # part of the compile identity (the auto policy / rewriter
+        # hint may resolve differently across dispatches)
+        key = (self._stage_key(run_stage), boost, shape_key, window)
         hit = self._compiled.get(key)
         if hit is None:
             t0 = time.monotonic()
@@ -393,7 +405,6 @@ class GraphExecutor:
             )
             axes = mesh_axes(self.mesh)
             sizes = tuple(self.mesh.shape[a] for a in axes)
-            window = self.config.exchange_window
             cell: List[Dict[str, int]] = []
             if isinstance(run_stage, FusedStage):
                 fn = build_fused_fn(
@@ -421,6 +432,37 @@ class GraphExecutor:
     def _shape_key(inputs: Tuple[ColumnBatch, ...]) -> Tuple:
         return tuple(
             (tuple(sorted(b.data.keys())), b.capacity) for b in inputs
+        )
+
+    def _resolve_window(self, shape_key: Tuple, boost: int) -> int:
+        """Effective staged-exchange window for one compilation.
+
+        Static ``config.exchange_window >= 0`` passes through; ``-1``
+        delegates to :func:`plan.xchgplan.resolve_window` with a
+        conservative per-destination bucket estimate derived from the
+        shape key (capacity x columns x 8B, widened by slack/boost —
+        the same quantities the lowered exchange sizes its send buffer
+        from), the configured HBM budget, and the runtime rewriter's
+        retune hint when one is pinned.  Deterministic in its inputs,
+        so the resolved value is safe inside the compile-cache key.
+        """
+        cfgw = int(getattr(self.config, "exchange_window", 0))
+        if cfgw >= 0:
+            return cfgw
+        slack = float(getattr(self.config, "shuffle_slack", 1.25))
+        bucket_bytes = 1
+        for cols, capacity in shape_key:
+            rows = -(-int(capacity) * max(1, int(boost)) // max(1, self.P))
+            est = int(rows * slack) * max(1, len(cols)) * 8
+            bucket_bytes = max(bucket_bytes, est)
+        budget = (
+            int(getattr(self.config, "exchange_hbm_budget_mb", 256)) << 20
+        )
+        hint = None
+        if self.rewriter is not None:
+            hint = self.rewriter.exchange_window_hint()
+        return resolve_window(
+            cfgw, self.P, bucket_bytes, budget, hint=hint
         )
 
     # -- execution ---------------------------------------------------------
@@ -1026,6 +1068,21 @@ class GraphExecutor:
             can_overflow or bool(window)
         )
         boost = boost0
+        if self.rewriter is not None and can_overflow:
+            # proactive palette pre-widening: an overflow_loop diagnosis
+            # raises this stage-name's starting tier so the NEXT
+            # dispatch skips the doomed narrow attempt entirely
+            floor = self.rewriter.boost_floor(stage.name)
+            if floor > boost:
+                boost = floor
+                if (stage.name, floor) not in self._rewrites_applied:
+                    self._rewrites_applied.add((stage.name, floor))
+                    self.events.emit(
+                        "plan_rewrite", phase="applied",
+                        action="prewiden_palette", rule="overflow_loop",
+                        subject=stage.name, stage=stage.name,
+                        boost=floor,
+                    )
         failures = 0
         version = 0
         attempts: List[Attempt] = []  # failed-attempt history (post-mortem)

@@ -526,6 +526,8 @@ class StreamExecutor:
         # reclaimed HBM on bigger buckets — fewer device jobs, fewer
         # spill round-trips — scaling by the P/window buffer shrink,
         # clamped to 4x so ingest chunking stays responsive.
+        # (-1 = auto policy resolves per-compilation in the executor;
+        # no static bucket scaling can be assumed here)
         window = int(getattr(cfg, "exchange_window", 0))
         if window > 0:
             P = self._P()
@@ -548,6 +550,10 @@ class StreamExecutor:
         self.chunk_fuse = max(1, int(getattr(cfg, "chunk_fuse", 1)))
         self.max_split_depth = 3
         self.events = ctx.executor.events if ctx.executor else None
+        # runtime plan rewriter (rewrite.controller): polled at chunk
+        # boundaries for hot-bucket splits and combine pins; None when
+        # diagnosis/rewrite is off
+        self.rewriter = getattr(ctx, "rewriter", None)
         # driver-loop spans (cat=chunk structural, engine jobs land on
         # cat=execute inside) + the shared counter registry
         self.tracer = Tracer(self.events)
@@ -996,11 +1002,35 @@ class StreamExecutor:
         the differential baseline and covers engine-order-sensitive
         aggregates (``first``), which the tree's similarity routing
         would reorder."""
-        if bool(getattr(self.ctx.config, "combine_tree", True)) and not any(
+        tree = bool(getattr(self.ctx.config, "combine_tree", True))
+        ov = (
+            self.rewriter.combine_tree_override()
+            if self.rewriter is not None else None
+        )
+        if ov is not None and bool(ov) != tree:
+            # combine_thrash rewrite: flip the strategy for streams
+            # that START after the diagnosis (both strategies compute
+            # the same groups — only the merge cadence differs)
+            tree = bool(ov)
+            self._emit(
+                "plan_rewrite", phase="applied", action="flip_combine",
+                rule="combine_thrash", subject="stream_combine",
+                tree=tree,
+            )
+        if tree and not any(
             op == "first" for op, _c, _o in agg_list
         ):
             return self._group_partial_tree(node, stream, keys, agg_list)
         return self._group_partial_flat(node, stream, keys, agg_list)
+
+    def _combine_pinned(self) -> bool:
+        """True when a combine_thrash rewrite pinned the streaming
+        combine to host accumulation (the always-correct conservative
+        side of the oscillation)."""
+        return (
+            self.rewriter is not None
+            and self.rewriter.combine_pin() == "host"
+        )
 
     def _first_chunk_irreducible(self, table, stream, keys, batch, n):
         """Static high-cardinality signal for the first chunk: count the
@@ -1058,6 +1088,19 @@ class StreamExecutor:
         host_rows = 0
         reduce_streak = 0  # consecutive host combines that DID reduce
         nchunks = 0
+        pin_applied = False
+        if self._combine_pinned():
+            # pin_combine rewrite: start (and stay) on host
+            # accumulation — no probe merge, no reprobe oscillation
+            host_acc = []
+            pin_applied = True
+            self._emit("stream_combine_policy", mode="host", chunks=0,
+                       pinned=True)
+            self._emit(
+                "plan_rewrite", phase="applied", action="pin_combine",
+                rule="combine_thrash", subject="stream_combine",
+                mode="host",
+            )
         for table in self._iter_base(stream):
             n = _chunk_rows(table)
             pq = self._chunk_partial_query(
@@ -1116,7 +1159,18 @@ class StreamExecutor:
                     reduce_streak += 1
                 else:
                     reduce_streak = 0
-                if (
+                if self._combine_pinned():
+                    # a combine_thrash diagnosis mid-stream pins the
+                    # degrade: stop re-probing the device path
+                    reduce_streak = 0
+                    if not pin_applied:
+                        pin_applied = True
+                        self._emit(
+                            "plan_rewrite", phase="applied",
+                            action="pin_combine", rule="combine_thrash",
+                            subject="stream_combine", mode="host",
+                        )
+                elif (
                     reprobe_after
                     and reduce_streak >= reprobe_after
                     and host_rows > 0
@@ -1589,6 +1643,11 @@ class StreamExecutor:
         primary, pdesc = keys[0]
         spill = SpillDir(self.ctx.dictionary, root=self._spill_root())
         writer = self._spill_writer()
+        # rewrite-split hot buckets: bucket -> {"splitters", "spill",
+        # "extent", "rows"} — rows landing in a refined bucket route
+        # straight into its sub-range spill at depth+1 (rewrite
+        # controller's split_bucket action, claimed at chunk bounds)
+        refined: Dict[int, dict] = {}
         try:
             scope = self._scope()
             if pieces is not None:
@@ -1608,16 +1667,27 @@ class StreamExecutor:
                 col = _sort_key_view(t[primary])
                 if splitters is None:
                     splitters = _sample_splitters(col, self.num_buckets)
+                # chunk boundary = safe application point: no partial
+                # chunk is in flight, bucket contents are self-contained
+                if self.rewriter is not None:
+                    self._apply_sort_splits(
+                        spill, writer, refined, primary, depth
+                    )
                 bids = np.searchsorted(splitters, col, side="right")
                 for b in np.unique(bids):
                     sel = bids == b
+                    piece = {c: v[sel] for c, v in t.items()}
+                    if int(b) in refined:
+                        self._route_refined(
+                            refined[int(b)], piece, primary, depth
+                        )
+                        continue
                     vals = col[sel]
                     mn, mx = vals.min(), vals.max()
                     if b in extent:
                         pmn, pmx = extent[b]
                         mn, mx = min(mn, pmn), max(mx, pmx)
                     extent[int(b)] = (mn, mx)
-                    piece = {c: v[sel] for c, v in t.items()}
                     self.metrics.observe(
                         "partition_rows", int(sel.sum()), depth=depth
                     )
@@ -1634,17 +1704,91 @@ class StreamExecutor:
             if writer is not None:
                 writer.flush()  # phase barrier: bucket metadata is final
             order = spill.buckets()
+            if refined:
+                order = sorted(set(order) | set(refined))
             if pdesc:
                 order = list(reversed(order))
             yield from self._sort_buckets(
-                node, spill, order, extent, keys, depth
+                node, spill, order, extent, keys, depth,
+                refined=refined or None,
             )
         finally:
             if writer is not None:
                 writer.close(drain=False)
+            for rec in refined.values():
+                rec["spill"].cleanup()
             spill.cleanup()
 
-    def _sort_buckets(self, node, spill, order, extent, keys, depth):
+    def _apply_sort_splits(self, spill, writer, refined, primary, depth):
+        """Claim pending split_bucket rewrites for this depth and turn
+        each into a range refinement: sub-splitters from the bucket's
+        live sample, already-spilled pieces re-routed eagerly, future
+        rows routed on arrival (``_route_refined``).  Byte-identity:
+        sub-buckets nest inside the parent range and emit in range
+        order, so the global sorted order is exactly preserved."""
+        acts = self.rewriter.claim_splits(depth)
+        acts = [a for a in acts
+                if int(a.params["bucket"]) not in refined]
+        if not acts or depth >= self.max_split_depth:
+            return
+        if writer is not None:
+            writer.flush()  # bucket piece lists must be final to reroute
+        for act in acts:
+            b = int(act.params["bucket"])
+            if b not in spill.buckets():
+                continue  # diagnosis about another spill at this depth
+            sample = _bucket_sample(spill, b, primary)
+            sub = _splitters_from_sample(
+                sample, int(act.params.get("fan", 8) or 8)
+            )
+            if len(sub) == 0:
+                continue  # single-valued: a range split cannot help
+            rec = {
+                "splitters": sub,
+                "spill": SpillDir(
+                    self.ctx.dictionary, root=self._spill_root()
+                ),
+                "extent": {},
+                "rows": 0,
+            }
+            for piece in spill.read_bucket_pieces(b):
+                self._route_refined(rec, piece, primary, depth)
+            spill.drop_bucket(b)
+            refined[b] = rec
+            self._emit(
+                "plan_rewrite", phase="applied", action="split_bucket",
+                rule=act.rule, subject=act.subject, bucket=b,
+                depth=depth, fan=int(len(sub)) + 1,
+            )
+
+    def _route_refined(self, rec, piece, primary, depth):
+        """Route one piece of a rewrite-split bucket into its sub-range
+        spill at ``depth + 1``, tracking exact sub-extents (the same
+        invariant phase 1 keeps for the parent buckets)."""
+        col = _sort_key_view(piece[primary])
+        bids = np.searchsorted(rec["splitters"], col, side="right")
+        rspill = rec["spill"]
+        for sb in np.unique(bids):
+            sel = bids == sb
+            vals = col[sel]
+            mn, mx = vals.min(), vals.max()
+            if int(sb) in rec["extent"]:
+                pmn, pmx = rec["extent"][int(sb)]
+                mn, mx = min(mn, pmn), max(mx, pmx)
+            rec["extent"][int(sb)] = (mn, mx)
+            sub = {c: v[sel] for c, v in piece.items()}
+            self.metrics.observe(
+                "partition_rows", int(sel.sum()), depth=depth + 1
+            )
+            b0 = rspill.bytes_written
+            n = rspill.append(int(sb), sub)
+            self.metrics.add("spill_bytes", rspill.bytes_written - b0)
+            self._emit("stream_spill", bucket=int(sb), rows=n,
+                       depth=depth + 1)
+            rec["rows"] += n
+
+    def _sort_buckets(self, node, spill, order, extent, keys, depth,
+                      refined=None):
         """Phase 2 of the external sort: per-bucket device sorts in
         key order, with read-ahead and a bounded dispatch window when
         pipelined."""
@@ -1657,6 +1801,11 @@ class StreamExecutor:
 
         def reads():
             for b in order:
+                if refined and b in refined:
+                    # rewrite-split: contents live in the sub-spill,
+                    # the driver recurses below (never read whole)
+                    yield b, refined[b]["rows"], None
+                    continue
                 rows = spill.bucket_rows(b)
                 # oversized buckets are re-split by the driver, which
                 # streams their pieces — don't read them whole ahead
@@ -1728,12 +1877,28 @@ class StreamExecutor:
                         yield out
                         spill.drop_bucket(b)
                     continue
-                # oversized: results must stay in key order, so the
-                # dispatch window drains before the re-split recursion
+                # refined or oversized: results must stay in key order,
+                # so the dispatch window drains before the recursion
                 if dsp is not None:
                     yield from committed(dsp.drain())
                 while inflight:
                     yield drain_one()
+                if refined and b in refined:
+                    # rewrite-split bucket: sub-ranges nest inside the
+                    # parent range, so emitting them in range order
+                    # here preserves the global sorted order exactly
+                    rec = refined[b]
+                    rorder = sorted(rec["spill"].buckets())
+                    if _pdesc:
+                        rorder = list(reversed(rorder))
+                    self._emit("stream_bucket_split", bucket=b,
+                               rows=rows, depth=depth, mode="rewrite",
+                               fanout=len(rorder))
+                    yield from self._sort_buckets(
+                        node, rec["spill"], rorder, rec["extent"],
+                        keys, depth + 1,
+                    )
+                    continue
                 if depth >= self.max_split_depth:
                     raise RuntimeError(
                         f"sort bucket {b} still holds {rows} rows at "
@@ -1818,33 +1983,103 @@ class StreamExecutor:
         lspill = SpillDir(self.ctx.dictionary, root=self._spill_root())
         rspill = SpillDir(self.ctx.dictionary, root=self._spill_root())
         writer = self._spill_writer()
+        # rewrite-split hot buckets: bucket -> (left sub-spill, right
+        # sub-spill), re-hashed at salt=depth+1 on BOTH sides so
+        # matching keys stay co-bucketed (split_bucket action claimed
+        # at chunk boundaries of either spill loop)
+        jrefined: Dict[int, Tuple[SpillDir, SpillDir]] = {}
         try:
             lscope = self._scope()
             rscope = self._scope()
             for t in (self._realize_table(x, ls, lscope)
                       for x in self._iter_base(ls)):
-                self._spill_by_hash(lspill, t, lk, depth, writer=writer)
+                if self.rewriter is not None:
+                    self._apply_join_splits(
+                        jrefined, lspill, rspill, lk, rk, writer, depth
+                    )
+                self._spill_by_hash(lspill, t, lk, depth, writer=writer,
+                                    refined=jrefined, side=0)
             for t in (self._realize_table(x, rs, rscope)
                       for x in self._iter_base(rs)):
-                self._spill_by_hash(rspill, t, rk, depth, writer=writer)
+                if self.rewriter is not None:
+                    self._apply_join_splits(
+                        jrefined, lspill, rspill, lk, rk, writer, depth
+                    )
+                self._spill_by_hash(rspill, t, rk, depth, writer=writer,
+                                    refined=jrefined, side=1)
             if writer is not None:
                 writer.flush()
             yield from self._join_buckets(
-                node, lspill, rspill, lk, rk, depth
+                node, lspill, rspill, lk, rk, depth,
+                refined=jrefined or None,
             )
         finally:
             if writer is not None:
                 writer.close(drain=False)
+            for l2, r2 in jrefined.values():
+                l2.cleanup()
+                r2.cleanup()
             lspill.cleanup()
             rspill.cleanup()
 
-    def _join_buckets(self, node, lspill, rspill, lk, rk, depth):
+    def _apply_join_splits(self, jrefined, lspill, rspill, lk, rk,
+                           writer, depth):
+        """Claim pending split_bucket rewrites for this depth and
+        re-hash the hot bucket into per-side sub-spills at depth+1 —
+        the SAME salt/fanout the oversized rehash path would use, so
+        the resulting per-key co-bucketing (and thus the join output)
+        is identical; only when the work happens changes."""
+        acts = self.rewriter.claim_splits(depth)
+        acts = [a for a in acts
+                if int(a.params["bucket"]) not in jrefined]
+        if not acts or depth >= self.max_split_depth:
+            return
+        if writer is not None:
+            writer.flush()  # bucket piece lists must be final to reroute
+        for act in acts:
+            b = int(act.params["bucket"])
+            l2 = SpillDir(self.ctx.dictionary, root=self._spill_root())
+            r2 = SpillDir(self.ctx.dictionary, root=self._spill_root())
+            jrefined[b] = (l2, r2)
+            if b in lspill.buckets():
+                for piece in lspill.read_bucket_pieces(b):
+                    self._spill_by_hash(l2, piece, lk, depth + 1)
+                lspill.drop_bucket(b)
+            if b in rspill.buckets():
+                for piece in rspill.read_bucket_pieces(b):
+                    self._spill_by_hash(r2, piece, rk, depth + 1)
+                rspill.drop_bucket(b)
+            self._emit(
+                "plan_rewrite", phase="applied", action="split_bucket",
+                rule=act.rule, subject=act.subject, bucket=b,
+                depth=depth,
+            )
+
+    def _join_buckets(self, node, lspill, rspill, lk, rk, depth,
+                      refined=None):
         jkind = node.params.get("join_kind", "inner")
         # shared per-side scopes: the pow2 capacity palette keeps
         # repeated bucket sizes on the same compiled join program
         lscope = self._scope()
         rscope = self._scope()
-        for b in sorted(set(lspill.buckets()) | set(rspill.buckets())):
+        allb = set(lspill.buckets()) | set(rspill.buckets())
+        if refined:
+            allb |= set(refined)
+        for b in sorted(allb):
+            if refined and b in refined:
+                # rewrite-split: both sides already re-hashed at
+                # depth+1 — join the sub-buckets in the parent's slot
+                # (exactly where the oversized rehash would emit them)
+                l2, r2 = refined[b]
+                rows2 = (
+                    sum(l2.bucket_rows(x) for x in l2.buckets())
+                    + sum(r2.bucket_rows(x) for x in r2.buckets())
+                )
+                self._emit("stream_bucket_split", bucket=b, rows=rows2,
+                           depth=depth, mode="rewrite")
+                yield from self._join_buckets(node, l2, r2, lk, rk,
+                                              depth + 1)
+                continue
             lrows = lspill.bucket_rows(b)
             rrows = rspill.bucket_rows(b)
             if lrows == 0 and jkind in ("inner", "left", "semi", "anti",
@@ -1926,7 +2161,8 @@ class StreamExecutor:
             self._emit("stream_bucket", bucket=b, depth=0, rows=rows)
             yield out
 
-    def _spill_by_hash(self, spill, table, keys, depth, writer=None):
+    def _spill_by_hash(self, spill, table, keys, depth, writer=None,
+                       refined=None, side=0):
         bids = _host_hash_buckets(
             table, keys, self.num_buckets, salt=depth,
             dictionary=self.ctx.dictionary,
@@ -1934,6 +2170,15 @@ class StreamExecutor:
         for b in np.unique(bids):
             sel = bids == b
             piece = {c: v[sel] for c, v in table.items()}
+            if refined and int(b) in refined:
+                # rewrite-split hot bucket: route straight into the
+                # per-side sub-spill at depth+1 (same salt the rehash
+                # resplit uses — co-bucketing is preserved)
+                self._spill_by_hash(
+                    refined[int(b)][side], piece, keys, depth + 1,
+                    writer=writer,
+                )
+                continue
             # per-partition row histogram = the skew signal
             # distribution-aware scheduling needs (PAPERS.md "Chasing
             # Similarity"); one sample per (bucket, piece)

@@ -335,6 +335,15 @@ class JobMetrics:
     tenants: Dict[str, Dict[str, Any]] = dataclasses.field(
         default_factory=dict
     )
+    # runtime plan rewriting (rewrite.controller): decisions folded
+    # from the diagnosis stream vs how many a driver actually honored
+    # at a safe application point, plus per-action decided counts
+    # (action name -> count) for the jobview rewrite panel
+    rewrites_decided: int = 0
+    rewrites_applied: int = 0
+    rewrite_actions: Dict[str, int] = dataclasses.field(
+        default_factory=dict
+    )
 
     @property
     def driver_cpu_fraction(self) -> float:
@@ -391,6 +400,8 @@ class JobMetrics:
             "queries_completed": self.queries_completed,
             "queries_rejected": self.queries_rejected,
             "result_cache_hits": self.result_cache_hits,
+            "rewrites_decided": self.rewrites_decided,
+            "rewrites_applied": self.rewrites_applied,
         }
 
     def _tenant(self, ev: Dict[str, Any]) -> Dict[str, Any]:
@@ -521,6 +532,15 @@ class JobMetrics:
             elif kind == "tenant_quota":
                 # state TRANSITIONS, so the last one is the live state
                 m._tenant(ev)["quota_state"] = ev.get("state", "ok")
+            elif kind == "plan_rewrite":
+                act = str(ev.get("action", "?"))
+                if ev.get("phase") == "applied":
+                    m.rewrites_applied += 1
+                else:
+                    m.rewrites_decided += 1
+                    m.rewrite_actions[act] = (
+                        m.rewrite_actions.get(act, 0) + 1
+                    )
             elif kind == "combine_tree_degrade":
                 m.degraded_ranges = max(
                     m.degraded_ranges, int(ev.get("degraded", 0) or 0)

@@ -126,6 +126,41 @@ def flat_accounting(
     }
 
 
+def resolve_window(
+    config_window: int,
+    num_partitions: int,
+    bucket_bytes: int,
+    budget_bytes: int,
+    hint: "int | None" = None,
+) -> int:
+    """The effective staged-exchange window for one compilation.
+
+    The policy hook behind ``config.exchange_window``:
+
+    - ``config_window >= 0`` — the static knob is an override; it is
+      returned verbatim (0 = flat).
+    - ``config_window == -1`` — auto.  An explicit ``hint`` (the
+      runtime rewriter's ``retune_exchange``) wins; otherwise pick
+      flat while the whole ``P * bucket_bytes`` send buffer fits
+      ``budget_bytes``, else the widest window whose ``O(window * B)``
+      staging footprint does (clamped to ``[1, P-1]``).
+
+    Pure and deterministic: equal inputs always resolve equally, so
+    the compile-cache key may include the resolved value without
+    fragmenting the palette.
+    """
+    if config_window >= 0:
+        return int(config_window)
+    if hint is not None:
+        return max(0, min(int(hint), max(num_partitions - 1, 0)))
+    if num_partitions <= 1:
+        return 0
+    block = max(1, int(bucket_bytes))
+    if num_partitions * block <= budget_bytes:
+        return 0  # flat fits: one collective beats any staging
+    return max(1, min(int(budget_bytes // block), num_partitions - 1))
+
+
 def plan_exchange(
     num_partitions: int, window: int, dcn_slices: int = 1
 ) -> ExchangeSchedule:

@@ -16,11 +16,17 @@ fingerprints differently and misses cleanly (likewise a vocabulary
 widening that moves the plan to a new operand tier changes the graph
 key — a recompute, never a stale hit).
 
-Eviction is LRU by byte budget.  Hits hand back per-client array
-copies so a caller mutating its result cannot poison the cached
-master.  NOT thread-safe on its own: the service driver thread is the
-only caller (lookups, inserts, and eviction all happen between
-dispatches).
+Eviction is LRU by byte budget.  ADMISSION is cost-aware (config
+``serve_cache_admission="cost"``): an insert carrying its observed
+compute seconds is rejected when the query is cheaper to recompute
+than its bytes are worth keeping — ``cost_s < min_sec_per_gb *
+nbytes/1e9`` — so a burst of big cheap scans cannot evict small
+expensive aggregates.  ``admission="all"`` restores unconditional
+insert (the differential baseline); inserts without a cost always
+admit.  Hits hand back per-client array copies so a caller mutating
+its result cannot poison the cached master.  NOT thread-safe on its
+own: the service driver thread is the only caller (lookups, inserts,
+and eviction all happen between dispatches).
 """
 
 from __future__ import annotations
@@ -45,8 +51,15 @@ def _copy_table(table: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
 class ResultCache:
     """LRU-by-bytes map: (tenant, fingerprint) -> host result table."""
 
-    def __init__(self, budget_bytes: int):
+    def __init__(
+        self,
+        budget_bytes: int,
+        admission: str = "all",
+        min_sec_per_gb: float = 0.5,
+    ):
         self.budget = int(budget_bytes)
+        self.admission = str(admission)
+        self.min_sec_per_gb = float(min_sec_per_gb)
         # key -> (master table, nbytes, tenant epoch at insert)
         self._entries: "OrderedDict[Tuple, Tuple[Dict, int, int]]" = (
             OrderedDict()
@@ -54,6 +67,7 @@ class ResultCache:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        self.rejected = 0
         self.bytes = 0
 
     def __len__(self) -> int:
@@ -76,12 +90,28 @@ class ResultCache:
         self.hits += 1
         return _copy_table(ent[0])
 
-    def put(self, key, table: Dict[str, np.ndarray], epoch: int) -> None:
+    def put(
+        self,
+        key,
+        table: Dict[str, np.ndarray],
+        epoch: int,
+        cost_s: Optional[float] = None,
+    ) -> None:
+        """Insert; ``cost_s`` is the observed compute seconds the entry
+        would save on a hit (the ``query_complete`` wall time).  Under
+        cost admission an entry must be worth its bytes to enter."""
         if self.budget <= 0 or key is None:
             return
         nbytes = table_nbytes(table)
         if nbytes > self.budget:
             return  # would evict everything and still not fit
+        if (
+            self.admission == "cost"
+            and cost_s is not None
+            and cost_s < self.min_sec_per_gb * (nbytes / 1e9)
+        ):
+            self.rejected += 1
+            return
         if key in self._entries:
             self._drop(key)
         self._entries[key] = (_copy_table(table), nbytes, epoch)
@@ -102,4 +132,5 @@ class ResultCache:
             "hits": self.hits,
             "misses": self.misses,
             "evictions": self.evictions,
+            "rejected": self.rejected,
         }

@@ -168,7 +168,15 @@ class QueryService:
         self.ctx = ctx
         self.config = ctx.config
         self.events = ctx.events
-        self._cache = ResultCache(self.config.serve_result_cache_bytes)
+        self._cache = ResultCache(
+            self.config.serve_result_cache_bytes,
+            admission=getattr(
+                self.config, "serve_cache_admission", "all"
+            ),
+            min_sec_per_gb=getattr(
+                self.config, "serve_cache_min_sec_per_gb", 0.5
+            ),
+        )
         self._window = DispatchWindow(
             depth=self.config.dispatch_depth, events=self.events,
             name="serve",
@@ -422,7 +430,12 @@ class QueryService:
         with self._lock:
             item, key = self._inflight_items.pop(tag)
         if error is None and key is not None:
-            self._cache.put(key, value, item.epoch)
+            # observed compute seconds drive cost-aware admission: a
+            # cheap-to-recompute result must not displace expensive ones
+            self._cache.put(
+                key, value, item.epoch,
+                cost_s=time.monotonic() - item.t_submit,
+            )
         if isinstance(error, BaseException) and not isinstance(
             error, Exception
         ):

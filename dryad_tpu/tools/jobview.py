@@ -1114,6 +1114,38 @@ def render_health(events: List[Dict[str, Any]]) -> str:
     return "\n".join(lines)
 
 
+def render_rewrites(events: List[Dict[str, Any]]) -> str:
+    """Runtime plan-rewrite panel: the ``plan_rewrite`` audit trail
+    (``rewrite.controller``), one line per decision with whether a
+    driver applied it at a safe boundary — the closed diagnosis→replan
+    loop made visible.  Empty when nothing was rewritten."""
+    rws = [e for e in events if e.get("kind") == "plan_rewrite"]
+    if not rws:
+        return ""
+    applied = {
+        (e.get("action"), e.get("subject"), e.get("bucket"))
+        for e in rws if e.get("phase") == "applied"
+    }
+    lines = ["-- plan rewrites --"]
+    for e in rws:
+        if e.get("phase") != "decided":
+            continue
+        tag = (e.get("action"), e.get("subject"), e.get("bucket"))
+        detail = " ".join(
+            f"{k}={e[k]}"
+            for k in ("bucket", "depth", "fan", "boost", "mode",
+                      "tree", "window")
+            if k in e
+        )
+        lines.append(
+            f"  {e.get('action')} <- {e.get('rule')}"
+            + (f" ({e.get('subject')})" if e.get("subject") else "")
+            + (f": {detail}" if detail else "")
+            + ("  [applied]" if tag in applied else "  [pending]")
+        )
+    return "\n".join(lines)
+
+
 def render_tenants(events: List[Dict[str, Any]]) -> str:
     """Serving-tier panel: one line per tenant (queries in flight,
     cache hits, quota state) folded from the ``query_*`` /
@@ -1150,11 +1182,13 @@ def _render_stream(events: List[Dict[str, Any]]) -> str:
     attr = render_attribution(events)
     tenants = render_tenants(events)
     health = render_health(events)
+    rewrites = render_rewrites(events)
     return (
         text
         + ("\n" + attr if attr else "")
         + ("\n\n" + tenants if tenants else "")
         + ("\n\n" + health if health else "")
+        + ("\n\n" + rewrites if rewrites else "")
     )
 
 

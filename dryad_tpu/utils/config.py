@@ -191,8 +191,17 @@ class DryadConfig:
     # hops staged first, all DCN-crossing traffic batched into one
     # round per remote slice (arxiv 2112.01075's decomposition over the
     # combinetree mesh model).  0 = the flat single-collective path,
-    # kept as the differential baseline.
+    # kept as the differential baseline; -1 = auto policy — the
+    # executor picks flat while the estimated all_to_all footprint
+    # fits exchange_hbm_budget_mb, else the widest window that does
+    # (plan.xchgplan.resolve_window; the runtime rewriter can pin the
+    # auto choice via RewriteController.retune_exchange).
     exchange_window: int = _env_int("DRYAD_TPU_EXCHANGE_WINDOW", 0)
+    # HBM the auto exchange-window policy may spend on one exchange's
+    # staging buffers (only read when exchange_window == -1).
+    exchange_hbm_budget_mb: int = _env_int(
+        "DRYAD_TPU_EXCHANGE_HBM_BUDGET_MB", 256
+    )
     # Stage-level fan-out adaptation (DrDynamicRangeDistributor.cpp:
     # 54-110: consumer copies = observed size / data-per-vertex): when a
     # stage's input row count is STATICALLY bounded at or below
@@ -370,6 +379,23 @@ class DryadConfig:
     serve_drr_quantum_bytes: int = _env_int(
         "DRYAD_TPU_SERVE_DRR_QUANTUM", 1 << 22
     )
+    # Result-cache admission policy: "cost" admits an entry only when
+    # its observed recompute time amortizes its bytes (at least
+    # serve_cache_min_sec_per_gb seconds of saved work per cached GB),
+    # so cheap-but-large results cannot evict expensive ones; "all" is
+    # the legacy unconditional insert.
+    serve_cache_admission: str = os.environ.get(
+        "DRYAD_TPU_SERVE_CACHE_ADMISSION", "cost"
+    )
+    serve_cache_min_sec_per_gb: float = _env_float(
+        "DRYAD_TPU_SERVE_CACHE_MIN_SEC_PER_GB", 0.5
+    )
+    # Runtime plan rewriting (dryad_tpu.rewrite): the controller taps
+    # the event stream, folds diagnosis events into RewriteActions,
+    # and the drivers apply them at chunk/window boundaries.  Requires
+    # obs_diagnosis; every rewrite is byte-identity-preserving (the
+    # fuzz-differential suite runs this knob on vs off).
+    plan_rewrite: bool = _env_bool("DRYAD_TPU_PLAN_REWRITE", True)
 
     def __post_init__(self) -> None:
         self.validate()
@@ -416,8 +442,12 @@ class DryadConfig:
             raise ValueError("device_cache_bytes must be >= 0")
         if self.overflow_sync_depth < 1:
             raise ValueError("overflow_sync_depth must be >= 1")
-        if self.exchange_window < 0:
-            raise ValueError("exchange_window must be >= 0")
+        if self.exchange_window < -1:
+            raise ValueError(
+                "exchange_window must be >= 0, or -1 for the auto policy"
+            )
+        if self.exchange_hbm_budget_mb < 1:
+            raise ValueError("exchange_hbm_budget_mb must be >= 1")
         if self.tail_fanout_rows < 0:
             raise ValueError("tail_fanout_rows must be >= 0")
         if self.tail_rows_per_partition < 1:
@@ -474,6 +504,12 @@ class DryadConfig:
             raise ValueError("serve_result_cache_bytes must be >= 0")
         if self.serve_drr_quantum_bytes < 1:
             raise ValueError("serve_drr_quantum_bytes must be >= 1")
+        if self.serve_cache_admission not in ("cost", "all"):
+            raise ValueError(
+                "serve_cache_admission must be 'cost' or 'all'"
+            )
+        if self.serve_cache_min_sec_per_gb < 0:
+            raise ValueError("serve_cache_min_sec_per_gb must be >= 0")
 
 
 # Every ``DryadConfig`` field, one line each — THE documented key
@@ -516,7 +552,10 @@ CONFIG_KEYS = {
     "rows_per_vertex": "target rows per independent vertex task",
     "plan_fuse": "whole-DAG SPMD fusion into one dispatched program",
     "overflow_sync_depth": "speculative dispatches per overflow readback",
-    "exchange_window": "staged-exchange buckets per round (0 = flat all_to_all)",
+    "exchange_window":
+        "staged-exchange buckets per round (0 = flat, -1 = auto policy)",
+    "exchange_hbm_budget_mb":
+        "staging-buffer HBM budget for the auto exchange-window policy",
     "tail_fanout_rows": "static row bound enabling tail fan-out; 0 off",
     "tail_rows_per_partition": "rows per partition after tail fan-out",
     "stream_bucket_rows": "max rows per phase-2 bucket before re-split",
@@ -548,4 +587,10 @@ CONFIG_KEYS = {
     "serve_max_bytes": "per-tenant admitted host-input byte budget; 0 off",
     "serve_result_cache_bytes": "plan-fingerprint result cache; 0 off",
     "serve_drr_quantum_bytes": "input bytes per fair-share cost unit",
+    "serve_cache_admission":
+        "result-cache admission: 'cost' (amortizing only) or 'all'",
+    "serve_cache_min_sec_per_gb":
+        "cost admission floor: saved seconds per cached GB",
+    "plan_rewrite": "runtime plan rewriter (dryad_tpu.rewrite); "
+                    "diagnosis-driven, byte-identity-preserving",
 }

@@ -36,7 +36,7 @@ def test_registry_has_every_expected_rule():
         "layer-imports", "placement-snapshot", "coded-linearity",
         "event-schema", "kernel-determinism", "recompile-hazard",
         "span-discipline", "config-key", "collective-order",
-        "sync-in-dispatch-loop", "serve-layering",
+        "sync-in-dispatch-loop", "serve-layering", "rewrite-layering",
     }
     assert expected == set(all_checkers())
     assert {"bad-suppression", "unused-suppression"} <= set(known_rules())

@@ -989,3 +989,84 @@ def test_serve_layering_fires(path, old, new):
     _assert_fires(
         _mutate(SERVE_FIXTURE, path, old, new), "serve-layering"
     )
+
+
+# -- rewrite-layering --------------------------------------------------------
+
+OUTOFCORE = "dryad_tpu/exec/outofcore.py"
+CONTROLLER = "dryad_tpu/rewrite/controller.py"
+
+OUTOFCORE_CLEAN = '''\
+import numpy as np
+
+
+class StreamExecutor:
+    def __init__(self, ctx):
+        self.rewriter = getattr(ctx, "rewriter", None)
+'''
+
+CONTROLLER_CLEAN = '''\
+import threading
+
+from dryad_tpu.exec.events import EVENT_KINDS
+from dryad_tpu.obs.diagnose import DiagnosisEngine
+from dryad_tpu.rewrite.actions import RewriteAction
+
+
+class RewriteController:
+    def __init__(self, config=None, events=None):
+        self.events = events
+        self._lock = threading.Lock()
+'''
+
+REWRITE_FIXTURE = {OUTOFCORE: OUTOFCORE_CLEAN, CONTROLLER: CONTROLLER_CLEAN}
+
+
+def test_rewrite_layering_clean_fixture():
+    assert _rules(REWRITE_FIXTURE, "rewrite-layering") == []
+
+
+@pytest.mark.parametrize(
+    "path,old,new",
+    [
+        # the engine importing the policy layer inverts the contract:
+        # drivers hold the controller by handle only
+        (
+            OUTOFCORE,
+            "import numpy as np",
+            "import numpy as np\n"
+            "from dryad_tpu.rewrite.controller import RewriteController",
+        ),
+        # direct jax makes the policy fold a device client
+        (
+            CONTROLLER,
+            "import threading",
+            "import threading\n\nimport jax",
+        ),
+        # reaching into worker control (cluster/) from policy code
+        (
+            CONTROLLER,
+            "from dryad_tpu.obs.diagnose import DiagnosisEngine",
+            "from dryad_tpu.cluster.localjob import LocalJobSubmission",
+        ),
+        # exec machinery beyond the schema registry is off limits
+        (
+            CONTROLLER,
+            "from dryad_tpu.exec.events import EVENT_KINDS",
+            "from dryad_tpu.exec.executor import GraphExecutor",
+        ),
+        # anchor drift: the scan must notice the controller moving
+        (
+            CONTROLLER,
+            "class RewriteController:",
+            "class ReplanController:",
+        ),
+    ],
+    ids=["engine-imports-rewrite", "rewrite-imports-jax",
+         "rewrite-imports-cluster", "rewrite-imports-exec-machinery",
+         "anchor-drift"],
+)
+def test_rewrite_layering_fires(path, old, new):
+    _assert_fires(
+        _mutate(REWRITE_FIXTURE, path, old, new), "rewrite-layering"
+    )

@@ -1,0 +1,46 @@
+"""Repo hygiene guards.
+
+Flight-recorder crash dumps (``blackbox-<pid>.json``) land in the
+process cwd when no dump dir is configured — test runs and local
+experiments keep scattering them into the repo root, and they have
+been committed by accident more than once.  ``.gitignore`` keeps NEW
+strays out of ``git status``; this test keeps them out of the INDEX —
+an ignore rule is silent about files that were already ``git add``-ed.
+"""
+
+import fnmatch
+import os
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tracked_files():
+    out = subprocess.run(
+        ["git", "ls-files"], cwd=REPO, capture_output=True, text=True
+    )
+    if out.returncode != 0:
+        pytest.skip("not a git checkout")
+    return out.stdout.splitlines()
+
+
+def test_no_tracked_blackbox_dumps():
+    strays = [
+        f for f in _tracked_files()
+        if fnmatch.fnmatch(os.path.basename(f), "blackbox-*.json")
+    ]
+    assert not strays, (
+        f"flight-recorder dumps are tracked: {strays} — "
+        "git rm them; dumps are debris, never source"
+    )
+
+
+def test_blackbox_dumps_gitignored():
+    with open(os.path.join(REPO, ".gitignore")) as f:
+        rules = [ln.strip() for ln in f if ln.strip()]
+    assert "blackbox-*.json" in rules, (
+        ".gitignore lost the blackbox-*.json rule that keeps "
+        "crash dumps out of the repo root"
+    )

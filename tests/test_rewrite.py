@@ -1,0 +1,426 @@
+"""Runtime plan rewriting (dryad_tpu.rewrite): the diagnosis→replan
+loop.
+
+Controller unit tests: each diagnosis rule folds into its action with
+the documented dedup/claim semantics.  Integration tests: the three
+rules each demonstrably trigger a DISTINCT rewrite through the real
+drivers — partition_skew splits a hot bucket mid-stream, overflow_loop
+pre-widens the next dispatch's boost tier, combine_thrash pins/flips
+the streaming-combine strategy — and every rewritten run produces the
+same bytes the static plan would have (total-order sorts compare
+byte-for-byte in place; unordered join/group output compares as
+canonical row multisets, the same equality the engine guarantees).
+"""
+
+import numpy as np
+import pytest
+
+from dryad_tpu import DryadConfig, DryadContext
+from dryad_tpu.exec.events import EventLog
+from dryad_tpu.rewrite import RewriteController
+
+
+def _diag(rule, evidence, **kw):
+    ev = {"kind": "diagnosis", "rule": rule, "evidence": evidence}
+    ev.update(kw)
+    return ev
+
+
+def _skew_ev(bucket=3, depth=0, rows=9000, ratio=6.0):
+    return {
+        "source": "stream_spill",
+        "subject": f"spill depth={depth}",
+        "buckets": 8,
+        "hot_bucket": bucket,
+        "hot_rows": rows,
+        "mean_rows": rows / ratio,
+        "ratio": ratio,
+    }
+
+
+# -- controller units --------------------------------------------------------
+
+
+def test_skew_folds_to_split_and_claim_pops_once():
+    c = RewriteController()
+    c.observe(_diag("partition_skew", _skew_ev(bucket=3, depth=0)))
+    acts = c.claim_splits(0)
+    assert len(acts) == 1
+    a = acts[0]
+    assert a.action == "split_bucket" and a.rule == "partition_skew"
+    assert a.params["bucket"] == 3 and a.params["depth"] == 0
+    assert a.params["fan"] >= 4
+    # claimed: gone
+    assert c.claim_splits(0) == []
+    # re-diagnosis of the same (depth, bucket) is deduplicated
+    c.observe(_diag("partition_skew", _skew_ev(bucket=3, depth=0)))
+    assert c.claim_splits(0) == []
+    # a different bucket is a fresh decision
+    c.observe(_diag("partition_skew", _skew_ev(bucket=5, depth=0)))
+    assert [x.params["bucket"] for x in c.claim_splits(0)] == [5]
+
+
+def test_skew_ignores_histogram_source_and_deep_splits():
+    c = RewriteController()
+    # the metrics-histogram fold has no concrete bucket to split
+    c.observe(_diag("partition_skew", {
+        "source": "metrics", "subject": "hist:depth=0", "ratio": 9.0,
+    }))
+    assert c.claim_splits(0) == []
+    # at max split depth the driver could not recurse anyway
+    c.observe(_diag("partition_skew", _skew_ev(bucket=1, depth=3)))
+    assert c.claim_splits(3) == []
+
+
+def test_split_fan_scales_with_ratio_and_clamps():
+    c = RewriteController()
+    c.observe(_diag("partition_skew", _skew_ev(bucket=0, ratio=4.0)))
+    c.observe(_diag("partition_skew", _skew_ev(bucket=1, ratio=100.0)))
+    c.observe(_diag("partition_skew", _skew_ev(bucket=2, ratio=1e9)))
+    by_bucket = {
+        a.params["bucket"]: a.params["fan"] for a in c.claim_splits(0)
+    }
+    assert by_bucket[0] == 4
+    assert by_bucket[1] >= 16
+    assert by_bucket[2] == 64  # clamped
+
+
+def test_overflow_folds_to_monotonic_boost_floor():
+    c = RewriteController()
+    assert c.boost_floor("s1:group_by") == 1
+    c.observe(_diag("overflow_loop", {"overflows": 2, "boost": 1},
+                    name="s1:group_by"))
+    assert c.boost_floor("s1:group_by") == 2
+    # floors only rise
+    c.observe(_diag("overflow_loop", {"overflows": 3, "boost": 2},
+                    name="s1:group_by"))
+    assert c.boost_floor("s1:group_by") == 4
+    c.observe(_diag("overflow_loop", {"overflows": 4, "boost": 1},
+                    name="s1:group_by"))
+    assert c.boost_floor("s1:group_by") == 4
+    # capped at the palette bound
+    for b in (8, 16, 64, 1024):
+        c.observe(_diag("overflow_loop", {"overflows": 5, "boost": b},
+                        name="s1:group_by"))
+    assert c.boost_floor("s1:group_by") == 16  # 2**max_shuffle_retries
+    assert c.boost_floor("other") == 1
+
+
+def test_thrash_pins_host_and_flips_tree_once():
+    c = RewriteController()
+    assert c.combine_pin() is None and c.combine_tree_override() is None
+    c.observe(_diag("combine_thrash", {
+        "flips": 3, "recent_modes": ["host", "device", "host", "device"],
+    }))
+    assert c.combine_pin() == "host"
+    assert c.combine_tree_override() is True
+    n = len(c.actions())
+    c.observe(_diag("combine_thrash", {"flips": 4, "recent_modes": []}))
+    assert len(c.actions()) == n  # idempotent
+
+
+def test_retune_exchange_sets_hint_and_audits():
+    c = RewriteController()
+    assert c.exchange_window_hint() is None
+    c.retune_exchange(3)
+    assert c.exchange_window_hint() == 3
+    c.retune_exchange(-5)  # clamped
+    assert c.exchange_window_hint() == 0
+    kinds = [a["action"] for a in c.actions()]
+    assert kinds == ["retune_exchange", "retune_exchange"]
+
+
+def test_decided_events_emitted_and_observe_never_raises():
+    log = EventLog(None)
+    c = RewriteController(events=log)
+    log.add_tap(c.observe)  # tapping its own sink must not loop
+    c.observe(_diag("partition_skew", _skew_ev()))
+    c.observe(_diag("overflow_loop", {"boost": 1}, name="s"))
+    c.observe(_diag("combine_thrash", {"flips": 3}))
+    evs = [e for e in log.events() if e["kind"] == "plan_rewrite"]
+    assert [e["phase"] for e in evs] == ["decided"] * 4
+    assert {e["action"] for e in evs} == {
+        "split_bucket", "prewiden_palette", "pin_combine", "flip_combine"
+    }
+    # malformed events never raise out of the tap
+    c.observe({"kind": "diagnosis"})
+    c.observe({"kind": "diagnosis", "rule": "partition_skew",
+               "evidence": {"source": "stream_spill",
+                            "subject": "garbage", "hot_bucket": "x"}})
+    c.observe({})
+
+
+def test_reset_clears_all_decisions():
+    c = RewriteController()
+    c.observe(_diag("partition_skew", _skew_ev()))
+    c.observe(_diag("overflow_loop", {"boost": 2}, name="s"))
+    c.observe(_diag("combine_thrash", {"flips": 3}))
+    c.retune_exchange(2)
+    c.reset()
+    assert c.claim_splits(0) == []
+    assert c.boost_floor("s") == 1
+    assert c.combine_pin() is None
+    assert c.exchange_window_hint() is None
+
+
+# -- integration: the three rules drive distinct rewrites --------------------
+
+
+def _mk_ctx(**kw):
+    cfg = DryadConfig(
+        stream_bucket_rows=kw.pop("bucket_rows", 4000),
+        stream_combine_rows=kw.pop("combine_rows", 2000),
+        stream_buckets=kw.pop("buckets", 8),
+        diagnose_cooldown_s=0.0,
+        **kw,
+    )
+    return DryadContext(num_partitions_=8, config=cfg)
+
+
+def _evs(ctx, kind):
+    return [e for e in ctx.executor.events.events() if e["kind"] == kind]
+
+
+def _drift_sort_chunks(seed=7, nchunks=9, n=1500):
+    """First chunk uniform over [0, 1000) — that's what the splitters
+    sample — then the distribution collapses onto [0, 20): the static
+    range partition goes hot in its lowest bucket."""
+    rng = np.random.default_rng(seed)
+    chunks = [{"x": rng.integers(0, 1000, n).astype(np.int64),
+               "v": rng.random(n).astype(np.float32)}]
+    for _ in range(nchunks - 1):
+        chunks.append({"x": rng.integers(0, 20, n).astype(np.int64),
+                       "v": rng.random(n).astype(np.float32)})
+    return chunks
+
+
+def _sorted_stream(ctx, chunks):
+    return (
+        ctx.from_stream(
+            iter([{k: v.copy() for k, v in c.items()} for c in chunks])
+        )
+        .order_by(["x", "v"])  # total order: ties cannot hide reorders
+        .collect()
+    )
+
+
+def test_skew_rewrite_splits_sort_bucket_byte_identical(mesh8):
+    chunks = _drift_sort_chunks()
+    on = _mk_ctx(plan_rewrite=True)
+    out_on = _sorted_stream(on, chunks)
+    off = _mk_ctx(plan_rewrite=False)
+    out_off = _sorted_stream(off, chunks)
+    assert set(out_on) == set(out_off)
+    for c in out_on:  # byte-identical under a total order
+        assert out_on[c].dtype == out_off[c].dtype
+        assert out_on[c].tobytes() == out_off[c].tobytes(), c
+    decided = [e for e in _evs(on, "plan_rewrite")
+               if e["phase"] == "decided"]
+    applied = [e for e in _evs(on, "plan_rewrite")
+               if e["phase"] == "applied"]
+    assert any(e["action"] == "split_bucket" for e in decided)
+    assert any(e["action"] == "split_bucket" for e in applied)
+    assert any(e.get("mode") == "rewrite"
+               for e in _evs(on, "stream_bucket_split"))
+    # the off run must not rewrite anything
+    assert _evs(off, "plan_rewrite") == []
+    # audit trail mirrors the event stream
+    assert on.rewriter is not None and len(on.rewriter.actions()) >= 1
+    assert off.rewriter is None
+
+
+def _canonical(table):
+    names = sorted(table)
+    order = np.lexsort([np.asarray(table[n]) for n in names])
+    return {n: np.asarray(table[n])[order] for n in names}
+
+
+def test_skew_rewrite_splits_join_bucket_same_rows(mesh8):
+    """The grace join's application point: a pending split_bucket claim
+    is applied mid-spill by re-hashing both sides at the next salt —
+    co-bucketing and the joined row multiset are exactly preserved.
+    (The natural partition_skew trigger is exercised by the sort test;
+    a single hot join key is NOT naturally splittable — rehashing
+    cannot separate one key — so here the decision is pre-seeded.)"""
+    rng = np.random.default_rng(11)
+
+    def chunks(side):
+        return [
+            {"k": rng.integers(0, 20000, 1200).astype(np.int64),
+             side: rng.integers(0, 1000, 1200).astype(np.int32)}
+            for _ in range(8)
+        ]
+
+    L, R = chunks("a"), chunks("b")
+
+    def run(rw):
+        ctx = _mk_ctx(plan_rewrite=rw)
+        if rw:  # decision lands before the stream starts spilling
+            ctx.rewriter.observe(
+                _diag("partition_skew", _skew_ev(bucket=0, depth=0))
+            )
+            ctx.rewriter.observe(
+                _diag("partition_skew", _skew_ev(bucket=5, depth=0))
+            )
+        q = ctx.from_stream(
+            iter([{k: v.copy() for k, v in c.items()} for c in L])
+        ).join(
+            ctx.from_stream(
+                iter([{k: v.copy() for k, v in c.items()} for c in R])
+            ),
+            ["k"], ["k"],
+        )
+        return ctx, q.collect()
+
+    on, out_on = run(True)
+    off, out_off = run(False)
+    a, b = _canonical(out_on), _canonical(out_off)
+    assert set(a) == set(b) and len(a["k"]) == len(b["k"])
+    for c in a:  # identical row multiset, bytes and all
+        assert a[c].tobytes() == b[c].tobytes(), c
+    applied = [e for e in _evs(on, "plan_rewrite")
+               if e["phase"] == "applied"]
+    assert any(e["action"] == "split_bucket" for e in applied)
+    assert _evs(off, "plan_rewrite") == []
+
+
+def test_overflow_rewrite_prewidens_next_dispatch(mesh8):
+    ctx = DryadContext(
+        num_partitions_=8,
+        config=DryadConfig(shuffle_slack=1.0, diagnose_cooldown_s=0.0),
+    )
+    n = 4096
+
+    def run():
+        i0 = len(ctx.executor.events.events())
+        # keys start at -1: keeps the int auto-dense rewrite off so the
+        # shuffling path (and its overflow) actually runs
+        out = ctx.from_arrays(
+            {"k": np.arange(n, dtype=np.int32) - 1}
+        ).group_by("k", {"c": ("count", None)}).collect()
+        assert len(out["k"]) == n
+        return ctx.executor.events.events()[i0:]
+
+    first = run()
+    over = [e for e in first if e["kind"] == "stage_overflow"]
+    assert over, "fixture no longer overflows; tighten slack"
+    name = over[0]["name"]
+    # 2+ overflows of one stage name -> overflow_loop -> boost floor
+    runs = [first]
+    for _ in range(3):
+        if ctx.rewriter.boost_floor(name) > 1:
+            break
+        runs.append(run())
+    assert ctx.rewriter.boost_floor(name) >= 2
+    last = run()
+    starts = [e for e in last
+              if e["kind"] == "stage_start" and e["name"] == name]
+    assert starts and starts[0]["boost"] >= 2  # born pre-widened
+    assert not any(e["kind"] == "stage_overflow" and e["name"] == name
+                   for e in last)
+    assert any(
+        e["kind"] == "plan_rewrite" and e["phase"] == "applied"
+        and e["action"] == "prewiden_palette" and e["subject"] == name
+        for e in ctx.executor.events.events()
+    )
+
+
+def _thrash(ctx):
+    """Drive the diagnosis engine's mode-flip fold with the events the
+    flat combiner would emit while oscillating."""
+    for mode in ("host", "device", "host", "device", "host"):
+        ctx.events.emit("stream_combine_policy", mode=mode, chunks=1)
+
+
+def test_thrash_rewrite_pins_flat_combine_to_host(mesh8):
+    # "first" agg forces the flat path regardless of tree overrides
+    ctx = _mk_ctx(plan_rewrite=True, combine_tree=False,
+                  stream_host_reprobe=2)
+    _thrash(ctx)
+    assert ctx.rewriter.combine_pin() == "host"
+    rng = np.random.default_rng(3)
+    chunks = [
+        {"k": rng.integers(0, 50, 1200).astype(np.int32),
+         "v": rng.random(1200).astype(np.float32)}
+        for _ in range(4)
+    ]
+    out = ctx.from_stream(
+        iter([{k: v.copy() for k, v in c.items()} for c in chunks])
+    ).group_by("k", {"s": ("sum", "v"), "f": ("first", "v")}).collect()
+    allk = np.concatenate([c["k"] for c in chunks])
+    assert set(out["k"].tolist()) == set(np.unique(allk).tolist())
+    pol = _evs(ctx, "stream_combine_policy")
+    assert any(e.get("pinned") for e in pol if e["mode"] == "host")
+    assert not any(e.get("reprobe") for e in pol)  # pin ended the churn
+    assert any(
+        e["action"] == "pin_combine" and e["phase"] == "applied"
+        for e in _evs(ctx, "plan_rewrite")
+    )
+
+
+def test_thrash_rewrite_flips_strategy_to_tree(mesh8):
+    ctx = _mk_ctx(plan_rewrite=True, combine_tree=False)
+    _thrash(ctx)
+    assert ctx.rewriter.combine_tree_override() is True
+    rng = np.random.default_rng(4)
+    chunks = [
+        {"k": rng.integers(0, 50, 1200).astype(np.int32),
+         "v": rng.random(1200).astype(np.float32)}
+        for _ in range(4)
+    ]
+    out = ctx.from_stream(
+        iter([{k: v.copy() for k, v in c.items()} for c in chunks])
+    ).group_by("k", {"s": ("sum", "v")}).collect()
+    allk = np.concatenate([c["k"] for c in chunks])
+    allv = np.concatenate([c["v"] for c in chunks])
+    got = dict(zip(out["k"].tolist(), out["s"].tolist()))
+    for k in np.unique(allk):
+        assert np.isclose(got[int(k)], allv[allk == k].sum(), rtol=1e-4)
+    assert any(
+        e["action"] == "flip_combine" and e["phase"] == "applied"
+        and e["tree"] is True
+        for e in _evs(ctx, "plan_rewrite")
+    )
+
+
+# -- folds & panels ----------------------------------------------------------
+
+
+def test_jobmetrics_folds_rewrite_counts():
+    from dryad_tpu.obs.metrics import JobMetrics
+
+    evs = [
+        {"kind": "plan_rewrite", "phase": "decided",
+         "action": "split_bucket", "rule": "partition_skew"},
+        {"kind": "plan_rewrite", "phase": "decided",
+         "action": "prewiden_palette", "rule": "overflow_loop"},
+        {"kind": "plan_rewrite", "phase": "applied",
+         "action": "split_bucket", "rule": "partition_skew"},
+    ]
+    m = JobMetrics.from_events(evs)
+    assert m.rewrites_decided == 2 and m.rewrites_applied == 1
+    assert m.rewrite_actions == {"split_bucket": 1, "prewiden_palette": 1}
+    attr = m.attribution()
+    assert attr["rewrites_decided"] == 2
+    assert attr["rewrites_applied"] == 1
+
+
+def test_jobview_rewrite_panel():
+    from dryad_tpu.tools.jobview import render_rewrites
+
+    evs = [
+        {"kind": "plan_rewrite", "phase": "decided",
+         "action": "split_bucket", "rule": "partition_skew",
+         "subject": "spill depth=0", "bucket": 3, "depth": 0, "fan": 8},
+        {"kind": "plan_rewrite", "phase": "applied",
+         "action": "split_bucket", "rule": "partition_skew",
+         "subject": "spill depth=0", "bucket": 3, "depth": 0, "fan": 8},
+        {"kind": "plan_rewrite", "phase": "decided",
+         "action": "prewiden_palette", "rule": "overflow_loop",
+         "subject": "s1:group_by", "boost": 4},
+    ]
+    text = render_rewrites(evs)
+    assert "plan rewrites" in text
+    assert "split_bucket <- partition_skew" in text
+    assert "[applied]" in text and "[pending]" in text
+    assert render_rewrites([]) == ""

@@ -1,0 +1,304 @@
+"""Fuzz-differential sweeps for the runtime plan rewriter.
+
+The rewriter's safety bar: with ``plan_rewrite`` on, every run must
+produce EXACTLY the bytes the static plan produces — a rewrite changes
+the execution shape (bucket fans, boost tiers, combine strategy,
+exchange windows), never the result.  Sort outputs compare in place
+under a TOTAL order (equal-key order is unspecified: device sorts are
+not stable on ties, so ties would hide legal reorders); unordered
+join/group outputs compare as canonical byte-keyed row multisets —
+the same equality the engine itself guarantees.
+
+Sweeps compose the rewriter with the machinery it must not disturb:
+the overflow retry (slack=1.0), whole-DAG plan fusion, and deep
+async dispatch (dispatch_depth>1).
+"""
+
+import numpy as np
+import pytest
+
+from dryad_tpu import DryadConfig, DryadContext
+
+# first seed gates each differential in tier-1; the rest of the sweep
+# rides the slow suite (each pair of runs recompiles the streaming
+# pipeline, so the full 3-seed matrix costs minutes, not seconds)
+SEEDS = (3, pytest.param(11, marks=pytest.mark.slow),
+         pytest.param(19, marks=pytest.mark.slow))
+
+
+# -- byte-identity helpers (mirrors test_fuzz_differential) ------------------
+
+
+def _canonical_rows(table):
+    names = sorted(table.keys())
+    cols = [np.asarray(table[n]) for n in names]
+    n = len(cols[0]) if cols else 0
+    rows = []
+    for i in range(n):
+        key = []
+        for c in cols:
+            v = c[i]
+            if c.dtype == object:
+                key.append(str(v).encode())
+            else:
+                key.append(c.dtype.str.encode() + v.tobytes())
+        rows.append(tuple(key))
+    return names, sorted(rows)
+
+
+def _assert_byte_identical_rows(a, b, ctxmsg):
+    na, ra = _canonical_rows(a)
+    nb, rb = _canonical_rows(b)
+    assert na == nb, f"{ctxmsg}: columns {na} != {nb}"
+    assert len(ra) == len(rb), f"{ctxmsg}: {len(ra)} vs {len(rb)} rows"
+    for i, (x, y) in enumerate(zip(ra, rb)):
+        assert x == y, f"{ctxmsg}: row {i} differs byte-wise"
+
+
+def _assert_byte_identical_ordered(a, b, ctxmsg):
+    assert set(a) == set(b), ctxmsg
+    for c in a:
+        assert a[c].dtype == b[c].dtype, f"{ctxmsg}: dtype of {c}"
+        assert a[c].tobytes() == b[c].tobytes(), (
+            f"{ctxmsg}: column {c} differs byte-wise in place"
+        )
+
+
+def _rewrote(ctx):
+    return [
+        e for e in ctx.executor.events.events()
+        if e["kind"] == "plan_rewrite"
+    ]
+
+
+def _mk_ctx(rw, **kw):
+    cfg = DryadConfig(
+        stream_bucket_rows=kw.pop("bucket_rows", 4000),
+        stream_combine_rows=2000,
+        stream_buckets=8,
+        plan_rewrite=rw,
+        diagnose_cooldown_s=0.0,
+        **kw,
+    )
+    return DryadContext(num_partitions_=8, config=cfg)
+
+
+def _stream(ctx, chunks):
+    return ctx.from_stream(
+        iter([{k: v.copy() for k, v in c.items()} for c in chunks])
+    )
+
+
+# -- skewed sort: natural partition_skew -> split_bucket ---------------------
+
+
+def _drift_sort_chunks(seed, nchunks=9, n=1500):
+    """Quantile splitters sample the first chunk; the rest collapse
+    onto a 20-value range, so the static partition's low bucket goes
+    hot and the spill telemetry trips partition_skew."""
+    rng = np.random.default_rng(seed)
+    chunks = [{"x": rng.integers(0, 1000, n).astype(np.int64),
+               "v": rng.random(n).astype(np.float32)}]
+    for _ in range(nchunks - 1):
+        chunks.append({"x": rng.integers(0, 20, n).astype(np.int64),
+                       "v": rng.random(n).astype(np.float32)})
+    return chunks
+
+
+def _sort_differential(seed, **cfg):
+    chunks = _drift_sort_chunks(seed)
+
+    def run(rw):
+        ctx = _mk_ctx(rw, **cfg)
+        out = _stream(ctx, chunks).order_by(["x", "v"]).collect()
+        return out, ctx
+
+    on, ctx_on = run(True)
+    off, ctx_off = run(False)
+    tag = f"seed={seed} cfg={cfg}"
+    _assert_byte_identical_ordered(on, off, f"sort {tag}")
+    assert any(
+        e["action"] == "split_bucket" for e in _rewrote(ctx_on)
+    ), f"drift fixture stopped triggering the rewriter ({tag})"
+    assert _rewrote(ctx_off) == []
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_skewed_sort_rewriter_differential(seed, mesh8):
+    _sort_differential(seed)
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_skewed_sort_rewriter_with_plan_fuse(seed, mesh8):
+    _sort_differential(seed, plan_fuse=True)
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_skewed_sort_rewriter_deep_dispatch(seed, mesh8):
+    _sort_differential(seed, dispatch_depth=3)
+
+
+# -- skewed join: split applied at the grace-join boundary -------------------
+
+
+def _seed_splits(ctx, buckets=(0, 5)):
+    """Force pending split decisions so the driver's application point
+    runs every time (the natural trigger needs multi-chunk timing; a
+    single hot key is structurally unsplittable by rehash)."""
+    for b in buckets:
+        ctx.rewriter.observe({
+            "kind": "diagnosis", "rule": "partition_skew",
+            "evidence": {
+                "source": "stream_spill", "subject": "spill depth=0",
+                "buckets": 8, "hot_bucket": b, "hot_rows": 9000,
+                "mean_rows": 1500, "ratio": 6.0,
+            },
+        })
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_skewed_join_rewriter_differential(seed, mesh8):
+    rng = np.random.default_rng(seed)
+
+    def chunks(side):
+        # near-distinct keys: a hot join key would square the pair
+        # count; the split decisions are pre-seeded, so the data only
+        # has to spill, not skew
+        return [
+            {"k": rng.integers(0, 20000, 1200).astype(np.int64),
+             side: rng.integers(0, 1000, 1200).astype(np.int32)}
+            for _ in range(8)
+        ]
+
+    L, R = chunks("a"), chunks("b")
+
+    def run(rw):
+        ctx = _mk_ctx(rw)
+        if rw:
+            _seed_splits(ctx)
+        out = _stream(ctx, L).join(_stream(ctx, R), ["k"], ["k"]).collect()
+        return out, ctx
+
+    on, ctx_on = run(True)
+    off, ctx_off = run(False)
+    _assert_byte_identical_rows(on, off, f"join seed={seed}")
+    assert any(
+        e["action"] == "split_bucket" and e["phase"] == "applied"
+        for e in _rewrote(ctx_on)
+    )
+    assert _rewrote(ctx_off) == []
+
+
+# -- skewed group-by: combine pin/flip under thrash --------------------------
+
+
+def _skew_group_chunks(seed, nchunks=4, n=1200):
+    rng = np.random.default_rng(seed)
+    chunks = []
+    for _ in range(nchunks):
+        hot = rng.integers(0, 8, n // 2).astype(np.int64)
+        tail = rng.integers(1000, 40 * n, n - n // 2).astype(np.int64)
+        k = np.concatenate([hot, tail])
+        rng.shuffle(k)
+        chunks.append({
+            "k": k,
+            "w": rng.integers(-(2 ** 52), 2 ** 52, n).astype(np.int64),
+            "d": rng.standard_normal(n),
+        })
+    return chunks
+
+
+_EXACT_AGGS = {
+    "c": ("count", None), "ws": ("sum", "w"),
+    "mn": ("min", "d"), "mx": ("max", "d"),
+}
+
+
+def _thrash(ctx):
+    for mode in ("host", "device", "host", "device", "host"):
+        ctx.events.emit("stream_combine_policy", mode=mode, chunks=1)
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+@pytest.mark.parametrize("rewrite_kind", ("pin", "flip"))
+def test_skewed_group_rewriter_differential(seed, rewrite_kind, mesh8):
+    """combine_thrash rewrites flip strategy (tree) or pin the mode
+    (host); both only reorder WHICH partials merge — the exact aggs
+    below are order-independent, so outputs stay byte-identical."""
+    chunks = _skew_group_chunks(seed)
+    aggs = dict(_EXACT_AGGS)
+    if rewrite_kind == "pin":
+        # "first" routes to the flat path, where the pin applies; it is
+        # deterministic here because chunk order is the stream order
+        aggs["f"] = ("first", "w")
+
+    def run(rw):
+        ctx = _mk_ctx(rw, combine_tree=False)
+        if rw:
+            _thrash(ctx)
+        out = _stream(ctx, chunks).group_by("k", aggs).collect()
+        return out, ctx
+
+    on, ctx_on = run(True)
+    off, ctx_off = run(False)
+    _assert_byte_identical_rows(
+        on, off, f"group seed={seed} kind={rewrite_kind}"
+    )
+    want = "pin_combine" if rewrite_kind == "pin" else "flip_combine"
+    assert any(
+        e["action"] == want and e["phase"] == "applied"
+        for e in _rewrote(ctx_on)
+    ), f"{want} did not apply (seed={seed})"
+    assert _rewrote(ctx_off) == []
+
+
+# -- overflow retry composition: prewiden vs reactive widen ------------------
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_overflow_retry_rewriter_differential(seed, mesh8):
+    """slack=1.0 with near-distinct keys overflows every run; once
+    overflow_loop fires, later dispatches are born pre-widened.  The
+    boost tier changes WHEN capacity is granted, never placement — all
+    runs, reactive or proactive, must agree byte-for-byte."""
+    rng = np.random.default_rng(seed)
+    n = 4096
+    tbl = {
+        "k": (rng.permutation(n).astype(np.int32) - 1),
+        "w": rng.integers(-(2 ** 40), 2 ** 40, n).astype(np.int64),
+    }
+
+    def series(rw):
+        ctx = DryadContext(
+            num_partitions_=8,
+            config=DryadConfig(
+                shuffle_slack=1.0, plan_rewrite=rw,
+                diagnose_cooldown_s=0.0,
+            ),
+        )
+        outs = []
+        for _ in range(4):
+            outs.append(
+                ctx.from_arrays(
+                    {k: v.copy() for k, v in tbl.items()}
+                ).group_by(
+                    "k", {"c": ("count", None), "ws": ("sum", "w")}
+                ).collect()
+            )
+        return outs, ctx
+
+    outs_on, ctx_on = series(True)
+    outs_off, ctx_off = series(False)
+    assert any(
+        e["kind"] == "stage_overflow"
+        for e in ctx_off.executor.events.events()
+    ), "fixture stopped overflowing; tighten it"
+    for i, (a, b) in enumerate(zip(outs_on, outs_off)):
+        _assert_byte_identical_rows(
+            a, b, f"overflow seed={seed} run={i}"
+        )
+    assert any(
+        e["action"] == "prewiden_palette" and e["phase"] == "applied"
+        for e in _rewrote(ctx_on)
+    ), "overflow_loop never pre-widened a dispatch"
+    assert _rewrote(ctx_off) == []

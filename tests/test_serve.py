@@ -439,3 +439,69 @@ def test_quota_pressure_diagnosis():
     d = next(d for d in eng.diagnoses() if d["rule"] == "quota_pressure")
     assert d["subject"] == "hot"
     assert d["evidence"]["rejections"] >= 3
+
+
+# -- cost-aware cache admission ----------------------------------------------
+
+
+def _payload_table(nbytes):
+    return {"v": np.zeros(max(1, nbytes // 8), dtype=np.int64)}
+
+
+def test_cost_admission_stops_cheap_evicting_expensive():
+    """The eviction-storm differential: a burst of big-but-cheap
+    results must not wash a small expensive one out of the cache.
+    Under admission="all" (LRU only) it does; under admission="cost"
+    the cheap entries are refused at the door instead."""
+    from dryad_tpu.serve.cache import ResultCache
+
+    expensive = _payload_table(8 * 1024)          # 8 KiB, 5 s to compute
+    cheap = [_payload_table(2 << 20) for _ in range(4)]  # 2 MiB, ~free
+
+    def fill(admission):
+        c = ResultCache(3 << 20, admission=admission)
+        c.put("expensive", expensive, epoch=0, cost_s=5.0)
+        for i, t in enumerate(cheap):
+            c.put(f"cheap{i}", t, epoch=0, cost_s=1e-4)
+        return c
+
+    lru = fill("all")
+    assert lru.get("expensive", 0) is None, (
+        "differential baseline broke: LRU no longer evicts — the cost "
+        "policy has nothing to improve on"
+    )
+    cost = fill("cost")
+    assert cost.get("expensive", 0) is not None
+    st = cost.stats()
+    assert st["rejected"] == 4
+    assert st["evictions"] == 0
+    # worth-its-bytes entries still enter under cost admission
+    assert cost.get("cheap0", 0) is None
+
+
+def test_cost_admission_edge_rules():
+    from dryad_tpu.serve.cache import ResultCache
+
+    c = ResultCache(1 << 20, admission="cost", min_sec_per_gb=0.5)
+    # unknown cost is admitted (no evidence to refuse on)
+    c.put("nocost", _payload_table(64 * 1024), epoch=0)
+    assert c.get("nocost", 0) is not None
+    # exactly at the threshold is admitted (strict < refuses)
+    nb = 64 * 1024 * 8 // 8  # _payload_table rounds to int64 words
+    thr = 0.5 * (nb / 1e9)
+    c.put("at", _payload_table(nb), epoch=0, cost_s=thr)
+    assert c.get("at", 0) is not None
+    assert c.stats()["rejected"] == 0
+
+
+def test_service_builds_cache_from_config(rng):
+    cfg = DryadConfig(
+        serve_cache_admission="cost", serve_cache_min_sec_per_gb=2.5
+    )
+    ctx = DryadContext(num_partitions_=8, config=cfg)
+    with QueryService(ctx) as svc:
+        assert svc._cache.admission == "cost"
+        assert svc._cache.min_sec_per_gb == 2.5
+        assert "rejected" in svc.stats()["cache"]
+    with pytest.raises(ValueError):
+        DryadConfig(serve_cache_admission="lfu")

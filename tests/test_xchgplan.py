@@ -250,3 +250,89 @@ def test_jobview_renders_exchange_panel():
     text = render(job)
     assert "exchanges:" in text
     assert "window=2" in text
+
+
+# -- window policy hook (resolve_window) -------------------------------------
+
+
+def test_resolve_window_static_knob_is_verbatim_override():
+    from dryad_tpu.plan.xchgplan import resolve_window
+
+    for w in (0, 1, 3, 7):
+        # static knob wins over any budget math or hint
+        assert resolve_window(w, 8, 1 << 30, 1, hint=5) == w
+
+
+def test_resolve_window_auto_hint_wins_and_clamps():
+    from dryad_tpu.plan.xchgplan import resolve_window
+
+    assert resolve_window(-1, 8, 1 << 20, 1 << 20, hint=3) == 3
+    assert resolve_window(-1, 8, 1 << 20, 1 << 20, hint=99) == 7
+    assert resolve_window(-1, 8, 1 << 20, 1 << 20, hint=-4) == 0
+
+
+def test_resolve_window_auto_budget_policy():
+    from dryad_tpu.plan.xchgplan import resolve_window
+
+    mb = 1 << 20
+    # whole flat send buffer fits: stay flat
+    assert resolve_window(-1, 8, mb, 8 * mb) == 0
+    # half the buffer fits: window of 4 in-flight blocks
+    assert resolve_window(-1, 8, mb, 4 * mb) == 4
+    # starved budget still stages one block at a time
+    assert resolve_window(-1, 8, mb, 1) == 1
+    # generous-but-not-flat budget clamps to P-1
+    assert resolve_window(-1, 8, mb, 7 * mb + 1) == 7
+    # degenerate meshes are always flat
+    assert resolve_window(-1, 1, mb, 1) == 0
+    assert resolve_window(-1, 0, mb, 1) == 0
+
+
+def test_resolve_window_deterministic_for_compile_key():
+    from dryad_tpu.plan.xchgplan import resolve_window
+
+    args = (-1, 16, 3 << 20, 24 << 20)
+    assert resolve_window(*args) == resolve_window(*args)
+    # zero/negative bucket estimates must not divide-by-zero
+    assert resolve_window(-1, 8, 0, 1 << 20) == 0
+
+
+def test_auto_window_end_to_end_stages_under_tight_budget(mesh8):
+    """exchange_window=-1 with a starved HBM budget must resolve to a
+    staged window (>0) and still land every row where flat does."""
+    rng = np.random.default_rng(9)
+    n = 40000  # big enough that the flat send buffer tops 1 MiB
+    tbl = {
+        "k": rng.integers(0, 500, n).astype(np.int32),
+        "v": rng.standard_normal(n).astype(np.float32),
+        "w": rng.standard_normal(n).astype(np.float32),
+        "u": rng.integers(0, 9, n).astype(np.int64),
+    }
+
+    def run(window, budget_mb=1024):
+        ctx = DryadContext(
+            num_partitions_=8,
+            config=DryadConfig(
+                exchange_window=window, exchange_hbm_budget_mb=budget_mb
+            ),
+        )
+        out = ctx.from_arrays(
+            {k: v.copy() for k, v in tbl.items()}
+        ).hash_partition("k").collect()
+        evs = [
+            e for e in ctx.events.events()
+            if e["kind"] == "exchange_round"
+        ]
+        return out, evs
+
+    flat_out, flat_evs = run(0)
+    auto_out, auto_evs = run(-1, budget_mb=1)  # 1 MiB: cannot go flat
+    assert all(e["window"] == 0 for e in flat_evs)
+    assert auto_evs and all(e["window"] > 0 for e in auto_evs)
+    for c in flat_out:
+        assert flat_out[c].tobytes() == auto_out[c].tobytes(), c
+    # a roomy budget resolves the same batch back to flat
+    roomy_out, roomy_evs = run(-1, budget_mb=4096)
+    assert all(e["window"] == 0 for e in roomy_evs)
+    for c in flat_out:
+        assert flat_out[c].tobytes() == roomy_out[c].tobytes(), c
