@@ -456,7 +456,8 @@ def _ooc_sort_once(n: int, chunk_rows: int, depth=None, obs=True):
     ``depth`` overrides ``stream_pipeline_depth`` (1 = the serial
     legacy driver, the pre-pipeline baseline); ``obs=False`` turns the
     always-on observability layer (flight recorder + diagnosis
-    engine) off for the --obs-overhead A/B."""
+    engine + continuous telemetry sampler) off for the
+    --obs-overhead A/B."""
     from dryad_tpu import DryadConfig, DryadContext
 
     rng = np.random.default_rng(3)
@@ -470,7 +471,11 @@ def _ooc_sort_once(n: int, chunk_rows: int, depth=None, obs=True):
     bucket_rows = max(chunk_rows, 1 << 20)
     kw = {} if depth is None else {"stream_pipeline_depth": depth}
     if not obs:
-        kw.update(obs_flight_recorder=False, obs_diagnosis=False)
+        kw.update(
+            obs_flight_recorder=False,
+            obs_diagnosis=False,
+            obs_telemetry=False,
+        )
     cfg = DryadConfig(
         stream_bucket_rows=bucket_rows * 2,
         stream_buckets=max(8, 2 * total // bucket_rows),
@@ -2173,7 +2178,8 @@ OBS_OVERHEAD_LIMIT = 0.02  # always-on observability budget: 2%
 
 def obs_overhead_gate(n: int = 1 << 22, chunk_rows: int = 1 << 20) -> None:
     """--obs-overhead: prove the always-on observability layer (event
-    taps -> flight-recorder ring + diagnosis folds) costs < 2% on the
+    taps -> flight-recorder ring + diagnosis folds + the continuous
+    telemetry sampler and its rolling store) costs < 2% on the
     out-of-core sort, the event-densest workload in the suite.  A/B in
     one process — warmup run first (XLA compile), then interleaved
     off/on pairs, best-of each so scheduler noise cancels.  Emits one
@@ -2196,6 +2202,7 @@ def obs_overhead_gate(n: int = 1 << 22, chunk_rows: int = 1 << 20) -> None:
         "ok": ok,
         "obs_on_s": [round(t, 4) for t in on_s],
         "obs_off_s": [round(t, 4) for t in off_s],
+        "telemetry": True,
         "rows": n,
         "chunk_rows": chunk_rows,
         "platform": _PLATFORM,

@@ -1,9 +1,10 @@
-"""Checkers: observability discipline — span usage and config keys.
+"""Checkers: observability discipline — spans, config keys, metrics.
 
-Two rules grown out of the flight-recorder work (``obs.flightrec``):
-crash forensics is only as good as the stream it records, and the
-stream is only trustworthy if spans always close and config reads
-always name real knobs.
+Rules grown out of the flight-recorder and telemetry work
+(``obs.flightrec`` / ``obs.telemetry``): crash forensics is only as
+good as the stream it records, and the stream is only trustworthy if
+spans always close, config reads always name real knobs, and metric
+emissions always name registered series.
 
 - ``span-discipline``: every ``tracer.span(...)`` call site must be a
   ``with``-statement context item.  A span held as a plain value can
@@ -20,6 +21,11 @@ always name real knobs.
   config lookups — attribute access IS the lookup — so a typo'd knob
   read otherwise fails only at runtime, or worse, silently via
   ``getattr`` defaults.
+- ``metric-key``: ``obs/telemetry.py`` keeps a ``METRIC_KEYS`` literal
+  (metric name -> one-line doc) that must agree BOTH ways with every
+  ``incr``/``set_gauge``/``observe_latency`` literal call site in the
+  package (mirroring the event-schema rule) — a misspelled metric name
+  otherwise silently starts a new time series nobody scrapes.
 """
 
 from __future__ import annotations
@@ -212,3 +218,101 @@ class ConfigKeyChecker(Checker):
                             f"getattr config key {key!r} is not a "
                             "DryadConfig field",
                         )
+
+
+TELEMETRY_PATH = "dryad_tpu/obs/telemetry.py"
+
+# RollingStore's write surface: a literal first argument at any of
+# these call sites IS a metric emission
+_METRIC_EMITTERS = ("incr", "set_gauge", "observe_latency")
+
+
+@register
+class MetricKeyChecker(Checker):
+    rule = "metric-key"
+    summary = (
+        "METRIC_KEYS and incr/set_gauge/observe_latency sites agree "
+        "both ways; metric names are string literals"
+    )
+    hint = (
+        "document the metric (one line) in obs/telemetry.py "
+        "METRIC_KEYS, or remove the stale entry"
+    )
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        src = project.file(TELEMETRY_PATH)
+        if src is None:
+            return
+        keys = astutil.literal_dict(src.tree, "METRIC_KEYS")
+        if keys is None:
+            yield self.finding(
+                src.rel,
+                1,
+                "could not parse the METRIC_KEYS literal",
+                hint="keep the metric schema dict a plain literal",
+            )
+            return
+        keys_stmt = astutil.find_assign(src.tree, "METRIC_KEYS")
+        keys_line = keys_stmt.lineno if keys_stmt is not None else 1
+
+        # docs are non-empty one-liners (the schema doubles as THE
+        # documented metric table — see the event-schema rule)
+        for name, doc_node in keys.items():
+            doc = (
+                doc_node.value
+                if isinstance(doc_node, ast.Constant)
+                and isinstance(doc_node.value, str)
+                else None
+            )
+            if doc is None or not doc.strip() or "\n" in doc:
+                yield self.finding(
+                    src.rel,
+                    doc_node.lineno,
+                    f"doc for metric {name!r} must be a non-empty "
+                    "one-line string",
+                )
+
+        emitted: Set[str] = set()
+        for usage in project.package_files():
+            for node in ast.walk(usage.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if not (
+                    isinstance(f, ast.Attribute)
+                    and f.attr in _METRIC_EMITTERS
+                ):
+                    continue
+                if not node.args:
+                    continue
+                first = node.args[0]
+                if not (
+                    isinstance(first, ast.Constant)
+                    and isinstance(first.value, str)
+                ):
+                    yield self.finding(
+                        usage.rel,
+                        node.lineno,
+                        f"{f.attr}() metric name must be a string "
+                        "literal (the schema cross-reference cannot "
+                        "see computed names)",
+                    )
+                    continue
+                name = first.value
+                emitted.add(name)
+                if name not in keys:
+                    yield self.finding(
+                        usage.rel,
+                        node.lineno,
+                        f"emits unregistered metric {name!r}",
+                    )
+
+        # documented metrics no call site emits are stale
+        for name in sorted(set(keys) - emitted):
+            yield self.finding(
+                src.rel,
+                keys_line,
+                f"METRIC_KEYS documents metric {name!r} that no call "
+                "site emits",
+                hint="remove the stale metric or emit it",
+            )

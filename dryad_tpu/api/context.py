@@ -128,6 +128,12 @@ class DryadContext:
         self._device_cache: "OrderedDict[int, tuple]" = OrderedDict()
         self.diagnosis: Optional[DiagnosisEngine] = None
         self.rewriter = None
+        # Continuous telemetry plane (obs.telemetry): the tap-paced
+        # resource sampler and its measured HeadroomProvider, consumed
+        # by the adaptive exchange-window and dispatch-depth policies.
+        # None (local_debug / obs_telemetry=False) = budget fallbacks.
+        self.telemetry = None
+        self.headroom = None
         if local_debug:
             self.mesh = None
             self.executor = None
@@ -206,12 +212,27 @@ class DryadContext:
                     config=self.config, events=self.events
                 )
                 self.events.add_tap(self.rewriter.observe)
+            # Resource sampler: opportunistic (event-tap-paced, the
+            # flightrec discipline — no thread here; resident
+            # processes call ctx.telemetry.start()).  Its samples feed
+            # the hbm_pressure diagnosis upstream and the measured
+            # HeadroomProvider the executor consults below.
+            if getattr(self.config, "obs_telemetry", True):
+                from dryad_tpu.obs.telemetry import ResourceMonitor
+
+                self.telemetry = ResourceMonitor(
+                    interval_s=self.config.telemetry_sample_s,
+                    events=self.events,
+                )
+                self.headroom = self.telemetry.headroom
+                self.events.add_tap(self.telemetry.observe)
             self.executor = GraphExecutor(
                 self.mesh, self.config, self.events,
                 subquery_runner=self._run_subquery,
                 loop_lowerer=self._lower_loop_stage,
             )
             self.executor.rewriter = self.rewriter
+            self.executor.headroom = self.headroom
 
     def rebuild_mesh(self, exclude_device_ids) -> None:
         """Elastic recovery: shrink the mesh past failed devices and
@@ -233,6 +254,7 @@ class DryadContext:
             loop_lowerer=self._lower_loop_stage,
         )
         self.executor.rewriter = self.rewriter
+        self.executor.headroom = self.headroom
 
     # -- ingestion ----------------------------------------------------------
     def from_arrays(

@@ -101,6 +101,7 @@ EVENT_KINDS: Dict[str, str] = {
     "xla_compile": "stage (re)compiled; stage/key/trace_s/compile_s",
     "telemetry_merged": "driver absorbed worker span/counter batches",
     # -- diagnosis / flight recorder (obs.diagnose / exec.events) ---------
+    "resource_sample": "continuous telemetry sample; hbm/rss/probes",
     "diagnosis": "online pathology detected; rule/severity/evidence/hint",
     "plan_rewrite": "runtime plan rewrite decided/applied; "
                     "action/rule/phase (rewrite.controller)",
@@ -307,6 +308,11 @@ EVENT_PAYLOADS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
     "worker_killed_injected": (("name", "stage"), ()),
     "quarantine_delta": (("computer", "count", "src"), ()),
     "quarantine_absorbed": (("deltas", "source"), ()),
+    "resource_sample": (
+        ("source",),
+        ("hbm_headroom_bytes", "hbm_limit_bytes", "hbm_used_bytes",
+         "probes", "rss_kb"),
+    ),
     "diagnosis": (
         ("evidence", "hint", "rule", "severity"), ("name", "stage"),
     ),

@@ -266,6 +266,11 @@ class GraphExecutor:
         # per-stage starting-boost floors (overflow pre-widening) and
         # the auto exchange-window hint.
         self.rewriter = None
+        # Measured-headroom provider (obs.telemetry.HeadroomProvider),
+        # wired by the context alongside the rewriter.  Consulted by
+        # the auto exchange-window policy; None (or a provider with no
+        # measurement yet) falls back to the configured HBM budget.
+        self.headroom = None
         self._rewrites_applied: set = set()
         # do_while loop-state compaction programs (see _compact_loop_state)
         self._compact_cache: Dict[Tuple, Any] = {}
@@ -442,9 +447,14 @@ class GraphExecutor:
         conservative per-destination bucket estimate derived from the
         shape key (capacity x columns x 8B, widened by slack/boost —
         the same quantities the lowered exchange sizes its send buffer
-        from), the configured HBM budget, and the runtime rewriter's
-        retune hint when one is pinned.  Deterministic in its inputs,
-        so the resolved value is safe inside the compile-cache key.
+        from), the configured HBM budget, the runtime rewriter's
+        retune hint when one is pinned, and the MEASURED live headroom
+        when a telemetry provider is wired (precedence: hint >
+        measured > budget).  Live headroom is quantized to a power of
+        two before it enters the policy — the resolved window rides
+        the compile-cache key, and raw byte-exact measurements would
+        fragment the palette into one entry per sample.  Deterministic
+        in its (quantized) inputs.
         """
         cfgw = int(getattr(self.config, "exchange_window", 0))
         if cfgw >= 0:
@@ -461,8 +471,14 @@ class GraphExecutor:
         hint = None
         if self.rewriter is not None:
             hint = self.rewriter.exchange_window_hint()
+        headroom = None
+        if self.headroom is not None:
+            h = self.headroom.headroom_bytes()
+            if h is not None and int(h) > 0:
+                headroom = 1 << (int(h).bit_length() - 1)
         return resolve_window(
-            cfgw, self.P, bucket_bytes, budget, hint=hint
+            cfgw, self.P, bucket_bytes, budget, hint=hint,
+            headroom_bytes=headroom,
         )
 
     # -- execution ---------------------------------------------------------

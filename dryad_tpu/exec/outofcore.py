@@ -50,6 +50,7 @@ from dryad_tpu.exec.failure import JobFailedError, StageFailedError
 from dryad_tpu.exec.faults import InjectedFault
 from dryad_tpu.exec.pipeline import DispatchWindow, prefetched
 from dryad_tpu.exec.spill import SpillDir, SpillWriter
+from dryad_tpu.obs import telemetry
 from dryad_tpu.obs.metrics import KeyRangeHistogram, MetricsRegistry
 from dryad_tpu.obs.span import Tracer
 from dryad_tpu.plan.nodes import Node, walk
@@ -543,8 +544,15 @@ class StreamExecutor:
         self.writer_queue = int(getattr(cfg, "stream_writer_queue", 8))
         # async device-paced dispatch: how many chunk dispatches stay
         # in flight (readbacks drained by the DispatchWindow collector
-        # thread); 1 = today's serial driver, the differential baseline
-        self.dispatch_depth = max(1, int(getattr(cfg, "dispatch_depth", 1)))
+        # thread); 1 = today's serial driver, the differential
+        # baseline; -1 = adaptive — measured HBM headroom (the
+        # context's telemetry HeadroomProvider) picks the tier, and
+        # the collector's submit-order drain keeps ANY resolved depth
+        # byte-identical to serial
+        self.dispatch_depth = max(1, telemetry.resolve_depth(
+            int(getattr(cfg, "dispatch_depth", 1)),
+            getattr(ctx, "headroom", None),
+        ))
         # cross-chunk fusion: K chunk partial-plans lowered as one
         # multi-root program, collapsing K dispatch RTTs into one
         self.chunk_fuse = max(1, int(getattr(cfg, "chunk_fuse", 1)))

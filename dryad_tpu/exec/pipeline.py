@@ -36,7 +36,7 @@ import threading
 import time
 from typing import Any, Iterator, Optional
 
-from dryad_tpu.obs import flightrec
+from dryad_tpu.obs import flightrec, telemetry
 from dryad_tpu.obs.span import Tracer
 
 __all__ = [
@@ -274,7 +274,21 @@ class DispatchWindow:
     window's life (``driver_cpu_fraction`` in JobMetrics).
     """
 
-    def __init__(self, depth: int, events=None, name: str = "dispatch"):
+    def __init__(
+        self,
+        depth: int,
+        events=None,
+        name: str = "dispatch",
+        headroom=None,
+    ):
+        depth = int(depth)
+        if depth == -1:
+            # adaptive mode: measured HBM headroom picks the depth
+            # tier (obs.telemetry.resolve_depth); with no measurement
+            # the default applies.  Any resolved depth is
+            # byte-identical — the collector drains in submit order
+            # regardless of how wide the window is.
+            depth = telemetry.resolve_depth(-1, headroom)
         if depth < 1:
             raise ValueError("dispatch depth must be >= 1")
         self.depth = depth

@@ -109,10 +109,18 @@ RULES: Dict[str, Tuple[str, str]] = {
         "serve_max_inflight/serve_max_bytes quota or DRR weight, or "
         "shed load client-side with backoff on QueryRejected",
     ),
+    "hbm_pressure": (
+        "warn",
+        "measured device HBM is nearly exhausted — the rewriter "
+        "narrows the staged-exchange window; consider lowering "
+        "dispatch_depth/chunk_fuse or raising exchange_hbm_budget_mb "
+        "headroom by shrinking resident operands",
+    ),
 }
 
 _WINDOW_S = 60.0  # sliding window for rate-based rules
 _MIN_STALL_S = 1.0  # ignore stall dominance below this absolute cost
+_HBM_PRESSURE_RATIO = 0.92  # used/limit at or above diagnoses pressure
 
 
 class _Tuning:
@@ -291,6 +299,29 @@ class DiagnosisEngine:
             self._fold_overflow(ev)
         elif kind == "query_rejected":
             self._fold_rejection(ev)
+        elif kind == "resource_sample":
+            self._fold_resource(ev)
+
+    def _fold_resource(self, ev: Dict[str, Any]) -> None:
+        """Measured HBM near the limit diagnoses ``hbm_pressure`` —
+        the rewriter folds it into a conservative exchange-window
+        retune.  Host-fallback samples (no device limit) fold
+        nowhere."""
+        used = int(ev.get("hbm_used_bytes", 0) or 0)
+        limit = int(ev.get("hbm_limit_bytes", 0) or 0)
+        if limit <= 0:
+            return
+        ratio = used / limit
+        if ratio >= _HBM_PRESSURE_RATIO:
+            self._diagnose(
+                "hbm_pressure", "hbm",
+                evidence={
+                    "used": used,
+                    "limit": limit,
+                    "ratio": round(ratio, 4),
+                    "headroom": max(0, limit - used),
+                },
+            )
 
     def _fold_compile(self, ev: Dict[str, Any]) -> None:
         stage = str(ev.get("stage", "?"))

@@ -47,11 +47,35 @@ __all__ = [
     "uninstall_recorder",
     "probe",
     "unprobe",
+    "sample_shared_probes",
     "dump_now",
 ]
 
 DUMP_VERSION = 1
 _SNAPSHOT_CAP = 256  # snapshots kept alongside the event ring
+
+# One process-wide probe registry shared by every consumer — the
+# blackbox microsnapshots AND the telemetry ResourceMonitor sample the
+# same entries, so a subsystem registers its probe exactly once and
+# ``unprobe`` removes it everywhere.  Keyed by name (re-registering a
+# name replaces the callable, which also bounds any leak from callers
+# that never unprobe).
+_PROBE_LOCK = threading.Lock()
+_SHARED_PROBES: Dict[str, Callable[[], Any]] = {}
+
+
+def sample_shared_probes() -> Dict[str, Any]:
+    """Sample every shared probe once; a raising probe is skipped.
+    Callables run outside the lock (they may take their own locks)."""
+    with _PROBE_LOCK:
+        probes = list(_SHARED_PROBES.items())
+    out: Dict[str, Any] = {}
+    for name, fn in probes:
+        try:
+            out[name] = fn()
+        except Exception:
+            pass
+    return out
 
 
 def _rss_kb() -> Optional[int]:
@@ -138,8 +162,12 @@ class FlightRecorder:
         rss = _rss_kb()
         if rss is not None:
             snap["rss_kb"] = rss
+        # shared registry first, instance probes win on a name clash
+        with _PROBE_LOCK:
+            merged = dict(_SHARED_PROBES)
         with self._lock:
-            probes = list(self._probes.items())
+            merged.update(self._probes)
+        probes = list(merged.items())
         for name, fn in probes:
             try:
                 snap[name] = fn()
@@ -295,14 +323,19 @@ def uninstall_recorder() -> None:
 
 
 def probe(name: str, fn: Callable[[], Any]) -> None:
-    """Register a health probe on the process recorder (no-op when no
-    recorder is installed — probes never gate on obs being on)."""
-    rec = _RECORDER
-    if rec is not None:
-        rec.probe(name, fn)
+    """Register a health probe in the SHARED registry: one entry feeds
+    both the blackbox microsnapshots (of whatever recorder is current)
+    and the telemetry ResourceMonitor — no double registration, no
+    double sampling.  Never gates on a recorder being installed."""
+    with _PROBE_LOCK:
+        _SHARED_PROBES[name] = fn
 
 
 def unprobe(name: str) -> None:
+    """Remove *name* everywhere — the shared registry and the current
+    recorder's instance probes."""
+    with _PROBE_LOCK:
+        _SHARED_PROBES.pop(name, None)
     rec = _RECORDER
     if rec is not None:
         rec.unprobe(name)

@@ -109,6 +109,23 @@ def chrome_trace(
                 "ts": round((ev["ts"] - base) * 1e6, 1),
                 "args": {"in_flight": ev.get("in_flight", 0)},
             })
+        elif kind == "resource_sample":
+            # one "resources" counter track per process: HBM usage and
+            # headroom (device samples) or RSS (host fallback) ride as
+            # Perfetto counters alongside the dispatch occupancy
+            args = {
+                k: ev[k]
+                for k in ("hbm_used_bytes", "hbm_headroom_bytes", "rss_kb")
+                if ev.get(k) is not None
+            }
+            if not args:
+                continue
+            out.append({
+                "ph": "C", "name": "resources",
+                "pid": pid, "tid": 0,
+                "ts": round((ev["ts"] - base) * 1e6, 1),
+                "args": args,
+            })
         elif kind == "metrics":
             continue  # snapshots are bulky; JobMetrics folds them
         else:

@@ -132,6 +132,7 @@ def resolve_window(
     bucket_bytes: int,
     budget_bytes: int,
     hint: "int | None" = None,
+    headroom_bytes: "int | None" = None,
 ) -> int:
     """The effective staged-exchange window for one compilation.
 
@@ -139,15 +140,20 @@ def resolve_window(
 
     - ``config_window >= 0`` — the static knob is an override; it is
       returned verbatim (0 = flat).
-    - ``config_window == -1`` — auto.  An explicit ``hint`` (the
-      runtime rewriter's ``retune_exchange``) wins; otherwise pick
-      flat while the whole ``P * bucket_bytes`` send buffer fits
-      ``budget_bytes``, else the widest window whose ``O(window * B)``
-      staging footprint does (clamped to ``[1, P-1]``).
+    - ``config_window == -1`` — auto, with precedence rewriter hint >
+      measured headroom > configured budget.  An explicit ``hint``
+      (the runtime rewriter's ``retune_exchange``) wins outright;
+      otherwise the staging bound is ``headroom_bytes`` (live measured
+      HBM headroom from ``obs.telemetry``) when available, else the
+      configured ``budget_bytes`` — then pick flat while the whole
+      ``P * bucket_bytes`` send buffer fits the bound, else the widest
+      window whose ``O(window * B)`` staging footprint does (clamped
+      to ``[1, P-1]``).
 
     Pure and deterministic: equal inputs always resolve equally, so
     the compile-cache key may include the resolved value without
-    fragmenting the palette.
+    fragmenting the palette (callers quantize live headroom before
+    passing it here for exactly that reason).
     """
     if config_window >= 0:
         return int(config_window)
@@ -155,10 +161,14 @@ def resolve_window(
         return max(0, min(int(hint), max(num_partitions - 1, 0)))
     if num_partitions <= 1:
         return 0
+    bound = (
+        int(headroom_bytes) if headroom_bytes is not None
+        else int(budget_bytes)
+    )
     block = max(1, int(bucket_bytes))
-    if num_partitions * block <= budget_bytes:
+    if num_partitions * block <= bound:
         return 0  # flat fits: one collective beats any staging
-    return max(1, min(int(budget_bytes // block), num_partitions - 1))
+    return max(1, min(int(bound // block), num_partitions - 1))
 
 
 def plan_exchange(

@@ -107,6 +107,8 @@ class RewriteController:
             self._on_overflow(ev, evidence)
         elif rule == "combine_thrash":
             self._on_thrash(evidence)
+        elif rule == "hbm_pressure":
+            self._on_hbm_pressure(evidence)
 
     def _on_skew(self, evidence: Dict[str, Any]) -> None:
         # only the stream_spill fold names a concrete bucket; the
@@ -139,6 +141,18 @@ class RewriteController:
             self._splits.setdefault(depth, {})[bucket] = act
             self.records.append(act)
         self._emit_decided(act)
+
+    def _on_hbm_pressure(self, evidence: Dict[str, Any]) -> None:
+        # measured HBM near exhaustion: pin the staged-exchange window
+        # to its narrowest (1) so subsequent compilations stage one
+        # bucket at a time.  A pinned hint — from anywhere, including
+        # an earlier pressure fold — stays pinned: pressure persists
+        # until operands shrink, and re-pinning every sample would
+        # flood the decision trail.
+        with self._lock:
+            if self._xchg_hint is not None:
+                return
+        self.retune_exchange(1, reason="hbm_pressure")
 
     def _on_overflow(self, ev: Dict[str, Any], evidence: Dict[str, Any]) -> None:
         name = str(ev.get("name") or evidence.get("subject") or "?")

@@ -39,6 +39,8 @@ from collections import deque
 from typing import Any, Dict, Optional, Tuple
 
 from dryad_tpu.exec.pipeline import DispatchWindow
+from dryad_tpu.obs import flightrec
+from dryad_tpu.obs.telemetry import RollingStore
 from dryad_tpu.serve.admission import QueryRejected, TenantQuota
 from dryad_tpu.serve.cache import ResultCache
 from dryad_tpu.utils.logging import get_logger
@@ -179,7 +181,14 @@ class QueryService:
         )
         self._window = DispatchWindow(
             depth=self.config.dispatch_depth, events=self.events,
-            name="serve",
+            name="serve", headroom=getattr(ctx, "headroom", None),
+        )
+        # per-tenant SLO plane: admission->completion latency
+        # percentiles and windowed admission/completion/rejection
+        # counters over the telemetry rolling window — the metricsd
+        # scrape surface and the ``stats()["slo"]`` block
+        self.slo = RollingStore(
+            window_s=getattr(self.config, "telemetry_window_s", 60.0)
         )
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
@@ -194,6 +203,16 @@ class QueryService:
         self._inflight_items: Dict[str, Tuple[_Queued, Any]] = {}
         self._closed = False
         self._thread: Optional[threading.Thread] = None
+        # queue-depth health probe: ONE shared-registry entry feeds
+        # both the blackbox microsnapshots and the ResourceMonitor
+        flightrec.probe(
+            "serve:queue",
+            lambda: {
+                "queued": self._queued,
+                "in_flight": len(self._inflight_items),
+                "depth": self._window.depth,
+            },
+        )
         if start:
             self.start()
 
@@ -226,6 +245,7 @@ class QueryService:
             # rejection instead of letting them wait forever
             self._cancel_queued()
         self._window.close()
+        flightrec.unprobe("serve:queue")
 
     def __enter__(self) -> "QueryService":
         return self
@@ -304,12 +324,15 @@ class QueryService:
                     )
                 self._work.notify_all()
         if rejection is not None:
+            self.slo.incr("queries_rejected", tenant=st.name)
             self.events.emit(
                 "query_rejected", tenant=st.name, query=rej_id,
                 reason=rejection.reason, limit=rejection.limit,
                 current=rejection.current,
             )
             raise rejection
+        self.slo.incr("queries_admitted", tenant=st.name)
+        self.slo.set_gauge("serve_queue_depth", self._queued)
         self.events.emit(
             "query_admitted", tenant=st.name, query=qid,
             cost_bytes=cost, queued=queued,
@@ -411,6 +434,9 @@ class QueryService:
                                 len(next(iter(table.values())))
                                 if table else 0
                             )
+                            self.slo.incr(
+                                "result_cache_hits", tenant=st.name
+                            )
                             self.events.emit(
                                 "result_cache_hit", tenant=st.name,
                                 query=item.qid, rows=rows,
@@ -462,6 +488,9 @@ class QueryService:
                     limit=st.quota.max_inflight, bytes=st.inflight_bytes,
                 )
         seconds = round(time.monotonic() - item.t_submit, 6)
+        self.slo.incr("queries_completed", tenant=st.name)
+        self.slo.observe_latency("query_latency_s", seconds, tenant=st.name)
+        self.slo.set_gauge("serve_queue_depth", self._queued)
         if ok:
             self.events.emit(
                 "query_complete", tenant=st.name, query=item.qid,
@@ -528,8 +557,16 @@ class QueryService:
                 }
                 for st in self._tenants.values()
             }
+        # rolling-window SLO readout: admission->completion latency
+        # percentiles per tenant (None until a query completes inside
+        # the window)
+        slo = {
+            name: self.slo.percentiles("query_latency_s", tenant=name)
+            for name in tenants
+        }
         return {
             "tenants": tenants,
+            "slo": slo,
             "cache": self._cache.stats(),
             "dispatches": self._window.dispatches,
         }

@@ -1172,6 +1172,53 @@ def render_tenants(events: List[Dict[str, Any]]) -> str:
     return "\n".join(lines)
 
 
+def render_telemetry(events: List[Dict[str, Any]]) -> str:
+    """Continuous-telemetry panel: the ``resource_sample`` stream
+    (``obs.telemetry.ResourceMonitor``) folded to HBM/RSS extremes,
+    plus per-tenant admission→completion latency percentiles recomputed
+    from ``query_complete`` events with the SAME pow2 bucketing the
+    live RollingStore uses — so this panel and a ``metricsd`` scrape
+    agree bucket-for-bucket.  Empty when the stream has no samples."""
+    from dryad_tpu.obs import telemetry
+
+    samples = [e for e in events if e.get("kind") == "resource_sample"]
+    if not samples:
+        return ""
+    lines = [f"-- telemetry ({len(samples)} samples) --"]
+    hbm = [e for e in samples if e.get("hbm_limit_bytes")]
+    if hbm:
+        last = hbm[-1]
+        min_head = min(int(e.get("hbm_headroom_bytes", 0)) for e in hbm)
+        lines.append(
+            f"  hbm: used={int(last.get('hbm_used_bytes', 0)) >> 20}MB"
+            f"/{int(last.get('hbm_limit_bytes', 0)) >> 20}MB  "
+            f"headroom={int(last.get('hbm_headroom_bytes', 0)) >> 20}MB "
+            f"(min {min_head >> 20}MB)"
+        )
+    rss = [e for e in samples if e.get("rss_kb")]
+    if rss:
+        lines.append(
+            f"  host rss: last={int(rss[-1]['rss_kb']) >> 10}MB  "
+            f"peak={max(int(e['rss_kb']) for e in rss) >> 10}MB"
+        )
+    by_tenant: Dict[str, List[float]] = {}
+    for e in events:
+        if e.get("kind") == "query_complete" and "seconds" in e:
+            by_tenant.setdefault(str(e.get("tenant", "?")), []).append(
+                float(e["seconds"])
+            )
+    for name in sorted(by_tenant):
+        vals = by_tenant[name]
+        p50 = telemetry.percentile_of(vals, 0.5)
+        p95 = telemetry.percentile_of(vals, 0.95)
+        p99 = telemetry.percentile_of(vals, 0.99)
+        lines.append(
+            f"  slo {name}: n={len(vals)}  p50<={p50:.4g}s  "
+            f"p95<={p95:.4g}s  p99<={p99:.4g}s"
+        )
+    return "\n".join(lines)
+
+
 def _render_stream(events: List[Dict[str, Any]]) -> str:
     """Render whichever job model the stream holds."""
     kinds = {e["kind"] for e in events}
@@ -1181,12 +1228,14 @@ def _render_stream(events: List[Dict[str, Any]]) -> str:
         text = render(build_job(events))
     attr = render_attribution(events)
     tenants = render_tenants(events)
+    telemetry = render_telemetry(events)
     health = render_health(events)
     rewrites = render_rewrites(events)
     return (
         text
         + ("\n" + attr if attr else "")
         + ("\n\n" + tenants if tenants else "")
+        + ("\n\n" + telemetry if telemetry else "")
         + ("\n\n" + health if health else "")
         + ("\n\n" + rewrites if rewrites else "")
     )

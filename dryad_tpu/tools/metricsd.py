@@ -1,0 +1,195 @@
+"""metricsd — scrape a dryad_tpu event log into Prometheus/JSON.
+
+The continuous telemetry plane (``obs.telemetry``) keeps its rolling
+SLO state inside the resident process; this CLI is the OUT-of-process
+export surface: it folds a JSONL event log (the Calypso-style stream a
+running service writes via ``config.event_log_dir``) through the SAME
+:class:`~dryad_tpu.obs.telemetry.RollingStore` the live plane uses, so
+a scrape shows exactly what the service would report — per-tenant
+query counters, admission→completion latency p50/p95/p99, and the
+latest resource gauges — in Prometheus text exposition or a JSON
+snapshot.
+
+Usage::
+
+    python -m dryad_tpu.tools.metricsd events.jsonl
+        [--json] [--prom out.prom] [--json-out out.json]
+        [--window S] [--follow --interval S]
+
+One-shot (default) folds the whole log into one window and prints
+Prometheus text (``--json`` prints the JSON snapshot instead).
+``--prom`` / ``--json-out`` write file sinks (atomic tmp+rename, so a
+scraper never reads a torn file).  ``--follow`` keeps the process
+resident: it re-reads the log from the last byte offset every
+``--interval`` seconds and rewrites the sinks — the "periodic file
+sink" deployment, one step short of an HTTP endpoint.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from dryad_tpu.obs.telemetry import RollingStore, prometheus_text
+
+__all__ = ["fold_events", "load_events", "main"]
+
+# one-shot folds have no live clock: make the window wide enough that
+# every event in the log lands in the readout
+ONESHOT_WINDOW_S = 1e9
+
+
+def load_events(
+    path: str, offset: int = 0
+) -> Tuple[List[Dict[str, Any]], int]:
+    """Read JSONL events from ``path`` starting at byte ``offset``;
+    returns (events, new_offset).  A torn final line (mid-write by the
+    producer) is left for the next poll."""
+    out: List[Dict[str, Any]] = []
+    try:
+        with open(path, "rb") as fh:
+            fh.seek(offset)
+            data = fh.read()
+    except OSError:
+        return out, offset
+    end = data.rfind(b"\n")
+    if end < 0:
+        return out, offset
+    for line in data[: end + 1].splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            out.append(json.loads(line))
+        except ValueError:
+            continue
+    return out, offset + end + 1
+
+
+def fold_events(
+    events: List[Dict[str, Any]], store: Optional[RollingStore] = None
+) -> RollingStore:
+    """Fold serve/telemetry events into a RollingStore — the same
+    metric names, labels, and pow2 latency buckets the live plane
+    emits, so offline scrapes and in-process readouts agree."""
+    if store is None:
+        store = RollingStore(window_s=ONESHOT_WINDOW_S)
+    for ev in events:
+        kind = ev.get("kind")
+        tenant = str(ev.get("tenant", "?"))
+        if kind == "query_admitted":
+            store.incr("queries_admitted", tenant=tenant)
+        elif kind == "query_rejected":
+            store.incr("queries_rejected", tenant=tenant)
+        elif kind == "result_cache_hit":
+            store.incr("result_cache_hits", tenant=tenant)
+        elif kind == "query_complete":
+            store.incr("queries_completed", tenant=tenant)
+            if "seconds" in ev:
+                store.observe_latency(
+                    "query_latency_s", float(ev["seconds"]), tenant=tenant
+                )
+        elif kind == "resource_sample":
+            # literal metric names only: the graftlint metric-key rule
+            # cross-references every call site against METRIC_KEYS
+            if ev.get("hbm_used_bytes") is not None:
+                store.set_gauge("hbm_used_bytes", int(ev["hbm_used_bytes"]))
+            if ev.get("hbm_limit_bytes") is not None:
+                store.set_gauge(
+                    "hbm_limit_bytes", int(ev["hbm_limit_bytes"])
+                )
+            if ev.get("hbm_headroom_bytes") is not None:
+                store.set_gauge(
+                    "hbm_headroom_bytes", int(ev["hbm_headroom_bytes"])
+                )
+            if ev.get("rss_kb") is not None:
+                store.set_gauge("host_rss_kb", int(ev["rss_kb"]))
+            probes = ev.get("probes") or {}
+            q = probes.get("serve:queue")
+            if isinstance(q, dict) and "queued" in q:
+                store.set_gauge("serve_queue_depth", int(q["queued"]))
+    return store
+
+
+def _write_atomic(path: str, text: str) -> None:
+    tmp = path + f".tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        fh.write(text)
+    os.replace(tmp, path)
+
+
+def _render(store: RollingStore, as_json: bool) -> str:
+    snap = store.snapshot()
+    if as_json:
+        return json.dumps(snap, default=str)
+    return prometheus_text(snap)
+
+
+def _emit(store: RollingStore, as_json: bool,
+          prom_out: Optional[str], json_out: Optional[str]) -> None:
+    if prom_out:
+        _write_atomic(prom_out, prometheus_text(store.snapshot()))
+    if json_out:
+        _write_atomic(
+            json_out, json.dumps(store.snapshot(), default=str)
+        )
+    if not prom_out and not json_out:
+        print(_render(store, as_json))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+
+    def _flag_with_arg(name: str) -> Optional[str]:
+        if name in args:
+            i = args.index(name)
+            args.pop(i)
+            return args.pop(i)
+        return None
+
+    window = float(_flag_with_arg("--window") or 0.0)
+    interval = float(_flag_with_arg("--interval") or 2.0)
+    prom_out = _flag_with_arg("--prom")
+    json_out = _flag_with_arg("--json-out")
+    as_json = "--json" in args
+    if as_json:
+        args.remove("--json")
+    follow = "--follow" in args
+    if follow:
+        args.remove("--follow")
+    if not args:
+        print(
+            "usage: python -m dryad_tpu.tools.metricsd <events.jsonl> "
+            "[--json] [--prom out.prom] [--json-out out.json] "
+            "[--window S] [--follow --interval S]",
+            file=sys.stderr,
+        )
+        return 2
+    path = args[0]
+    if not follow and not os.path.exists(path):
+        print(f"no event log at {path}", file=sys.stderr)
+        return 1
+    if not follow:
+        events, _ = load_events(path)
+        store = RollingStore(window_s=window or ONESHOT_WINDOW_S)
+        fold_events(events, store)
+        _emit(store, as_json, prom_out, json_out)
+        return 0
+    # resident mode: a real rolling window over the live log
+    store = RollingStore(window_s=window or 60.0)
+    offset = 0
+    try:
+        while True:
+            events, offset = load_events(path, offset)
+            fold_events(events, store)
+            _emit(store, as_json, prom_out, json_out)
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -396,6 +396,24 @@ class DryadConfig:
     # obs_diagnosis; every rewrite is byte-identity-preserving (the
     # fuzz-differential suite runs this knob on vs off).
     plan_rewrite: bool = _env_bool("DRYAD_TPU_PLAN_REWRITE", True)
+    # Continuous telemetry plane (dryad_tpu.obs.telemetry): a
+    # ResourceMonitor taps the event stream and samples device HBM /
+    # host RSS plus every shared flightrec probe on an interval,
+    # feeding resource_sample events, rolling gauges, and the measured
+    # HeadroomProvider that the adaptive exchange-window and
+    # dispatch-depth policies consult.  Off = no sampler, adaptive
+    # knobs fall back to configured budgets/defaults.
+    obs_telemetry: bool = _env_bool("DRYAD_TPU_OBS_TELEMETRY", True)
+    # Min seconds between resource samples (tap-paced; a background
+    # thread in resident processes uses the same interval).
+    telemetry_sample_s: float = _env_float(
+        "DRYAD_TPU_TELEMETRY_SAMPLE_S", 1.0
+    )
+    # Rolling-window width for the telemetry metric store — counter
+    # totals and SLO latency percentiles read over this horizon.
+    telemetry_window_s: float = _env_float(
+        "DRYAD_TPU_TELEMETRY_WINDOW_S", 60.0
+    )
 
     def __post_init__(self) -> None:
         self.validate()
@@ -490,8 +508,11 @@ class DryadConfig:
             )
         if self.stream_host_reprobe < 0:
             raise ValueError("stream_host_reprobe must be >= 0")
-        if self.dispatch_depth < 1:
-            raise ValueError("dispatch_depth must be >= 1")
+        if self.dispatch_depth != -1 and self.dispatch_depth < 1:
+            raise ValueError(
+                "dispatch_depth must be >= 1, or -1 for the adaptive "
+                "headroom policy"
+            )
         if self.chunk_fuse < 1:
             raise ValueError("chunk_fuse must be >= 1")
         if self.command_batch < 0:
@@ -510,6 +531,10 @@ class DryadConfig:
             )
         if self.serve_cache_min_sec_per_gb < 0:
             raise ValueError("serve_cache_min_sec_per_gb must be >= 0")
+        if self.telemetry_sample_s <= 0:
+            raise ValueError("telemetry_sample_s must be > 0")
+        if self.telemetry_window_s <= 0:
+            raise ValueError("telemetry_window_s must be > 0")
 
 
 # Every ``DryadConfig`` field, one line each — THE documented key
@@ -579,7 +604,8 @@ CONFIG_KEYS = {
     "diagnose_skew_ratio": "partition-skew max/mean row-ratio trigger",
     "diagnose_recompile_burst": "per-tier compiles in window = storm",
     "diagnose_cooldown_s": "per-(rule, subject) re-diagnosis cooldown",
-    "dispatch_depth": "ooc chunk dispatches in flight; 1 = serial driver",
+    "dispatch_depth": "ooc chunk dispatches in flight; 1 = serial "
+                      "driver, -1 = adaptive from measured headroom",
     "chunk_fuse": "chunk partial-plans lowered per dispatch; 1 = legacy",
     "do_while_device_auto": "try lax.while_loop for every fixed point",
     "command_batch": "gang run commands per runbatch round trip; 0 off",
@@ -593,4 +619,7 @@ CONFIG_KEYS = {
         "cost admission floor: saved seconds per cached GB",
     "plan_rewrite": "runtime plan rewriter (dryad_tpu.rewrite); "
                     "diagnosis-driven, byte-identity-preserving",
+    "obs_telemetry": "continuous resource sampler + measured headroom",
+    "telemetry_sample_s": "min seconds between resource samples",
+    "telemetry_window_s": "rolling metric window for SLO readouts",
 }

@@ -110,6 +110,31 @@ def test_recorder_probes_feed_snapshots(tmp_path):
     assert "inflight" not in rec.snapshot()
 
 
+def test_shared_probe_registered_once_feeds_both_planes(tmp_path):
+    # the dedup contract: a module-level probe() registers ONCE in the
+    # shared registry and feeds BOTH the blackbox microsnapshots and
+    # the continuous-telemetry sampler; unprobe removes it from both
+    rec = flightrec.install_recorder(dump_dir=str(tmp_path))
+    flightrec.probe("serve:queue", lambda: {"queued": 7})
+    assert rec.snapshot()["serve:queue"] == {"queued": 7}
+    assert flightrec.sample_shared_probes()["serve:queue"] == {"queued": 7}
+    flightrec.unprobe("serve:queue")
+    assert "serve:queue" not in rec.snapshot()
+    assert "serve:queue" not in flightrec.sample_shared_probes()
+    # shared sampling skips raising probes instead of failing the plane
+    flightrec.probe("broken", lambda: 1 / 0)
+    assert "broken" not in flightrec.sample_shared_probes()
+    flightrec.unprobe("broken")
+    # instance-local probes stay private to their recorder and win
+    # name collisions over the shared registry
+    flightrec.probe("x", lambda: "shared")
+    rec.probe("x", lambda: "mine")
+    assert rec.snapshot()["x"] == "mine"
+    assert flightrec.sample_shared_probes()["x"] == "shared"
+    flightrec.unprobe("x")
+    assert "x" not in flightrec.sample_shared_probes()
+
+
 def test_install_taps_events_and_uninstall_detaches(tmp_path):
     log = EventLog(None, mem_cap=64)
     rec = flightrec.install_recorder(

@@ -648,7 +648,9 @@ def _async_pipeline(op, q):
     )
 
 
-def _run_stream_async(chunks, op, depth, fuse, nparts=8, **cfg_kw):
+def _run_stream_async(
+    chunks, op, depth, fuse, nparts=8, ctx_hook=None, **cfg_kw
+):
     from dryad_tpu import DryadConfig
 
     cfg_kw.setdefault("stream_combine_rows", 100)  # force mid-stream combines
@@ -663,6 +665,8 @@ def _run_stream_async(chunks, op, depth, fuse, nparts=8, **cfg_kw):
             chunk_fuse=fuse, **cfg_kw,
         ),
     )
+    if ctx_hook is not None:
+        ctx_hook(ctx)  # e.g. inject a fake-fed HeadroomProvider
     q = ctx.from_stream(
         iter([{c: v.copy() for c, v in ch.items()} for ch in chunks])
     )
@@ -763,4 +767,81 @@ def test_async_dispatch_fused_matches_serial():
     chunks = _async_chunks(rng)
     _assert_async_matches_serial(
         chunks, "group", 2, 3, "chunk-fuse+plan-fuse", plan_fuse=True
+    )
+
+
+# -- measured-headroom adaptive policies vs static (obs.telemetry) -----------
+#
+# dispatch_depth=-1 and exchange_window=-1-with-measured-headroom only
+# move the same window/depth knobs the static sweeps above prove
+# byte-identity-preserving — so an adaptive run fed ANY measurement
+# must match its static counterpart bit-for-bit.  The providers here
+# are real HeadroomProviders fed fake measurements: the policy path is
+# exactly production's, only the sampler is bypassed.
+
+
+@pytest.mark.parametrize("seed", _ASYNC_SEEDS)
+@pytest.mark.parametrize("op", ("group", "sort", "agg"))
+def test_adaptive_dispatch_depth_matches_serial(op, seed):
+    from dryad_tpu.obs.telemetry import HeadroomProvider
+
+    rng = np.random.default_rng(seed)
+    chunks = _async_chunks(rng)
+    provider = HeadroomProvider()
+    provider.update(2 << 30)  # 2GB measured -> depth tier 3
+
+    def hook(ctx):
+        ctx.headroom = provider
+
+    on, ctx_on = _run_stream_async(chunks, op, -1, 1, ctx_hook=hook)
+    off, _ = _run_stream_async(chunks, op, 1, 1)
+    wins = [
+        e for e in ctx_on.executor.events.events()
+        if e["kind"] == "dispatch_window"
+    ]
+    # depth 3 proves the MEASURED tier drove the policy: the
+    # no-measurement adaptive default is 2, serial is 1
+    assert wins and any(e["depth"] == 3 for e in wins), (
+        f"op={op} seed={seed}: adaptive depth should resolve to 3"
+    )
+    assert sum(e["dispatches"] for e in wins) >= 2
+    _assert_byte_identical_rows(
+        on, off, f"adaptive-depth op={op} seed={seed}"
+    )
+
+
+@pytest.mark.parametrize("seed", _XCHG_SEEDS)
+@pytest.mark.parametrize("op", ("hash", "range", "join"))
+def test_exchange_measured_headroom_matches_static(seed, op):
+    from dryad_tpu import DryadConfig
+    from dryad_tpu.obs.telemetry import HeadroomProvider
+
+    rng = np.random.default_rng(seed)
+    tbl = _rand_table(rng, int(rng.integers(80, 400)))
+
+    def run(w, provider=None):
+        ctx = DryadContext(
+            num_partitions_=8, config=DryadConfig(exchange_window=w)
+        )
+        if provider is not None:
+            ctx.executor.headroom = provider
+        out = _xchg_pipeline(op, ctx.from_arrays(tbl)).collect()
+        rounds = [
+            e for e in ctx.events.events() if e["kind"] == "exchange_round"
+        ]
+        return out, rounds
+
+    provider = HeadroomProvider()
+    provider.update(1)  # near-zero measured headroom -> window 1
+    out_adaptive, rounds = run(-1, provider)
+    out_flat, flat_rounds = run(0)
+    # at the default 256MB budget the auto policy resolves FLAT for
+    # these table sizes; window 1 proves measured headroom overrode
+    # the configured budget (precedence: hint > measured > budget)
+    assert rounds and all(e["window"] == 1 for e in rounds), (
+        f"seed={seed} op={op}: measured headroom should force window 1"
+    )
+    assert all(e["window"] == 0 for e in flat_rounds)
+    _assert_byte_identical_rows(
+        out_adaptive, out_flat, f"measured-headroom seed={seed} op={op}"
     )

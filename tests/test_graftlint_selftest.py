@@ -398,6 +398,64 @@ def test_event_schema_star_kwargs_checked_for_inclusion_only():
     _assert_fires(bad, "event-schema", n=1)
 
 
+# -- metric-key --------------------------------------------------------------
+
+TELEMETRY = "dryad_tpu/obs/telemetry.py"
+METRIC_EMITTER = "dryad_tpu/serve/metricsrc.py"
+
+METRIC_FIXTURE = {
+    TELEMETRY: '''\
+METRIC_KEYS = {
+    "ticks": "tick counter",
+    "depth": "queue depth gauge",
+    "lat_s": "latency histogram",
+}
+''',
+    METRIC_EMITTER: '''\
+def go(store):
+    store.incr("ticks", tenant="a")
+    store.set_gauge("depth", 3)
+    store.observe_latency("lat_s", 0.25, tenant="a")
+''',
+}
+
+
+def test_metric_key_clean_fixture():
+    assert _rules(METRIC_FIXTURE, "metric-key") == []
+
+
+@pytest.mark.parametrize(
+    "path,old,new",
+    [
+        (METRIC_EMITTER, 'store.incr("ticks", tenant="a")',
+         'store.incr("boom", tenant="a")'),
+        (TELEMETRY, '"ticks": "tick counter",',
+         '"ticks": "tick counter",\n    "ghost": "never emitted",'),
+        (TELEMETRY, '"tick counter"', '""'),
+        (METRIC_EMITTER, 'store.set_gauge("depth", 3)',
+         'name = "depth"\n    store.set_gauge(name, 3)'),
+        (TELEMETRY, "METRIC_KEYS", "OTHER_KEYS"),
+    ],
+    ids=["unregistered-metric", "stale-registry-key", "empty-doc",
+         "non-literal-name", "missing-registry"],
+)
+def test_metric_key_fires(path, old, new):
+    mutated = _mutate(METRIC_FIXTURE, path, old, new)
+    fired = _rules(mutated, "metric-key")
+    assert fired and set(fired) == {"metric-key"}, fired
+
+
+def test_metric_key_unregistered_and_stale_both_fire():
+    # renaming an emit site is BOTH an unregistered emission and a
+    # stale registry entry — the rule reports each direction
+    mutated = _mutate(
+        METRIC_FIXTURE, METRIC_EMITTER,
+        'store.incr("ticks", tenant="a")',
+        'store.incr("tocks", tenant="a")',
+    )
+    _assert_fires(mutated, "metric-key", n=2)
+
+
 # -- kernel-determinism ------------------------------------------------------
 
 DET = "dryad_tpu/ops/det.py"

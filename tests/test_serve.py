@@ -505,3 +505,51 @@ def test_service_builds_cache_from_config(rng):
         assert "rejected" in svc.stats()["cache"]
     with pytest.raises(ValueError):
         DryadConfig(serve_cache_admission="lfu")
+
+
+# -- continuous telemetry: per-tenant SLO + the metricsd scrape ---------------
+
+
+def test_slo_store_and_metricsd_scrape_show_per_tenant_quantiles(
+    rng, tmp_path, capsys
+):
+    """The ISSUE-15 acceptance path end-to-end: a live serve workload
+    writes its event log, the in-process RollingStore reports
+    per-tenant admission->completion percentiles via stats()["slo"],
+    and an out-of-process metricsd scrape of the SAME log reproduces
+    p50/p95/p99 for every tenant."""
+    import glob
+
+    from dryad_tpu.tools import metricsd
+
+    ldir = str(tmp_path / "evlog")
+    ctx = DryadContext(
+        num_partitions_=8,
+        config=DryadConfig(event_log_dir=ldir, serve_result_cache_bytes=0),
+    )
+    t = ctx.from_arrays(_mk_data(rng))
+    queries = _shapes(t)[:2]
+    with QueryService(ctx) as svc:
+        for name in ("alpha", "beta"):
+            s = svc.session(name)
+            for q in queries:
+                s.run(q, timeout=120)
+        stats = svc.stats()
+    for name in ("alpha", "beta"):
+        pct = stats["slo"][name]
+        assert pct is not None and pct["n"] == len(queries)
+        assert 0.0 < pct["p50"] <= pct["p95"] <= pct["p99"]
+    # the live stream carried resource samples (the context tap)
+    log_path = glob.glob(f"{ldir}/*.jsonl")[0]
+    events, _ = metricsd.load_events(log_path)
+    assert any(e.get("kind") == "resource_sample" for e in events)
+    # scrape: fold the recorded log and render a Prometheus page
+    assert metricsd.main([log_path]) == 0
+    page = capsys.readouterr().out
+    for name in ("alpha", "beta"):
+        assert f'dryad_queries_admitted_total{{tenant="{name}"}} 2' in page
+        for q in ("0.5", "0.95", "0.99"):
+            assert (
+                f'dryad_query_latency_s{{tenant="{name}",quantile="{q}"}}'
+                in page
+            )

@@ -288,6 +288,23 @@ def test_resolve_window_auto_budget_policy():
     assert resolve_window(-1, 0, mb, 1) == 0
 
 
+def test_resolve_window_measured_headroom_beats_budget():
+    from dryad_tpu.plan.xchgplan import resolve_window
+
+    mb = 1 << 20
+    # the configured budget says flat, the measurement says starved:
+    # measured wins
+    assert resolve_window(-1, 8, mb, 8 * mb, headroom_bytes=mb) == 1
+    # measured headroom wide enough for the flat buffer: stay flat
+    assert resolve_window(-1, 8, mb, 1, headroom_bytes=8 * mb) == 0
+    # precedence: rewriter hint > measured headroom > budget
+    assert resolve_window(-1, 8, mb, 8 * mb, hint=3, headroom_bytes=mb) == 3
+    # static knob still wins over everything
+    assert resolve_window(2, 8, mb, 8 * mb, headroom_bytes=mb) == 2
+    # no measurement (None): identical to the budget-only policy
+    assert resolve_window(-1, 8, mb, 4 * mb, headroom_bytes=None) == 4
+
+
 def test_resolve_window_deterministic_for_compile_key():
     from dryad_tpu.plan.xchgplan import resolve_window
 
