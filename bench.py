@@ -705,6 +705,135 @@ def asyncpipe_metric(n: int, chunk_rows: int = 1 << 17, nqueries: int = 6):
     }
 
 
+def gangtree_metric(nrows: int = 1 << 16, nqueries: int = 8):
+    """Gang hot path matrix on a 4-worker gang: worker-side combine
+    tree off/on (submit_partitioned at fan-in 4 per worker) crossed
+    with command-window depth {1, 2} (submit_many, J=``nqueries``
+    queries at command_batch=2).  Per cell: rows/s plus the three
+    ingress numbers the tree exists to shrink — driver-ingress wire
+    bytes (assemble_fetch), mailbox round trips, and job-root re-read
+    bytes on the workers (0 once the partition cache is warm) — and
+    the window's peak envelopes in flight (>= 2 proves the overlap).
+    Byte-identity against the flat/serial cell is asserted, not
+    assumed.  Host-bound: the workers pin JAX_PLATFORMS=cpu on any
+    backend, so the structure transfers while absolute rows/s is a
+    CPU number."""
+    from dryad_tpu import DryadConfig, DryadContext
+    from dryad_tpu.cluster.localjob import LocalJobSubmission
+    from dryad_tpu.obs.metrics import JobMetrics
+
+    # fan-in 8 per worker: every part holds (almost) the full key set,
+    # so the per-worker fold shrinks rows ~8x and ingress ~6x after
+    # per-file header overhead
+    workers, nparts = 4, 32
+    rng = np.random.default_rng(7)
+    tbl = {
+        "k": rng.integers(0, 128, nrows).astype(np.int32),
+        "v": rng.integers(-1000, 1000, nrows).astype(np.int32),
+    }
+
+    def mkq(**cfg):
+        ctx = DryadContext(num_partitions_=1, config=DryadConfig(**cfg))
+        return ctx.from_arrays(tbl).group_by(
+            "k", {"c": ("count", None), "s": ("sum", "v"),
+                  "mn": ("min", "v")}
+        )
+
+    def ingress(evs):
+        return sum(
+            int(e.get("wire_bytes", 0) or 0)
+            for e in evs if e["kind"] == "assemble_fetch"
+        )
+
+    out = {"workers": workers, "nparts": nparts, "queries": nqueries}
+    with LocalJobSubmission(
+        num_workers=workers, devices_per_worker=1
+    ) as sub:
+        # -- worker-tree half: partitioned vertex tasks, tree off/on --
+        sub.submit_partitioned(  # warm package/compile caches
+            mkq(), nparts=nparts, coded=False
+        )
+        tree_cells = {}
+        baseline = None
+        for on in (False, True):
+            n0 = len(sub.events.events())
+            rt0 = sub.round_trips
+            t0 = time.perf_counter()
+            res = sub.submit_partitioned(
+                mkq(gang_combine_tree=on), nparts=nparts, coded=False
+            )
+            wall = time.perf_counter() - t0
+            evs = sub.events.events()[n0:]
+            m = JobMetrics.from_events(evs)
+            if baseline is None:
+                baseline = res
+            else:
+                for c in baseline:
+                    assert baseline[c].tobytes() == res[c].tobytes(), c
+            tree_cells[f"tree_{'on' if on else 'off'}"] = {
+                "rows_per_sec": round(nrows / max(wall, 1e-9), 1),
+                "wall_s": round(wall, 3),
+                "driver_ingress_bytes": ingress(evs),
+                "round_trips": sub.round_trips - rt0,
+                "job_root_read_bytes": m.gang_root_read_bytes,
+                "cache_hits": m.gang_cache_hits,
+                "premerged_parts": m.gang_premerge_parts,
+            }
+        out["tree"] = tree_cells
+        out["ingress_reduction"] = round(
+            tree_cells["tree_off"]["driver_ingress_bytes"]
+            / max(tree_cells["tree_on"]["driver_ingress_bytes"], 1), 2
+        )
+
+        # -- window half: J queries through submit_many, depth 1 vs 2 --
+        def many(depth):
+            qs = [
+                mkq(command_batch=2, gang_batch_depth=depth)
+                for _ in range(nqueries)
+            ]
+            n0 = len(sub.events.events())
+            rt0 = sub.round_trips
+            t0 = time.perf_counter()
+            res = sub.submit_many(qs)
+            wall = time.perf_counter() - t0
+            m = JobMetrics.from_events(sub.events.events()[n0:])
+            return res, {
+                "rows_per_sec": round(
+                    nqueries * nrows / max(wall, 1e-9), 1
+                ),
+                "wall_s": round(wall, 3),
+                "round_trips": sub.round_trips - rt0,
+                "peak_in_flight": m.gang_peak_in_flight,
+                "window_retries": m.gang_retries,
+            }
+
+        serial, cell1 = many(1)
+        windowed, cell2 = many(2)
+        for a, b in zip(serial, windowed):
+            for c in a:
+                assert a[c].tobytes() == b[c].tobytes(), c
+        assert cell2["peak_in_flight"] >= 2, cell2
+        out["window"] = {"depth_1": cell1, "depth_2": cell2}
+
+    best = max(
+        tree_cells["tree_on"]["rows_per_sec"],
+        out["window"]["depth_2"]["rows_per_sec"],
+    )
+    out.update({
+        "metric": "gangtree_rows_per_sec",
+        "value": best,
+        "unit": "rows/s",
+        "baseline": "flat driver assembly + serial depth-1 windows",
+        "rows": nrows,
+        "cores": os.cpu_count(),
+        "platform": _PLATFORM,
+        "contended": False,
+        "spread": 1.0,
+        "reps_s": [out["window"]["depth_2"]["wall_s"]],
+    })
+    return out
+
+
 # Child body for aggtree_metric: the hybrid (DCN x ICI) mesh needs 8
 # virtual devices, and the parent process may already have initialized
 # its backend with a different device count (CPU fallback pins 1), so
@@ -1927,6 +2056,12 @@ def child_main() -> None:
              1 << 23 if accel else 1 << 20,
              chunk_rows=1 << 20 if accel else 1 << 17),
          240 if accel else 90, False),
+        # gang hot path: worker-side combine tree off/on x command
+        # window depth {1,2} on a 4-worker gang (host-bound — the
+        # workers pin JAX_PLATFORMS=cpu on any backend)
+        ("gangtree_rows_per_sec",
+         lambda: gangtree_metric(1 << 16),
+         240, False),
         # combine tree vs flat merge over a hybrid DCN x ICI mesh
         # (8 virtual CPU devices in a subprocess on any backend:
         # merge structure and byte accounting are platform-free)

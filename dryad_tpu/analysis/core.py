@@ -221,6 +221,7 @@ def _load_builtin() -> None:
         checks_events,
         checks_fusion,
         checks_layering,
+        checks_mailbox,
         checks_obs,
         checks_operands,
         checks_recompile,

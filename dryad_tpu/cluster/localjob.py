@@ -69,69 +69,24 @@ from dryad_tpu.utils.logging import get_logger
 log = get_logger("dryad_tpu.cluster.localjob")
 
 
-_MIX64 = np.uint64(0x9E3779B97F4A7C15)
-
-
 def _driver_key_hash(cols, keys) -> np.ndarray:
-    """Row hash over the key columns for similarity HISTOGRAMS only.
-    Placement is driver-local (no cross-process agreement needed), so
-    strings may hash by value without the engine dictionary."""
-    n = len(cols[keys[0]])
-    h = np.full(n, np.uint64(0x84222325), np.uint64)
-    for k in keys:
-        a = cols[k]
-        if a.dtype == object or a.dtype.kind in ("U", "S"):
-            uniq, inv = np.unique(a.astype(object), return_inverse=True)
-            hs = np.asarray(
-                [hash(str(s)) & 0xFFFFFFFFFFFFFFFF for s in uniq],
-                np.uint64,
-            )
-            w = hs[inv]
-        elif a.dtype.kind == "f":
-            w = np.ascontiguousarray(a.astype(np.float64)).view(np.uint64)
-        elif a.dtype.kind == "b":
-            w = a.astype(np.uint64)
-        else:
-            w = a.astype(np.int64).view(np.uint64)
-        h = (h ^ w) * _MIX64
-        h ^= h >> np.uint64(29)
-    return h
+    """Row hash over the key columns for similarity HISTOGRAMS.  Now
+    that gang workers ship level-(-1) pre-merge snapshots, driver- and
+    worker-computed histograms must live in ONE range space, so this
+    delegates to the shared deterministic hash
+    (``exec.partial.key_hash64`` — engine Hash64 for strings, never
+    Python's process-salted ``hash()``)."""
+    return _partial.key_hash64(cols, keys)
 
 
 def _merge_group_state(cols, keys, red) -> Dict[str, np.ndarray]:
     """Fold one merge group's partial STATE rows by key with the plan's
     associative reductions (``exec.partial.state_reductions``) — no
-    finalize, so the result is itself a valid partial table."""
-    n = len(cols[keys[0]]) if keys else 0
-    tups = list(zip(*[cols[k].tolist() for k in keys])) if n else []
-    index: Dict[tuple, list] = {}
-    for i, t in enumerate(tups):
-        index.setdefault(t, []).append(i)
-    out: Dict[str, list] = {k: [] for k in keys}
-    for c in red:
-        out[c] = []
-    for t, idxs in index.items():
-        for k, kv in zip(keys, t):
-            out[k].append(kv)
-        ii = np.asarray(idxs)
-        for c, op in red.items():
-            v = cols[c][ii]
-            if op == "sum":
-                out[c].append(v.sum())
-            elif op == "min":
-                out[c].append(v.min())
-            elif op == "max":
-                out[c].append(v.max())
-            elif op == "any":
-                out[c].append(np.any(v))
-            else:  # all
-                out[c].append(np.all(v))
-    res = {k: np.asarray(out[k], dtype=cols[k].dtype) for k in keys}
-    for c in red:
-        # promoted accumulators (int sums widen) keep their width; the
-        # flat root pass narrows to the output schema at finalize
-        res[c] = np.asarray(out[c])
-    return res
+    finalize, so the result is itself a valid partial table.  The fold
+    itself lives in ``exec.partial.merge_state_rows`` so the gang
+    workers' level-(-1) pre-merge is the same code path byte for
+    byte."""
+    return _partial.merge_state_rows(cols, keys, red)
 
 
 def _free_port() -> int:
@@ -724,19 +679,33 @@ class LocalJobSubmission:
         with the first error (per-command classification preserved in
         the aggregated status)."""
         queries = list(queries)
+        cfgs = [getattr(q.ctx, "config", None) for q in queries]
         if batch is None:
-            cfg = getattr(queries[0].ctx, "config", None) if queries else None
-            batch = int(getattr(cfg, "command_batch", 0) or 0)
+            # the gang executes ONE envelope per worker, so the most
+            # conservative query governs the whole batch — reading only
+            # queries[0] would silently over-batch a stricter peer
+            sizes = [int(getattr(c, "command_batch", 0) or 0) for c in cfgs]
+            batch = min(sizes) if sizes else 0
+            if sizes and batch != max(sizes):
+                self.events.emit(
+                    "command_batch", worker=-1, commands=batch,
+                    round_trips_saved=0, clamped_from=max(sizes),
+                )
+        depths = [int(getattr(c, "gang_batch_depth", 1) or 1) for c in cfgs]
+        depth = min(depths) if depths else 1
         if batch <= 1 or len(queries) <= 1:
             return [self.submit(q) for q in queries]
+        if depth > 1:
+            return self._submit_gang_windowed(queries, batch, depth)
         out: List[Dict[str, np.ndarray]] = []
         for at in range(0, len(queries), batch):
             out.extend(self._submit_gang_batch(queries[at:at + batch]))
         return out
 
-    def _submit_gang_batch(self, queries) -> List[Dict[str, np.ndarray]]:
-        self._check_workers_alive()
-        self._sync_membership()
+    def _pack_batch(self, queries) -> Tuple[List[Dict], List[str]]:
+        """Pack each query of one batch; returns the run sub-commands
+        (each with its own seq — the start/done barrier keys; the batch
+        envelope owns the cseq echo) and the per-query result dirs."""
         subs: List[Dict] = []
         result_rels: List[str] = []
         for query in queries:
@@ -751,12 +720,37 @@ class LocalJobSubmission:
                 pack_query(query, os.path.join(self.root, pkg_rel))
             result_rel = f"{self.job_id}/r{seq}/result"
             result_rels.append(result_rel)
-            # sub-commands carry their own seq (the start/done barrier
-            # keys); the batch envelope owns the cseq echo
             subs.append({
                 "kind": "run", "package": pkg_rel,
                 "result_dir": result_rel, "seq": seq,
             })
+        return subs, result_rels
+
+    def _record_sub_durations(self, queries, per_worker_results) -> None:
+        """Fold the workers' per-sub-command wall clocks into the
+        per-plan duration models.  The batch path used to smear ONE
+        batch-wide dt over K plans, poisoning every model with K-1
+        foreign commands' time; workers now ship each sub-command's own
+        duration, and the gang sample is the max across members (a gang
+        command is as slow as its slowest member)."""
+        from dryad_tpu.plan.nodes import walk
+
+        for j, query in enumerate(queries):
+            secs = [
+                r[j].get("seconds")
+                for r in per_worker_results
+                if j < len(r) and r[j].get("seconds") is not None
+            ]
+            if not secs:
+                continue
+            sig = tuple(nd.kind for nd in walk([query.node]))
+            st = self._gang_stats.setdefault(sig, StageStatistics())
+            st.record(max(secs))
+
+    def _submit_gang_batch(self, queries) -> List[Dict[str, np.ndarray]]:
+        self._check_workers_alive()
+        self._sync_membership()
+        subs, result_rels = self._pack_batch(queries)
         seqs = [s["seq"] for s in subs]
         cmd = {"kind": "runbatch", "cmds": subs, "cseq": self._next_cseq()}
         t_run0 = time.monotonic()
@@ -801,6 +795,9 @@ class LocalJobSubmission:
             "gang_run_complete", seq=seqs[0], seconds=round(dt, 3)
         )
         self._collect_telemetry()
+        self._record_sub_durations(
+            queries, [p.result.get("results") or [] for p in procs]
+        )
         out: List[Dict[str, np.ndarray]] = []
         for j, (query, result_rel) in enumerate(zip(queries, result_rels)):
             part_ids: set = set()
@@ -809,6 +806,171 @@ class LocalJobSubmission:
                 if j < len(sub_sts):
                     part_ids.update(sub_sts[j].get("parts") or [])
             out.append(self._assemble(query, result_rel, sorted(part_ids)))
+        return out
+
+    def _submit_gang_windowed(
+        self, queries, batch: int, depth: int
+    ) -> List[Dict[str, np.ndarray]]:
+        """Overlapped command streams: keep up to ``depth`` runbatch
+        envelopes in flight per worker (``config.gang_batch_depth``).
+        The driver thread only FEEDS — it packs each batch, posts its
+        envelope to every worker's command mailbox, and hands the
+        blocking status drain to the :class:`GangDispatchWindow`
+        collector — so the gang starts batch k+1 the moment it finishes
+        batch k instead of idling through a driver round trip.
+
+        Two distinct keys make the overlap safe on a latest-value
+        mailbox: each envelope posts its status to its OWN per-envelope
+        key (``wstatus/<i>/c<cseq>``), and the worker ACKS the dequeue
+        itself (``ack/<i>/c<cseq>``) so the feed never overwrites the
+        shared ``cmd/<i>`` slot while an unread envelope sits in it.
+        Results commit strictly in submit order; a batch with failed
+        sub-commands re-runs those queries SERIALLY at its commit
+        position (fresh seqs — consumed barrier keys are never reused),
+        so the output is byte-identical to the depth-1 serial loop."""
+        from dryad_tpu.cluster.gangwindow import GangDispatchWindow
+
+        mb = self.service.mailbox
+        self._check_workers_alive()
+        self._sync_membership()
+        chunks = [
+            queries[at:at + batch] for at in range(0, len(queries), batch)
+        ]
+        results: List[Optional[List[Dict[str, np.ndarray]]]] = (
+            [None] * len(chunks)
+        )
+        posted = [0] * self.n
+        statused = [0] * self.n
+        last_ack: List[Optional[str]] = [None] * self.n
+
+        def await_ack(i: int, key: str) -> None:
+            deadline = time.monotonic() + self.timeout
+            while True:
+                if mb.get_prop(self.job_id, key, 0, timeout=0.5) is not None:
+                    return
+                self._check_workers_alive()
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"worker {i}: envelope never dequeued "
+                        f"(no ack on {key}); log tail:\n"
+                        + self._worker_log_tail(i)
+                    )
+
+        def await_status(i: int, skey: str, cseq: int, deadline) -> Dict:
+            while True:
+                got = mb.get_prop(self.job_id, skey, 0, timeout=1.0)
+                if got is not None:
+                    st = json.loads(got[1])
+                    if st.get("cseq") == cseq:
+                        statused[i] += 1
+                        return st
+                self._check_workers_alive()
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"worker {i}: no windowed status after "
+                        f"{self.timeout}s; log tail:\n"
+                        + self._worker_log_tail(i)
+                    )
+
+        def commit(tag, value, error, win) -> None:
+            """Consume one drained batch at its commit position (submit
+            order): surface drain-site errors, re-run failed queries
+            serially, record durations, assemble."""
+            if error is not None:
+                raise error
+            chunk, sts = value["chunk"], value["statuses"]
+            per_worker = [st.get("results") or [] for st in sts]
+            self.events.emit(
+                "gang_run_complete", seq=value["seqs"][0],
+                seconds=round(time.monotonic() - value["t_post"], 3),
+            )
+            self._collect_telemetry()
+            self._record_sub_durations(chunk, per_worker)
+            out: List[Dict[str, np.ndarray]] = []
+            for j, query in enumerate(chunk):
+                failed = any(
+                    j < len(r) and r[j].get("state") != "completed"
+                    for r in per_worker
+                ) or any(j >= len(r) for r in per_worker)
+                if failed:
+                    # the shared cmd slot may still hold a later unread
+                    # envelope — wait for its dequeue ack before the
+                    # serial re-run posts into the same slot
+                    win.note_retry()
+                    for i in range(self.n):
+                        if last_ack[i] is not None:
+                            await_ack(i, last_ack[i])
+                    out.append(self.submit(query, auto_recover=False))
+                    continue
+                part_ids: set = set()
+                for r in per_worker:
+                    part_ids.update(r[j].get("parts") or [])
+                out.append(
+                    self._assemble(
+                        query, value["result_rels"][j], sorted(part_ids)
+                    )
+                )
+            results[tag] = out
+
+        win = GangDispatchWindow(
+            depth, events=self.events, name="submit_many"
+        )
+        try:
+            for k, chunk in enumerate(chunks):
+                subs, result_rels = self._pack_batch(chunk)
+                seqs = [s["seq"] for s in subs]
+                cseq = self._next_cseq()
+                self.events.emit(
+                    "gang_run_start", seq=seqs[0], workers=self.n
+                )
+                t_post = time.monotonic()
+                skeys: List[str] = []
+                for i in range(self.n):
+                    self.events.emit(
+                        "command_batch", worker=i, commands=len(subs),
+                        round_trips_saved=len(subs) - 1, seqs=seqs,
+                    )
+                    if last_ack[i] is not None:
+                        await_ack(i, last_ack[i])
+                    ack = f"ack/{i}/c{cseq}"
+                    skey = f"wstatus/{i}/c{cseq}"
+                    env = {
+                        "kind": "runbatch", "cmds": subs, "cseq": cseq,
+                        "ack": ack, "skey": skey,
+                    }
+                    self.round_trips += 1
+                    mb.set_prop(
+                        self.job_id, f"cmd/{i}", json.dumps(env).encode()
+                    )
+                    last_ack[i] = ack
+                    posted[i] += 1
+                    win.note_in_flight(posted[i] - statused[i])
+                    skeys.append(skey)
+
+                def drain(cseq=cseq, skeys=skeys, chunk=chunk,
+                          result_rels=result_rels, seqs=seqs,
+                          t_post=t_post) -> Dict:
+                    deadline = time.monotonic() + self.timeout
+                    sts = [
+                        await_status(i, skey, cseq, deadline)
+                        for i, skey in enumerate(skeys)
+                    ]
+                    return {
+                        "statuses": sts, "chunk": chunk,
+                        "result_rels": result_rels, "seqs": seqs,
+                        "t_post": t_post,
+                    }
+
+                win.submit(k, drain)
+                for tag, value, error in win.ready():
+                    commit(tag, value, error, win)
+            for tag, value, error in win.drain():
+                commit(tag, value, error, win)
+        finally:
+            win.close(workers=self.n)
+        out: List[Dict[str, np.ndarray]] = []
+        for res in results:
+            out.extend(res or [])
         return out
 
     def _collect_telemetry(self) -> int:
@@ -987,11 +1149,15 @@ class LocalJobSubmission:
         stats = StageStatistics()
         run_t0: Dict[int, float] = {}  # ClusterProcess.id -> RUNNING ts
 
+        cache_bytes = int(
+            getattr(query.ctx.config, "gang_partition_cache_bytes", 0) or 0
+        )
+
         def make_proc(part: int, attempt: int) -> ClusterProcess:
             cmd = {
                 "kind": "runpart", "package": pkg_rel, "part": part,
                 "nparts": nparts, "result_dir": result_rel, "seq": seq,
-                "cseq": self._next_cseq(),
+                "cseq": self._next_cseq(), "cache_bytes": cache_bytes,
             }
             # Primaries spread round-robin as a soft preference;
             # duplicates go wherever a slot is free first.
@@ -1012,6 +1178,8 @@ class LocalJobSubmission:
 
         terminal = (PS.COMPLETED, PS.FAILED, PS.CANCELED)
         tasks: Dict[int, Dict] = {}
+        winners: Dict[int, int] = {}  # part -> worker that completed it
+        part_fps: Dict[int, str] = {}  # part -> content fp (cache key)
         for part in range(nparts):
             p = make_proc(part, 0)
             tasks[part] = {
@@ -1046,6 +1214,13 @@ class LocalJobSubmission:
                             winner.id, time.monotonic()
                         )
                         stats.record(dur)
+                        if winner.computer:
+                            winners[part] = int(
+                                winner.computer.removeprefix("worker")
+                            )
+                        wfp = (winner.result or {}).get("fp")
+                        if wfp:
+                            part_fps[part] = wfp
                         for p in t["procs"]:
                             if p is not winner and p.state not in terminal:
                                 self.scheduler.cancel(p)
@@ -1191,19 +1366,187 @@ class LocalJobSubmission:
         self.events.emit("vertex_job_complete", seq=seq)
         self._collect_telemetry()
         part_rows: List[int] = []
-        table = self._assemble(
-            query, result_rel, list(range(nparts)),
-            dictionary=query.ctx.dictionary, part_rows=part_rows,
-        )
+        table = None
+        snaps = None
+        if (
+            merge is not None
+            and merge[0] == "group"
+            and bool(getattr(query.ctx.config, "gang_combine_tree", False))
+            and not any(op == "first" for _o, op, _p in merge[2])
+        ):
+            # level -1: winners pre-merge their own parts worker-side;
+            # None (a worker died or refused) falls back to the flat
+            # assembly below — the part files are durable on the job
+            # root, so the pre-merge is an optimization, never a
+            # correctness dependency
+            pre = self._worker_combine(
+                query, pkg_rel, result_rel, nparts, winners, part_fps,
+                merge,
+            )
+            if pre is not None:
+                table, part_rows, snaps = pre
+        if table is None:
+            table = self._assemble(
+                query, result_rel, list(range(nparts)),
+                dictionary=query.ctx.dictionary, part_rows=part_rows,
+            )
         if merge is not None:
             table = self._merge_partials(
-                table, merge, part_rows=part_rows, config=query.ctx.config,
+                table, merge, part_rows=part_rows,
+                config=query.ctx.config, snaps=snaps,
             )
             self.events.emit(
                 "vertex_partials_merged", seq=seq,
                 rows=len(next(iter(table.values()), [])),
             )
         return table
+
+    def _worker_combine(
+        self, query, pkg_rel: str, result_rel: str, nparts: int,
+        winners: Dict[int, int], part_fps: Dict[int, str], merge,
+    ):
+        """Level -1 of the combine tree (``config.gang_combine_tree``):
+        each winner worker folds the un-finalized partial state of the
+        parts IT completed into one ``wpart<w>.dpf``
+        (``cluster.worker._combine_parts``) and ships a key-range
+        snapshot, so the driver fetches one partial per WORKER instead
+        of one per VERTEX — ingress drops by the per-worker fan-in and
+        the existing level-0/1 driver tree starts from pre-merged
+        segments.  Returns ``(table, part_rows, snaps)`` with the
+        decoded premerged segments (string keys looked up from the
+        driver dictionary — wparts carry raw Hash64 codes), or ``None``
+        when any worker's combine fails, where the caller assembles the
+        original parts flat (byte-identical either way)."""
+        from dryad_tpu.columnar.schema import ColumnType
+        from dryad_tpu.exec.partial import state_reductions
+
+        _kind, keys, plan, _out_schema = merge
+        by_worker: Dict[int, List[int]] = {}
+        for part in range(nparts):
+            w = winners.get(part)
+            if w is None:
+                return None  # owner unknown — keep the flat path
+            by_worker.setdefault(w, []).append(part)
+        self._reap_dead_workers()
+        wids = sorted(by_worker)
+        if not wids or any(w in self._dead for w in wids):
+            return None
+        config = query.ctx.config
+        red = state_reductions(plan)
+        ranges = int(getattr(config, "combine_tree_ranges", 64))
+        cache_bytes = int(
+            getattr(config, "gang_partition_cache_bytes", 0) or 0
+        )
+        terminal = (
+            ProcessState.COMPLETED, ProcessState.FAILED,
+            ProcessState.CANCELED,
+        )
+        procs = []
+        for widx, w in enumerate(wids):
+            cmd = {
+                "kind": "combineparts", "package": pkg_rel,
+                "result_dir": result_rel,
+                "parts": [
+                    {"part": p, "fp": part_fps.get(p)}
+                    for p in by_worker[w]
+                ],
+                "keys": list(keys), "red": red, "ranges": ranges,
+                "wid": widx, "cache_bytes": cache_bytes,
+                "cseq": self._next_cseq(),
+            }
+
+            def fn(proc: ClusterProcess, i=w, cmd=cmd) -> Dict:
+                # per-worker watch (gang=False): an unrelated death
+                # must not poison every winner's combine
+                return self._round_trip_body(i, cmd, proc, gang=False)
+
+            p = ClusterProcess(
+                fn, name=f"combine-w{w}",
+                affinities=[Affinity(f"worker{w}", hard=True)],
+            )
+            self.scheduler.schedule(p)
+            procs.append(p)
+        statuses = []
+        ok = True
+        for p in procs:
+            if not p.wait(self.timeout + 30.0):
+                ok = False
+                break
+            if p.state is not ProcessState.COMPLETED:
+                ok = False
+                break
+            statuses.append(p.result)
+        if not ok:
+            for p in procs:
+                if p.state not in terminal:
+                    self.scheduler.cancel(p)
+            log.warning(
+                "worker-side combine failed (%s); falling back to flat "
+                "assembly — part files are durable",
+                "; ".join(
+                    f"{p.name}: {p.error}" for p in procs if p.error
+                ) or "timeout",
+            )
+            return None
+        # premerged assembly: wparts hold LOGICAL columns already (the
+        # worker decoded before folding), so this is lookup +
+        # pass-through, not the physical decode
+        w0, r0 = self._client.wire_bytes, self._client.raw_bytes
+        tables = []
+        part_rows: List[int] = []
+        snaps: List[Dict] = []
+        with self.tracer.span(
+            "assemble", cat="driver", parts=len(statuses)
+        ):
+            for st in statuses:
+                host = parse_partition_bytes(
+                    self._client.read_whole_file(
+                        f"{result_rel}/{st['wfile']}", compress=True
+                    )
+                )
+                tbl: Dict[str, np.ndarray] = {}
+                for f in query.schema.fields:
+                    if f.name not in host:
+                        continue
+                    col = np.asarray(host[f.name])
+                    if f.ctype is ColumnType.STRING:
+                        col = np.array(
+                            query.ctx.dictionary.lookup_all(
+                                col.astype(np.uint64)
+                            ),
+                            dtype=object,
+                        )
+                    tbl[f.name] = col
+                tables.append(tbl)
+                part_rows.append(len(next(iter(tbl.values()), [])))
+                snaps.append(st.get("snapshot"))
+        self.events.emit(
+            "assemble_fetch", parts=len(statuses),
+            wire_bytes=self._client.wire_bytes - w0,
+            raw_bytes=self._client.raw_bytes - r0,
+        )
+        for widx, st in enumerate(statuses):
+            self.events.emit(
+                "gang_partial_combine", worker=wids[widx],
+                parts=len(st.get("parts") or []),
+                rows=int(st.get("rows", 0)),
+                in_rows=int(st.get("in_rows", 0)),
+                read_bytes=int(st.get("read_bytes", 0)),
+                cache_hits=int(st.get("cache_hits", 0)),
+                cache_misses=int(st.get("cache_misses", 0)),
+                bytes=int(st.get("bytes", 0)),
+            )
+            self.events.emit(
+                "combine_tree_level", level=-1, group=widx,
+                fan_in=len(st.get("parts") or []),
+                cap_rows=int(st.get("rows", 0)),
+                bytes=int(st.get("bytes", 0)),
+                ici_bytes=0, dcn_bytes=0, device=False,
+            )
+        table = {
+            c: np.concatenate([t[c] for t in tables]) for c in tables[0]
+        }
+        return table, part_rows, snaps
 
     # -- coded k-of-n vertex execution (dryad_tpu.redundancy) ----------------
     def _submit_coded(self, query, merge, nparts, decision):
@@ -1741,7 +2084,9 @@ class LocalJobSubmission:
             "group_dec", list(node.params["keys"]), dec, query.schema
         ), inner.node
 
-    def _merge_partials(self, table, merge, part_rows=None, config=None):
+    def _merge_partials(
+        self, table, merge, part_rows=None, config=None, snaps=None
+    ):
         """Final merge of assembled per-vertex partial results on the
         driver (the aggregation tree's root; reference
         ``DrDynamicAggregateManager`` final vertex).
@@ -1766,7 +2111,7 @@ class LocalJobSubmission:
             and not any(op == "first" for _out, op, _p in plan)
         ):
             table = self._tree_merge_state(
-                table, keys, plan, part_rows, config
+                table, keys, plan, part_rows, config, snaps=snaps
             )
         cols = {k: np.asarray(v) for k, v in table.items()}
         n = len(next(iter(cols.values()), []))
@@ -1823,26 +2168,37 @@ class LocalJobSubmission:
             result[o] = np.asarray(out[o]).astype(dt)
         return result
 
-    def _tree_merge_state(self, table, keys, plan, part_rows, config):
+    def _tree_merge_state(
+        self, table, keys, plan, part_rows, config, snaps=None
+    ):
         """Level-0 of the driver-side combine tree: slice the assembled
         table back into per-vertex segments, place segments into merge
         groups by key-histogram similarity, and fold each group's
         partial STATE (un-finalized, associative reductions only).
         Returns the concatenated group results — a valid partial table
-        the flat finalizing pass then reduces as the tree root."""
+        the flat finalizing pass then reduces as the tree root.
+        ``snaps``: per-segment key-range snapshots already computed at
+        a lower tree level (the gang workers' level-(-1) pre-merge
+        ships them — same deterministic hash, same range space), which
+        skip the driver-side hash + histogram pass."""
         from dryad_tpu.exec.combinetree import plan_groups
         from dryad_tpu.exec.partial import state_reductions
         from dryad_tpu.obs.metrics import KeyRangeHistogram
 
         cols = {k: np.asarray(v) for k, v in table.items()}
         ranges = int(getattr(config, "combine_tree_ranges", 64))
-        h = _driver_key_hash(cols, keys)
         bounds = np.cumsum([0] + list(part_rows))
-        snaps = []
-        for i in range(len(part_rows)):
-            kr = KeyRangeHistogram(ranges)
-            kr.observe(h[bounds[i]:bounds[i + 1]])
-            snaps.append(kr.snapshot())
+        if (
+            snaps is None
+            or len(snaps) != len(part_rows)
+            or any(s is None for s in snaps)
+        ):
+            h = _driver_key_hash(cols, keys)
+            snaps = []
+            for i in range(len(part_rows)):
+                kr = KeyRangeHistogram(ranges)
+                kr.observe(h[bounds[i]:bounds[i + 1]])
+                snaps.append(kr.snapshot())
         g = int(getattr(config, "combine_tree_groups", 0) or 0)
         n_groups = g if g > 0 else max(2, int(len(part_rows) ** 0.5))
         groups = plan_groups(snaps, n_groups)

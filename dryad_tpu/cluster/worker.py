@@ -77,7 +77,8 @@ class _PackageCache:
         return q, self.pristine
 
 
-def _run_part(cmd: Dict, args, client, pkgs: _PackageCache) -> Dict:
+def _run_part(cmd: Dict, args, client, pkgs: _PackageCache,
+              pcache=None) -> Dict:
     """Execute ONE vertex task: the plan restricted to input partition
     ``part`` of ``nparts``, on this worker's local device, writing the
     result as a partition file (the independent re-executable vertex of
@@ -85,6 +86,7 @@ def _run_part(cmd: Dict, args, client, pkgs: _PackageCache) -> Dict:
     writes identical bytes and the rename is atomic)."""
     import numpy as np
 
+    from dryad_tpu.cluster.partcache import content_fp
     from dryad_tpu.columnar.io import write_partition_file
     from dryad_tpu.exec.jobpackage import slice_binding
 
@@ -103,8 +105,121 @@ def _run_part(cmd: Dict, args, client, pkgs: _PackageCache) -> Dict:
     final = os.path.join(out_dir, f"part{part}.dpf")
     tmp = f"{final}.w{args.pid}.tmp"
     write_partition_file(tmp, cols)
+    # fingerprint the serialized bytes BEFORE the rename (duplicates
+    # write identical bytes, so every attempt reports the same fp) and
+    # keep them gang-resident: a later sub-command naming this
+    # partition by fp (level -1 combineparts) reads it from memory
+    # instead of the job root
+    with open(tmp, "rb") as fh:
+        blob = fh.read()
+    fp = content_fp(blob)
     os.replace(tmp, final)
-    return {"state": "completed", "parts": [part]}
+    if pcache is not None:
+        pcache.put(fp, blob)
+    return {"state": "completed", "parts": [part], "fp": fp}
+
+
+def _combine_parts(cmd: Dict, args, client, pkgs: _PackageCache,
+                   pcache=None, wlog=None) -> Dict:
+    """Level -1 of the gang combine tree: fold the un-finalized partial
+    STATE of the vertex parts THIS worker won into one partial table
+    (``exec.partial.merge_state_rows``) before anything ships to the
+    driver — the reference's dynamic aggregation-tree rewrite
+    (``DrDynamicAggregateManager.h:117-168``) pushed into the worker.
+    Ships one ``wpart<w>.dpf`` plus a KeyRangeHistogram snapshot over
+    DETERMINISTIC key hashes (``exec.partial.key_hash64`` — snapshots
+    must mean the same ranges in every process), so driver ingress
+    drops by this worker's vertex fan-in and the driver's level-0/1
+    tree starts from per-worker partials.  Part bytes resolve through
+    the :class:`~dryad_tpu.cluster.partcache.PartitionCache` by
+    content fingerprint — this worker wrote them moments ago, so the
+    common case never touches the job root."""
+    import numpy as np
+
+    from dryad_tpu.cluster.partcache import content_fp
+    from dryad_tpu.columnar.batch import decode_physical_table
+    from dryad_tpu.columnar.io import (
+        parse_partition_bytes,
+        write_partition_file,
+    )
+    from dryad_tpu.exec import faults
+    from dryad_tpu.exec.partial import key_hash64, merge_state_rows
+    from dryad_tpu.obs.metrics import KeyRangeHistogram
+
+    faults.registry.maybe_fail("combineparts")
+    if faults.registry.maybe_kill("combineparts"):
+        # mid-level-(-1) chaos: the process dies between winning its
+        # parts and shipping the folded partial — the driver must fall
+        # back to flat assembly (the part files are durable) and the
+        # blackbox must be on disk before the process vanishes
+        from dryad_tpu.obs import flightrec
+
+        if wlog is not None:
+            wlog.emit(
+                "worker_killed_injected", stage=-1, name="combineparts"
+            )
+        flightrec.dump_now("worker_killed:combineparts")
+        os._exit(113)
+
+    q, _pristine = pkgs.load(cmd["package"], client)
+    keys = list(cmd["keys"])
+    red = dict(cmd["red"])
+    tables = []
+    read_bytes = 0
+    hits = misses = 0
+    for spec in cmd["parts"]:
+        blob = None
+        fp = spec.get("fp")
+        if pcache is not None and fp:
+            blob = pcache.get(fp)
+        if blob is None:
+            misses += 1
+            blob = client.read_whole_file(
+                f"{cmd['result_dir']}/part{spec['part']}.dpf"
+            )
+            read_bytes += len(blob)
+            if pcache is not None:
+                pcache.put(fp or content_fp(blob), blob)
+        else:
+            hits += 1
+        host = parse_partition_bytes(blob)
+        # decode to logical columns WITHOUT the dictionary: string keys
+        # stay raw Hash64 codes (cross-process deterministic), so the
+        # fold groups on codes and the driver decodes once at assembly
+        tables.append(
+            decode_physical_table(q.schema, slice(None), host, None)
+        )
+    cols = {c: np.concatenate([t[c] for t in tables]) for c in tables[0]}
+    in_rows = int(len(next(iter(cols.values()), [])))
+    merged = merge_state_rows(cols, keys, red)
+    out_rows = int(len(merged[keys[0]])) if keys else 0
+    kr = KeyRangeHistogram(int(cmd.get("ranges", 64) or 64))
+    if keys and out_rows:
+        kr.observe(key_hash64(merged, keys))
+    out_dir = os.path.join(args.root, cmd["result_dir"])
+    os.makedirs(out_dir, exist_ok=True)
+    wname = f"wpart{int(cmd['wid'])}.dpf"
+    final = os.path.join(out_dir, wname)
+    tmp = f"{final}.w{args.pid}.tmp"
+    write_partition_file(tmp, merged)
+    with open(tmp, "rb") as fh:
+        out_blob = fh.read()
+    out_fp = content_fp(out_blob)
+    os.replace(tmp, final)
+    if pcache is not None:
+        pcache.put(out_fp, out_blob)
+    snap = {
+        k: (v.tolist() if hasattr(v, "tolist") else v)
+        for k, v in kr.snapshot().items()
+    }
+    return {
+        "state": "completed", "wfile": wname, "fp": out_fp,
+        "parts": [int(s["part"]) for s in cmd["parts"]],
+        "rows": out_rows, "in_rows": in_rows,
+        "bytes": len(out_blob), "read_bytes": read_bytes,
+        "cache_hits": hits, "cache_misses": misses,
+        "snapshot": snap,
+    }
 
 
 def _run_coded(cmd: Dict, args, client, pkgs: _PackageCache) -> Dict:
@@ -221,11 +336,34 @@ def _run_command(cmd: Dict, args, client, cp, wlog=None) -> Dict:
         os.unlink(pkg_path)
 
 
-def _exec_one(cmd: Dict, args, client, cp, pkgs, delay, wtracer, wlog) -> Dict:
-    """Execute one run/runpart/runcoded command and return its status
-    dict (no cseq — the caller stamps the mailbox echo).  Failures are
-    classified per command: a failed status carries the error, and the
-    worker keeps serving (report-and-continue, never crash the loop)."""
+def _resolve_pcache(pstate: Dict, cmd: Dict, args):
+    """Lazily build this worker's :class:`PartitionCache` the first time
+    a command carries a ``cache_bytes`` budget (the driver forwards
+    ``config.gang_partition_cache_bytes``); a zero/absent budget runs
+    the command cache-less without disturbing an existing cache."""
+    budget = int(cmd.get("cache_bytes", 0) or 0)
+    if budget <= 0:
+        return None
+    pc = pstate.get("pcache")
+    if pc is None:
+        from dryad_tpu.cluster.partcache import PartitionCache
+
+        pc = PartitionCache(
+            budget,
+            spill_dir=os.path.join(args.root, f".pcache-w{args.pid}"),
+        )
+        pstate["pcache"] = pc
+    return pc
+
+
+def _exec_one(cmd: Dict, args, client, cp, pkgs, delay, wtracer, wlog,
+              pstate=None) -> Dict:
+    """Execute one run/runpart/runcoded/combineparts command and return
+    its status dict (no cseq — the caller stamps the mailbox echo).
+    Failures are classified per command: a failed status carries the
+    error, and the worker keeps serving (report-and-continue, never
+    crash the loop)."""
+    pstate = pstate if pstate is not None else {}
     try:
         with wtracer.span(
             cmd["kind"], cat="worker", seq=cmd.get("seq"),
@@ -238,13 +376,19 @@ def _exec_one(cmd: Dict, args, client, cp, pkgs, delay, wtracer, wlog) -> Dict:
                     delay["count"] -= 1
                     time.sleep(delay["seconds"])
                 status = (
-                    _run_part(cmd, args, client, pkgs)
+                    _run_part(cmd, args, client, pkgs,
+                              pcache=_resolve_pcache(pstate, cmd, args))
                     if cmd["kind"] == "runpart"
                     else _run_coded(cmd, args, client, pkgs)
                 )
                 _absorb_ctx_events(
                     wlog,
                     pkgs.query.ctx if pkgs.query is not None else None,
+                )
+            elif cmd["kind"] == "combineparts":
+                status = _combine_parts(
+                    cmd, args, client, pkgs,
+                    pcache=_resolve_pcache(pstate, cmd, args), wlog=wlog,
                 )
             else:
                 status = _run_command(cmd, args, client, cp, wlog=wlog)
@@ -331,6 +475,7 @@ def main(argv=None) -> int:
 
     after = 0
     pkgs = _PackageCache()
+    pstate: Dict = {}  # lazy PartitionCache, keyed setup per job
     delay = {"seconds": 0.0, "count": 0}  # injected straggler behavior
     while True:
         got = client.get_prop(args.job, f"cmd/{args.pid}", after, timeout=2.0)
@@ -342,6 +487,15 @@ def main(argv=None) -> int:
         # driver can discard stale statuses from a command it already
         # gave up on (e.g. a run that outlived its timeout).
         cseq = cmd.get("cseq")
+        if cmd.get("ack"):
+            # Windowed envelope: acknowledge the DEQUEUE itself, before
+            # executing — the command mailbox is a latest-value slot,
+            # and the overlapped feed may only overwrite it once this
+            # envelope has provably left it.
+            try:
+                client.set_prop(args.job, str(cmd["ack"]), b"1")
+            except Exception:  # noqa: BLE001 — driver timeout surfaces it
+                pass
         if cmd["kind"] == "exit":
             client.set_prop(
                 args.job, f"status/{args.pid}",
@@ -396,8 +550,13 @@ def main(argv=None) -> int:
             results = []
             first_error = None
             for sub in cmd["cmds"]:
+                sub_t0 = time.perf_counter()
                 st = _exec_one(sub, args, client, cp, pkgs, delay,
-                               wtracer, wlog)
+                               wtracer, wlog, pstate=pstate)
+                # per-sub wall clock rides in the aggregated status so
+                # the driver's StageStatistics sees K real durations,
+                # not one batch-wide dt smeared across K plans
+                st["seconds"] = round(time.perf_counter() - sub_t0, 6)
                 results.append(st)
                 if st.get("state") == "failed" and first_error is None:
                     first_error = st.get("error")
@@ -407,9 +566,9 @@ def main(argv=None) -> int:
             }
             if first_error:
                 status["error"] = first_error
-        elif cmd["kind"] in ("run", "runpart", "runcoded"):
+        elif cmd["kind"] in ("run", "runpart", "runcoded", "combineparts"):
             status = _exec_one(cmd, args, client, cp, pkgs, delay,
-                               wtracer, wlog)
+                               wtracer, wlog, pstate=pstate)
         else:
             continue  # unknown command kind: ignore, keep serving
         # telemetry ships BEFORE the status post: the driver drains
@@ -421,7 +580,8 @@ def main(argv=None) -> int:
             pass
         status["cseq"] = cseq
         client.set_prop(
-            args.job, f"status/{args.pid}", json.dumps(status).encode()
+            args.job, cmd.get("skey") or f"status/{args.pid}",
+            json.dumps(status).encode(),
         )
 
 

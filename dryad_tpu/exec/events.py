@@ -119,6 +119,10 @@ EVENT_KINDS: Dict[str, str] = {
     "worker_dead": "worker process died; worker",
     "command_batch": "batched worker command stream posted; worker/"
                      "commands/round_trips_saved",
+    "gang_window": "overlapped gang command window close summary; "
+                   "depth/dispatches/peak_in_flight/retries",
+    "gang_partial_combine": "worker-side level -1 partial pre-merge; "
+                            "worker/parts/rows/read_bytes/cache hits",
     "gang_run_start": "gang SPMD submission began; seq/workers",
     "gang_run_complete": "gang SPMD submission finished; seconds",
     "gang_straggler": "gang run duration beyond the outlier threshold",
@@ -263,7 +267,18 @@ EVENT_PAYLOADS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
     "worker_joined": (("worker",), ()),
     "worker_dead": (("worker",), ()),
     "command_batch": (
-        ("commands", "round_trips_saved", "worker"), ("seqs",),
+        ("commands", "round_trips_saved", "worker"),
+        ("clamped_from", "seqs"),
+    ),
+    "gang_window": (
+        ("depth", "dispatches", "peak_in_flight", "pipeline",
+         "retries", "wall_s"),
+        ("workers",),
+    ),
+    "gang_partial_combine": (
+        ("cache_hits", "cache_misses", "parts", "read_bytes", "rows",
+         "worker"),
+        ("bytes", "in_rows", "seconds"),
     ),
     "gang_run_start": (("seq", "workers"), ()),
     "gang_run_complete": (("seconds", "seq"), ()),

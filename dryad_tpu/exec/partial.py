@@ -90,6 +90,88 @@ def state_reductions(plan):
     return red
 
 
+_MIX64 = 0x9E3779B97F4A7C15
+
+
+def key_hash64(cols, keys):
+    """Deterministic row hash over the key columns, shared by the
+    driver's combine-tree placement histograms and the gang workers'
+    level-(-1) pre-merge histograms.  Strings hash with the engine's
+    framework Hash64 (``columnar.schema.hash64_str``) — NOT Python's
+    process-salted ``hash()`` — so a snapshot computed in a worker
+    process describes the same key ranges the driver (or any peer)
+    would compute for the same rows."""
+    import numpy as np
+
+    from dryad_tpu.columnar.schema import hash64_str
+
+    mix = np.uint64(_MIX64)
+    n = len(cols[keys[0]])
+    h = np.full(n, np.uint64(0x84222325), np.uint64)
+    for k in keys:
+        a = np.asarray(cols[k])
+        if a.dtype == object or a.dtype.kind in ("U", "S"):
+            uniq, inv = np.unique(a.astype(object), return_inverse=True)
+            hs = np.asarray(
+                [hash64_str(str(s)) for s in uniq], np.uint64
+            )
+            w = hs[inv]
+        elif a.dtype.kind == "f":
+            w = np.ascontiguousarray(a.astype(np.float64)).view(np.uint64)
+        elif a.dtype.kind == "b":
+            w = a.astype(np.uint64)
+        else:
+            w = a.astype(np.int64).view(np.uint64)
+        h = (h ^ w) * mix
+        h ^= h >> np.uint64(29)
+    return h
+
+
+def merge_state_rows(cols, keys, red):
+    """Fold partial STATE rows by key with the plan's associative
+    reductions (:func:`state_reductions`) — no finalize, so the result
+    is itself a valid partial table.  One fold step of the aggregation
+    tree, shared by the driver's level-0 merge groups
+    (``cluster.localjob._tree_merge_state``) and the gang workers'
+    level-(-1) pre-merge (``cluster.worker`` ``combineparts``)."""
+    import numpy as np
+
+    n = len(cols[keys[0]]) if keys else 0
+    tups = list(zip(*[np.asarray(cols[k]).tolist() for k in keys])) if n \
+        else []
+    index = {}
+    for i, t in enumerate(tups):
+        index.setdefault(t, []).append(i)
+    out = {k: [] for k in keys}
+    for c in red:
+        out[c] = []
+    for t, idxs in index.items():
+        for k, kv in zip(keys, t):
+            out[k].append(kv)
+        ii = np.asarray(idxs)
+        for c, op in red.items():
+            v = np.asarray(cols[c])[ii]
+            if op == "sum":
+                out[c].append(v.sum())
+            elif op == "min":
+                out[c].append(v.min())
+            elif op == "max":
+                out[c].append(v.max())
+            elif op == "any":
+                out[c].append(np.any(v))
+            else:  # all
+                out[c].append(np.all(v))
+    res = {
+        k: np.asarray(out[k], dtype=np.asarray(cols[k]).dtype)
+        for k in keys
+    }
+    for c in red:
+        # promoted accumulators (int sums widen) keep their width; the
+        # flat root pass narrows to the output schema at finalize
+        res[c] = np.asarray(out[c])
+    return res
+
+
 # -- coded combine (redundancy/: k-of-n partial aggregates) -----------------
 
 def align_partials(tables, key_cols, state_cols):

@@ -324,6 +324,23 @@ class JobMetrics:
     command_batches: int = 0
     batched_commands: int = 0
     round_trips_saved: int = 0
+    # gang hot path (cluster.localjob): worker-side level -1 partial
+    # pre-merges (rows folded before shipping, job-root read bytes the
+    # partition cache avoided, cache hit/miss totals) and overlapped
+    # gang command windows (envelopes in flight while the feed keeps
+    # posting — peak_in_flight >= 2 is the overlap-actually-happened
+    # signal; retries are drain-time serial re-entries)
+    gang_premerges: int = 0
+    gang_premerge_parts: int = 0
+    gang_premerge_rows_in: int = 0
+    gang_premerge_rows_out: int = 0
+    gang_root_read_bytes: int = 0
+    gang_cache_hits: int = 0
+    gang_cache_misses: int = 0
+    gang_windows: int = 0
+    gang_dispatches: int = 0
+    gang_peak_in_flight: int = 0
+    gang_retries: int = 0
     # serving tier (serve.service): service-level admission/cache
     # totals plus per-tenant attribution — tenant -> counter dict
     # (admitted/completed/rejected/cache_hits/failed/seconds plus the
@@ -396,6 +413,10 @@ class JobMetrics:
             "dispatch_retries": self.dispatch_retries,
             "command_batches": self.command_batches,
             "round_trips_saved": self.round_trips_saved,
+            "gang_premerges": self.gang_premerges,
+            "gang_root_read_bytes": self.gang_root_read_bytes,
+            "gang_cache_hits": self.gang_cache_hits,
+            "gang_peak_in_flight": self.gang_peak_in_flight,
             "queries_admitted": self.queries_admitted,
             "queries_completed": self.queries_completed,
             "queries_rejected": self.queries_rejected,
@@ -513,6 +534,22 @@ class JobMetrics:
                 m.round_trips_saved += int(
                     ev.get("round_trips_saved", 0) or 0
                 )
+            elif kind == "gang_partial_combine":
+                m.gang_premerges += 1
+                m.gang_premerge_parts += int(ev.get("parts", 0) or 0)
+                m.gang_premerge_rows_in += int(ev.get("in_rows", 0) or 0)
+                m.gang_premerge_rows_out += int(ev.get("rows", 0) or 0)
+                m.gang_root_read_bytes += int(ev.get("read_bytes", 0) or 0)
+                m.gang_cache_hits += int(ev.get("cache_hits", 0) or 0)
+                m.gang_cache_misses += int(ev.get("cache_misses", 0) or 0)
+            elif kind == "gang_window":
+                m.gang_windows += 1
+                m.gang_dispatches += int(ev.get("dispatches", 0) or 0)
+                m.gang_peak_in_flight = max(
+                    m.gang_peak_in_flight,
+                    int(ev.get("peak_in_flight", 0) or 0),
+                )
+                m.gang_retries += int(ev.get("retries", 0) or 0)
             elif kind == "query_admitted":
                 m.queries_admitted += 1
                 m._tenant(ev)["admitted"] += 1
@@ -639,6 +676,26 @@ def format_attribution(m: JobMetrics) -> List[str]:
             f"{m.command_batches} batches "
             f"(saved {m.round_trips_saved} round trips)"
         )
+    if m.gang_premerges or m.gang_windows:
+        bits = []
+        if m.gang_premerges:
+            folded = max(
+                0, m.gang_premerge_rows_in - m.gang_premerge_rows_out
+            )
+            bits.append(
+                f"premerged {m.gang_premerge_parts} parts on "
+                f"{m.gang_premerges} worker pass(es) "
+                f"(folded {folded} rows, root_reads="
+                f"{m.gang_root_read_bytes}B, cache "
+                f"{m.gang_cache_hits}/{m.gang_cache_hits + m.gang_cache_misses})"
+            )
+        if m.gang_windows:
+            bits.append(
+                f"{m.gang_dispatches} envelopes over {m.gang_windows} "
+                f"window(s) peak_in_flight={m.gang_peak_in_flight}"
+                + (f" retries={m.gang_retries}" if m.gang_retries else "")
+            )
+        parts.append("gang: " + "  ".join(bits))
     if m.queries_admitted or m.queries_rejected:
         hit_rate = (
             m.result_cache_hits / m.queries_completed

@@ -255,6 +255,55 @@ def _fold_job(events: List[Dict[str, Any]]) -> JobInfo:
                 stream_stats.get("_disp_wall_s", 0.0)
                 + ev.get("wall_s", 0.0)
             )
+        elif kind == "gang_partial_combine":
+            # worker-side level -1 pre-merge: parts folded per winner
+            # worker before shipping, job-root bytes the partition
+            # cache did NOT have to re-read, and the cache hit split
+            stream_stats["gang_premerges"] = (
+                stream_stats.get("gang_premerges", 0) + 1
+            )
+            stream_stats["gang_premerge_parts"] = (
+                stream_stats.get("gang_premerge_parts", 0)
+                + ev.get("parts", 0)
+            )
+            stream_stats["gang_premerge_rows"] = (
+                stream_stats.get("gang_premerge_rows", 0)
+                + ev.get("rows", 0)
+            )
+            stream_stats["gang_root_read_bytes"] = (
+                stream_stats.get("gang_root_read_bytes", 0)
+                + ev.get("read_bytes", 0)
+            )
+            stream_stats["gang_cache_hits"] = (
+                stream_stats.get("gang_cache_hits", 0)
+                + ev.get("cache_hits", 0)
+            )
+            stream_stats["gang_cache_misses"] = (
+                stream_stats.get("gang_cache_misses", 0)
+                + ev.get("cache_misses", 0)
+            )
+        elif kind == "gang_window":
+            # overlapped gang command stream close summary:
+            # peak_in_flight >= 2 means the feed genuinely kept more
+            # than one runbatch envelope outstanding per worker
+            stream_stats["gang_windows"] = (
+                stream_stats.get("gang_windows", 0) + 1
+            )
+            stream_stats["gang_depth"] = max(
+                stream_stats.get("gang_depth", 0), ev.get("depth", 0)
+            )
+            stream_stats["gang_dispatches"] = (
+                stream_stats.get("gang_dispatches", 0)
+                + ev.get("dispatches", 0)
+            )
+            stream_stats["gang_peak_in_flight"] = max(
+                stream_stats.get("gang_peak_in_flight", 0),
+                ev.get("peak_in_flight", 0),
+            )
+            stream_stats["gang_retries"] = (
+                stream_stats.get("gang_retries", 0)
+                + ev.get("retries", 0)
+            )
         elif kind.startswith("stream_"):
             if kind == "stream_chunk":
                 stream_stats["chunks"] = stream_stats.get("chunks", 0) + 1
@@ -504,6 +553,34 @@ def render(job: JobInfo) -> str:
                     if st.get("dispatch_retries") else ""
                 )
             )
+        if st.get("gang_premerges") or st.get("gang_windows"):
+            # gang hot-path panel: worker-side pre-merges (level -1 of
+            # the combine tree) and the overlapped command window —
+            # root_reads should be ~0 once the partition cache is warm,
+            # and peak>=2 means the overlap actually happened
+            bits = []
+            if st.get("gang_premerges"):
+                hits = st.get("gang_cache_hits", 0)
+                total = hits + st.get("gang_cache_misses", 0)
+                bits.append(
+                    f"premerge={st.get('gang_premerge_parts', 0)} parts "
+                    f"-> {st.get('gang_premerge_rows', 0)} rows on "
+                    f"{st['gang_premerges']} worker(s)  "
+                    f"root_reads={st.get('gang_root_read_bytes', 0)}B  "
+                    f"cache={hits}/{total}"
+                )
+            if st.get("gang_windows"):
+                bits.append(
+                    f"depth={st.get('gang_depth', 0)}  "
+                    f"envelopes={st.get('gang_dispatches', 0)} "
+                    f"over {st['gang_windows']} window(s)  "
+                    f"peak_in_flight={st.get('gang_peak_in_flight', 0)}"
+                    + (
+                        f"  retries={st.get('gang_retries', 0)}"
+                        if st.get("gang_retries") else ""
+                    )
+                )
+            lines.append("gang: " + "  ".join(bits))
     if job.exchanges:
         # exchange planner panel: one line per repartitioning stage —
         # window 0 means the flat all_to_all baseline, whose peak is

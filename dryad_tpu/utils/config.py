@@ -353,6 +353,34 @@ class DryadConfig:
     # command fault classification preserved in the aggregate).
     # 0 disables batching (one mailbox round trip per command).
     command_batch: int = _env_int("DRYAD_TPU_COMMAND_BATCH", 8)
+    # Worker-side combine, the gang tree's level -1 (cluster.localjob
+    # submit_partitioned + cluster.worker ``combineparts``): after the
+    # vertex wave, each gang worker pre-merges the un-finalized partial
+    # state of the parts IT won (``exec.partial.merge_state_rows``) and
+    # ships ONE folded partial plus its KeyRangeHistogram snapshot, so
+    # driver ingress drops by the per-worker vertex fan-in and the
+    # driver's level-0/1 tree merges per-WORKER partials.  Off = flat
+    # per-vertex assembly, kept as the differential oracle.
+    gang_combine_tree: bool = _env_bool(
+        "DRYAD_TPU_GANG_COMBINE_TREE", False
+    )
+    # Overlapped gang command streams (cluster.gangwindow): how many
+    # ``runbatch`` envelopes may be in flight per worker before
+    # ``submit_many`` blocks on its oldest aggregated status.  The
+    # driver only FEEDS; a collector drains statuses strictly in
+    # submit order, so batch commit order is identical to the serial
+    # loop.  1 = one blocking round trip per batch (the differential
+    # baseline).
+    gang_batch_depth: int = _env_int("DRYAD_TPU_GANG_BATCH_DEPTH", 1)
+    # Per-worker gang partition cache budget in host bytes
+    # (cluster.partcache.PartitionCache): a worker keeps the result
+    # partitions it wrote, content-fingerprint-keyed, so a later
+    # sub-command referencing them (level -1 ``combineparts``) reads
+    # from memory instead of the job root; entries LRU-evict by size
+    # with spill-to-file (spilled entries stay servable).  0 disables.
+    gang_partition_cache_bytes: int = _env_int(
+        "DRYAD_TPU_GANG_PARTITION_CACHE", 64 * 1024 * 1024
+    )
     # Serving tier (dryad_tpu.serve.QueryService): default per-tenant
     # admission quotas — max queries a tenant may have admitted-and-
     # unresolved at once, and the summed host-input bytes those admitted
@@ -517,6 +545,10 @@ class DryadConfig:
             raise ValueError("chunk_fuse must be >= 1")
         if self.command_batch < 0:
             raise ValueError("command_batch must be >= 0")
+        if self.gang_batch_depth < 1:
+            raise ValueError("gang_batch_depth must be >= 1")
+        if self.gang_partition_cache_bytes < 0:
+            raise ValueError("gang_partition_cache_bytes must be >= 0")
         if self.serve_max_inflight < 1:
             raise ValueError("serve_max_inflight must be >= 1")
         if self.serve_max_bytes < 0:
@@ -609,6 +641,9 @@ CONFIG_KEYS = {
     "chunk_fuse": "chunk partial-plans lowered per dispatch; 1 = legacy",
     "do_while_device_auto": "try lax.while_loop for every fixed point",
     "command_batch": "gang run commands per runbatch round trip; 0 off",
+    "gang_combine_tree": "worker-side level -1 partial pre-merge",
+    "gang_batch_depth": "runbatch envelopes in flight per worker; 1 serial",
+    "gang_partition_cache_bytes": "worker partition cache budget; 0 off",
     "serve_max_inflight": "per-tenant admitted-query cap (QueryRejected)",
     "serve_max_bytes": "per-tenant admitted host-input byte budget; 0 off",
     "serve_result_cache_bytes": "plan-fingerprint result cache; 0 off",

@@ -550,3 +550,71 @@ def test_chaos_straggler_diagnosed_and_parity_prelaunched():
         assert evs.index(diags[-1]) < evs.index(launches[-1])
         # and the engine retained it for explain/jobview
         assert "straggler" in [d["rule"] for d in sub.diagnosis.diagnoses()]
+
+
+@pytest.mark.slow
+def test_chaos_worker_killed_mid_level_minus1_merge():
+    """A seeded FaultPlan kill inside the worker-side combine
+    (``combineparts``, level -1 of the gang combine tree) must not
+    cost correctness: the part files are durable on the job root, so
+    the same submit falls back to flat assembly and still answers;
+    after ``rebuild_gang`` a replay with the tree on is byte-identical
+    to the flat oracle; and the killed worker left a recoverable
+    blackbox dump naming the combineparts stage."""
+    import os
+
+    from dryad_tpu.cluster.localjob import LocalJobSubmission
+    from dryad_tpu.tools import blackbox
+
+    rng = np.random.default_rng(11)
+    tbl = {
+        "k": rng.integers(0, 32, 2000).astype(np.int32),
+        "v": rng.integers(-500, 500, 2000).astype(np.int32),
+    }
+
+    def mkq(on):
+        ctx = DryadContext(
+            num_partitions_=1,
+            config=DryadConfig(gang_combine_tree=on),
+        )
+        return ctx.from_arrays(tbl).group_by(
+            "k", {"s": ("sum", "v"), "mn": ("min", "v")}
+        )
+
+    with LocalJobSubmission(num_workers=2, devices_per_worker=1) as sub:
+        root = sub.root
+        flat = sub.submit_partitioned(mkq(False), nparts=8, coded=False)
+        sub.inject_fault(
+            None,
+            plan={"seed": 3, "worker_kill_prob": 1.0,
+                  "max_worker_kills": 1, "stages": ["combineparts"]},
+            workers=[1],
+        )
+        # level -1 is an optimization, never a durability dependency:
+        # the kill lands mid-merge, the driver falls back to flat
+        # assembly of the durable part files and still answers
+        fallback = sub.submit_partitioned(mkq(True), nparts=8, coded=False)
+        for c in flat:
+            assert flat[c].tobytes() == fallback[c].tobytes(), c
+        sub.rebuild_gang(2)
+        n0 = len(sub.events.events())
+        replay = sub.submit_partitioned(mkq(True), nparts=8, coded=False)
+        for c in flat:
+            assert flat[c].tobytes() == replay[c].tobytes(), c
+        # the rebuilt gang runs the tree for real this time
+        pre = [
+            e for e in sub.events.events()[n0:]
+            if e["kind"] == "gang_partial_combine"
+        ]
+        assert len(pre) == 2, pre
+        dumps = blackbox.load_dumps(os.path.join(root, "blackbox"))
+        killed = [
+            d for d in dumps
+            if d["reason"] == "worker_killed:combineparts"
+        ]
+        assert killed and killed[0]["role"] == "worker-1"
+    merged = blackbox.merge(
+        blackbox.load_dumps(os.path.join(root, "blackbox")), window_s=30.0
+    )
+    kinds = [e["kind"] for e in merged["events"]]
+    assert "worker_killed_injected" in kinds

@@ -1,0 +1,374 @@
+"""Gang hot path differentials: worker-side combine trees (level -1),
+overlapped command windows, and the gang-resident partition cache.
+
+Every new path is held to a byte-identity oracle:
+
+- ``gang_combine_tree=True`` (workers pre-merge their own un-finalized
+  partials and ship ONE folded ``wpart<w>.dpf`` each) vs the flat path
+  (driver reads every ``part<p>.dpf`` itself) — same rows, same bytes,
+  same dtypes, and a >=4x driver-ingress cut at fan-in >= 4;
+- ``gang_batch_depth>1`` (submit_many keeps multiple runbatch
+  envelopes in flight per worker through GangDispatchWindow) vs the
+  serial depth-1 chunking — identical results, with the window's
+  close event proving >=2 envelopes were genuinely outstanding;
+- the per-worker partition cache on vs off (budget 0 forces the
+  job-root re-read path the cache exists to elide).
+"""
+
+import numpy as np
+import pytest
+
+from dryad_tpu import DryadConfig, DryadContext
+from dryad_tpu.cluster.localjob import LocalJobSubmission
+
+
+@pytest.fixture(scope="module")
+def sub():
+    with LocalJobSubmission(num_workers=2, devices_per_worker=1) as s:
+        yield s
+
+
+def _canonical_rows(table):
+    names = sorted(table.keys())
+    cols = [np.asarray(table[n]) for n in names]
+    n = len(cols[0]) if cols else 0
+    rows = []
+    for i in range(n):
+        key = []
+        for c in cols:
+            v = c[i]
+            if c.dtype == object:
+                key.append(str(v).encode())
+            else:
+                key.append(c.dtype.str.encode() + v.tobytes())
+        rows.append(tuple(key))
+    return names, sorted(rows)
+
+
+def _assert_byte_identical(a, b, ctxmsg):
+    na, ra = _canonical_rows(a)
+    nb, rb = _canonical_rows(b)
+    assert na == nb, f"{ctxmsg}: columns {na} != {nb}"
+    assert len(ra) == len(rb), f"{ctxmsg}: {len(ra)} vs {len(rb)} rows"
+    for i, (x, y) in enumerate(zip(ra, rb)):
+        assert x == y, f"{ctxmsg}: row {i} differs byte-wise"
+
+
+def _table(seed, n=4000, kcard=64):
+    rng = np.random.default_rng(seed)
+    return {
+        "k": rng.integers(0, kcard, n).astype(np.int32),
+        "v": rng.integers(-1000, 1000, n).astype(np.int32),
+        "w": rng.integers(-(2 ** 52), 2 ** 52, n).astype(np.int64),
+        "s": np.array(
+            [f"key{int(i):03d}" for i in rng.integers(0, kcard, n)],
+            object,
+        ),
+    }
+
+
+def _events_since(sub, n0, kind=None):
+    evs = sub.events.events()[n0:]
+    return [e for e in evs if kind is None or e["kind"] == kind]
+
+
+def _ingress_bytes(sub, n0):
+    return sum(
+        int(e.get("wire_bytes", 0) or 0)
+        for e in _events_since(sub, n0, "assemble_fetch")
+    )
+
+
+# -- worker-side combine tree (level -1) vs flat assembly --------------------
+
+def test_worker_tree_matches_flat_and_cuts_ingress(sub):
+    """nparts=16 over 2 workers (fan-in 8): the tree path must be
+    byte-identical to flat AND cut driver ingress >= 4x, with every
+    part served from the warm partition cache (zero root re-reads)."""
+    tbl = _table(2)
+
+    def run(on):
+        ctx = DryadContext(
+            num_partitions_=1,
+            config=DryadConfig(gang_combine_tree=on),
+        )
+        q = ctx.from_arrays(tbl).group_by(
+            "k", {"sv": ("sum", "v"), "mn": ("min", "v"),
+                  "c": ("count", None)}
+        )
+        n0 = len(sub.events.events())
+        out = sub.submit_partitioned(q, nparts=16, coded=False)
+        return out, n0
+
+    flat, n_flat = run(False)
+    flat_bytes = _ingress_bytes(sub, n_flat)
+    tree, n_tree = run(True)
+    tree_bytes = _ingress_bytes(sub, n_tree)
+
+    _assert_byte_identical(flat, tree, "worker tree vs flat")
+
+    pre = _events_since(sub, n_tree, "gang_partial_combine")
+    assert len(pre) == 2, pre  # one level -1 pass per winner worker
+    for e in pre:
+        # runpart warmed the cache in the SAME submit, so the level -1
+        # merge never re-reads the job root
+        assert e["read_bytes"] == 0, e
+        assert e["cache_misses"] == 0, e
+        assert e["cache_hits"] == e["parts"], e
+    assert sum(e["parts"] for e in pre) == 16
+    lv = [
+        e for e in _events_since(sub, n_tree, "combine_tree_level")
+        if e.get("level") == -1
+    ]
+    assert len(lv) == 2, lv
+
+    assert flat_bytes > 0 and tree_bytes > 0
+    ratio = flat_bytes / tree_bytes
+    assert ratio >= 4.0, (
+        f"driver ingress only {ratio:.2f}x smaller "
+        f"({flat_bytes} -> {tree_bytes} wire bytes)"
+    )
+
+
+@pytest.mark.slow
+def test_worker_tree_string_keys_match_flat(sub):
+    """String-keyed group_by: workers fold raw uint64 hash codes, the
+    driver resolves them through the shared dictionary — byte-identical
+    to the flat path that decodes every partial itself."""
+    tbl = _table(3)
+
+    def run(on):
+        ctx = DryadContext(
+            num_partitions_=1,
+            config=DryadConfig(gang_combine_tree=on),
+        )
+        q = ctx.from_arrays(tbl).group_by(
+            "s", {"c": ("count", None), "sv": ("sum", "v"),
+                  "hi": ("max", "w")}
+        )
+        return sub.submit_partitioned(q, nparts=8, coded=False)
+
+    _assert_byte_identical(run(False), run(True), "string keys")
+
+
+@pytest.mark.slow
+def test_worker_tree_cold_cache_rereads_root(sub):
+    """Budget 0 disables the partition cache: the level -1 merge falls
+    back to job-root reads (read_bytes > 0) and must STILL be
+    byte-identical to the flat path."""
+    tbl = _table(4)
+
+    def run(on, cache_bytes):
+        ctx = DryadContext(
+            num_partitions_=1,
+            config=DryadConfig(
+                gang_combine_tree=on,
+                gang_partition_cache_bytes=cache_bytes,
+            ),
+        )
+        q = ctx.from_arrays(tbl).group_by(
+            "k", {"sv": ("sum", "v"), "c": ("count", None)}
+        )
+        n0 = len(sub.events.events())
+        return sub.submit_partitioned(q, nparts=8, coded=False), n0
+
+    flat, _ = run(False, 0)
+    tree, n0 = run(True, 0)
+    _assert_byte_identical(flat, tree, "cold cache")
+    pre = _events_since(sub, n0, "gang_partial_combine")
+    assert len(pre) == 2
+    for e in pre:
+        assert e["cache_hits"] == 0, e
+        assert e["read_bytes"] > 0, e
+
+
+# -- overlapped command streams (submit_many at gang_batch_depth > 1) --------
+
+def _many_queries(seed, j=6):
+    """J independent queries sharing one batch config: a mix of group,
+    sort, and filtered-aggregation shapes."""
+    rng = np.random.default_rng(seed)
+    qs = []
+    for i in range(j):
+        tbl = {
+            "k": rng.integers(0, 24, 800).astype(np.int32),
+            "v": rng.integers(-500, 500, 800).astype(np.int32),
+        }
+
+        def build(ctx, tbl=tbl, i=i):
+            q = ctx.from_arrays(tbl)
+            if i % 3 == 0:
+                return q.group_by(
+                    "k", {"s": ("sum", "v"), "c": ("count", None)}
+                )
+            if i % 3 == 1:
+                return q.order_by([("v", False), ("k", False)]).take(50)
+            return q.where(lambda c: c["v"] > 0).group_by(
+                "k", {"mx": ("max", "v")}
+            )
+
+        qs.append(build)
+    return qs
+
+
+def _run_many(sub, builders, depth):
+    ctxs = [
+        DryadContext(
+            num_partitions_=1,
+            config=DryadConfig(command_batch=2, gang_batch_depth=depth),
+        )
+        for _ in builders
+    ]
+    return sub.submit_many([b(c) for b, c in zip(builders, ctxs)])
+
+
+def test_windowed_depths_match_serial(sub, depth=2):
+    # depth 4 (and more seeds) live in the slow test_windowed_sweep;
+    # tier-1 keeps the cheapest window that still proves overlap.
+    builders = _many_queries(10, j=4)
+    serial = _run_many(sub, builders, 1)
+    n0 = len(sub.events.events())
+    windowed = _run_many(sub, builders, depth)
+    assert len(serial) == len(windowed)
+    for j, (a, b) in enumerate(zip(serial, windowed)):
+        _assert_byte_identical(a, b, f"depth={depth} query {j}")
+    wins = _events_since(sub, n0, "gang_window")
+    assert len(wins) == 1, wins
+    assert wins[0]["depth"] == depth
+    # >= 2 runbatch envelopes genuinely in flight per worker: one
+    # executing plus one queued-unread in the command slot
+    assert wins[0]["peak_in_flight"] >= 2, wins[0]
+    assert wins[0]["retries"] == 0
+
+
+def test_submit_many_clamps_heterogeneous_command_batch(sub):
+    """submit_many normalizes command_batch as the MIN across every
+    query's config (a larger envelope would desync the per-command
+    barriers) and emits a clamp marker naming the size it refused."""
+    rng = np.random.default_rng(12)
+    tbl = {
+        "k": rng.integers(0, 16, 600).astype(np.int32),
+        "v": rng.integers(-100, 100, 600).astype(np.int32),
+    }
+
+    def mkq(batch):
+        ctx = DryadContext(
+            num_partitions_=1, config=DryadConfig(command_batch=batch)
+        )
+        return ctx.from_arrays(tbl).group_by(
+            "k", {"s": ("sum", "v"), "c": ("count", None)}
+        )
+
+    n0 = len(sub.events.events())
+    out = sub.submit_many([mkq(4), mkq(2), mkq(2), mkq(2)])
+    assert len(out) == 4
+    for a in out[1:]:
+        for c in out[0]:
+            assert out[0][c].tobytes() == a[c].tobytes(), c
+    clamps = [
+        e for e in _events_since(sub, n0, "command_batch")
+        if e.get("clamped_from")
+    ]
+    assert clamps and clamps[0]["commands"] == 2, clamps
+    assert clamps[0]["clamped_from"] == 4
+
+
+@pytest.mark.slow
+def test_windowed_transient_failure_retries_serially(sub):
+    """A sub-command that exhausts its stage budget inside a windowed
+    envelope re-enters SERIALLY at commit position: the window records
+    the retry, and the final results still match the clean serial
+    oracle."""
+    builders = _many_queries(11, j=4)
+    # the first group_by execution fails 3 attempts on every gang
+    # member (stage faults must reach every member), exhausting the
+    # default max_stage_failures budget -> the sub-command reports
+    # failed; the serial re-submission then runs with the counts spent
+    sub.inject_fault("group_by", count=3)
+    n0 = len(sub.events.events())
+    windowed = _run_many(sub, builders, 2)
+    wins = _events_since(sub, n0, "gang_window")
+    assert len(wins) == 1 and wins[0]["retries"] >= 1, wins
+    serial = _run_many(sub, builders, 1)
+    for j, (a, b) in enumerate(zip(serial, windowed)):
+        _assert_byte_identical(a, b, f"retried query {j}")
+
+
+# -- seeded sweeps (slow suite) ----------------------------------------------
+
+def _sweep_query(ctx, tbl, kind):
+    q = ctx.from_arrays(tbl)
+    if kind == "group":
+        return q.group_by(
+            "k", {"sv": ("sum", "v"), "c": ("count", None)}
+        )
+    if kind == "agg":
+        return q.group_by(
+            "s", {"ws": ("sum", "w"), "lo": ("min", "w"),
+                  "hi": ("max", "w"), "c": ("count", None)}
+        )
+    # sort: driver-routable range-partitioned order_by over host
+    # inputs — no mergeable group tail, so the tree gate must pass it
+    # through untouched and the differential holds trivially
+    return q.order_by([("v", True), ("k", False)])
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", (7, 23, 41))
+@pytest.mark.parametrize("kind", ("group", "agg", "sort"))
+def test_worker_tree_sweep(sub, seed, kind):
+    tbl = _table(seed, n=3000, kcard=48)
+
+    def run(on):
+        ctx = DryadContext(
+            num_partitions_=1,
+            config=DryadConfig(gang_combine_tree=on),
+        )
+        return sub.submit_partitioned(
+            _sweep_query(ctx, tbl, kind), nparts=8, coded=False
+        )
+
+    _assert_byte_identical(
+        run(False), run(True), f"seed={seed} kind={kind}"
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", (7, 23, 41))
+def test_worker_tree_coded_k_of_n_unaffected(sub, seed):
+    """Coded k-of-n submissions branch away before the worker-combine
+    gate: toggling gang_combine_tree must leave them byte-identical
+    (and still reconstructing)."""
+    rng = np.random.default_rng(seed)
+    tbl = {
+        "k": rng.integers(0, 40, 2000).astype(np.int32),
+        "w": rng.integers(-(2 ** 52), 2 ** 52, 2000).astype(np.int64),
+    }
+
+    def run(on):
+        ctx = DryadContext(
+            num_partitions_=1,
+            config=DryadConfig(gang_combine_tree=on),
+        )
+        q = ctx.from_arrays(tbl).group_by(
+            "k", {"c": ("count", None), "ws": ("sum", "w")}
+        )
+        n0 = len(sub.events.events())
+        out = sub.submit_partitioned(q, nparts=5, coded=True)
+        kinds = {e["kind"] for e in sub.events.events()[n0:]}
+        assert "coded_reconstruct" in kinds
+        assert "gang_partial_combine" not in kinds
+        return out
+
+    _assert_byte_identical(run(True), run(False), f"coded seed={seed}")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", (7, 23, 41))
+def test_windowed_sweep(sub, seed):
+    builders = _many_queries(seed, j=8)
+    serial = _run_many(sub, builders, 1)
+    for depth in (2, 4):
+        windowed = _run_many(sub, builders, depth)
+        for j, (a, b) in enumerate(zip(serial, windowed)):
+            _assert_byte_identical(a, b, f"seed={seed} d={depth} q{j}")

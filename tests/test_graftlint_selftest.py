@@ -1128,3 +1128,146 @@ def test_rewrite_layering_fires(path, old, new):
     _assert_fires(
         _mutate(REWRITE_FIXTURE, path, old, new), "rewrite-layering"
     )
+
+
+# -- mailbox-discipline ------------------------------------------------------
+
+GANGWIN = "dryad_tpu/cluster/gangwindow.py"
+
+GANGWIN_CLEAN = '''\
+class GangDispatchWindow:
+    def __init__(self, depth):
+        self.depth = depth
+
+    def submit(self, tag, drain):
+        pass
+
+    def ready(self):
+        return ()
+
+    def drain(self):
+        return ()
+
+    def close(self, workers=None):
+        pass
+'''
+
+GANGLJ = "dryad_tpu/cluster/localjob.py"
+
+GANGLJ_CLEAN = '''\
+from dryad_tpu.cluster.gangwindow import GangDispatchWindow
+
+
+class Submission:
+    def _command_round_trip(self, i, cmd):
+        return {}
+
+    def submit_windowed(self, chunks, depth):
+        win = GangDispatchWindow(depth)
+        results = {}
+        try:
+            for k, chunk in enumerate(chunks):
+                for i in range(2):
+                    self._post(i, chunk)
+
+                def drain(chunk=chunk):
+                    # the sanctioned blocking half: the closure is run
+                    # by the collector, so waits in here are the job
+                    for p in self._procs:
+                        p.wait(1.0)
+                    return chunk
+
+                win.submit(k, drain)
+                for tag, value, err in win.ready():
+                    results[tag] = value
+            for tag, value, err in win.drain():
+                results[tag] = value
+        finally:
+            win.close(workers=2)
+        return results
+
+    def submit_serial(self, cmds):
+        # no window in sight: synchronous round trips are fine here
+        out = []
+        for cmd in cmds:
+            out.append(self._command_round_trip(0, cmd))
+        return out
+
+    def shutdown(self):
+        # waits in a loop that never submits are also fine
+        for p in self._procs:
+            p.wait(5.0)
+
+    def _post(self, i, chunk):
+        pass
+'''
+
+MAILBOX_FIXTURE = {GANGWIN: GANGWIN_CLEAN, GANGLJ: GANGLJ_CLEAN}
+
+
+def test_mailbox_discipline_clean_fixture():
+    # the drain closure's p.wait(), submit_serial's round trips, and
+    # shutdown's wait loop must all stay exempt
+    assert _rules(MAILBOX_FIXTURE, "mailbox-discipline") == []
+
+
+@pytest.mark.parametrize(
+    "old,new",
+    [
+        # a synchronous mailbox round trip re-serializes the window
+        (
+            "win.submit(k, drain)",
+            "win.submit(k, drain)\n"
+            "                st = self._command_round_trip(0, chunk)",
+        ),
+        # a process wait in the feed path can deadlock: the status it
+        # waits on may only arrive after an envelope it has not posted
+        (
+            "self._post(i, chunk)",
+            "self._post(i, chunk)\n"
+            "                    self._procs[i].wait(5.0)",
+        ),
+        # the blocking drain belongs AFTER the feed loop
+        (
+            "win.submit(k, drain)",
+            "win.submit(k, drain)\n"
+            "                for tag, value, err in win.drain():\n"
+            "                    results[tag] = value",
+        ),
+        # bare-name round trip helpers count too
+        (
+            "win.submit(k, drain)",
+            "win.submit(k, drain)\n"
+            "                _placed_round_trip(0, chunk)",
+        ),
+    ],
+    ids=["round-trip-in-feed", "wait-in-feed", "drain-in-feed",
+         "bare-round-trip"],
+)
+def test_mailbox_discipline_fires(old, new):
+    _assert_fires(
+        _mutate(MAILBOX_FIXTURE, GANGLJ, old, new), "mailbox-discipline"
+    )
+
+
+def test_mailbox_discipline_exempts_drain_closure_blocking():
+    # even a round trip is fine INSIDE the nested drain closure — the
+    # collector runs it, not the feed thread
+    mutated = _mutate(
+        MAILBOX_FIXTURE,
+        GANGLJ,
+        "for p in self._procs:\n"
+        "                        p.wait(1.0)",
+        "for p in self._procs:\n"
+        "                        p.wait(1.0)\n"
+        "                    self._command_round_trip(0, chunk)",
+    )
+    assert _rules(mutated, "mailbox-discipline") == []
+
+
+def test_mailbox_discipline_lost_anchor_is_a_finding():
+    mutated = _mutate(
+        MAILBOX_FIXTURE, GANGWIN,
+        "class GangDispatchWindow", "class GangCommandWindow",
+    )
+    _assert_fires(mutated, "mailbox-discipline")
