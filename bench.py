@@ -456,8 +456,8 @@ def _ooc_sort_once(n: int, chunk_rows: int, depth=None, obs=True):
     ``depth`` overrides ``stream_pipeline_depth`` (1 = the serial
     legacy driver, the pre-pipeline baseline); ``obs=False`` turns the
     always-on observability layer (flight recorder + diagnosis
-    engine + continuous telemetry sampler) off for the
-    --obs-overhead A/B."""
+    engine + continuous telemetry sampler + query trace propagation)
+    off for the --obs-overhead A/B."""
     from dryad_tpu import DryadConfig, DryadContext
 
     rng = np.random.default_rng(3)
@@ -475,6 +475,7 @@ def _ooc_sort_once(n: int, chunk_rows: int, depth=None, obs=True):
             obs_flight_recorder=False,
             obs_diagnosis=False,
             obs_telemetry=False,
+            query_trace=False,
         )
     cfg = DryadConfig(
         stream_bucket_rows=bucket_rows * 2,
@@ -2314,8 +2315,9 @@ OBS_OVERHEAD_LIMIT = 0.02  # always-on observability budget: 2%
 def obs_overhead_gate(n: int = 1 << 22, chunk_rows: int = 1 << 20) -> None:
     """--obs-overhead: prove the always-on observability layer (event
     taps -> flight-recorder ring + diagnosis folds + the continuous
-    telemetry sampler and its rolling store) costs < 2% on the
-    out-of-core sort, the event-densest workload in the suite.  A/B in
+    telemetry sampler and its rolling store + query-scoped trace
+    propagation) costs < 2% on the out-of-core sort, the
+    event-densest workload in the suite.  A/B in
     one process — warmup run first (XLA compile), then interleaved
     off/on pairs, best-of each so scheduler noise cancels.  Emits one
     NDJSON record either way; exits 2 on breach, 0 on pass."""
@@ -2338,6 +2340,7 @@ def obs_overhead_gate(n: int = 1 << 22, chunk_rows: int = 1 << 20) -> None:
         "obs_on_s": [round(t, 4) for t in on_s],
         "obs_off_s": [round(t, 4) for t in off_s],
         "telemetry": True,
+        "query_trace": True,
         "rows": n,
         "chunk_rows": chunk_rows,
         "platform": _PLATFORM,

@@ -227,6 +227,7 @@ def _load_builtin() -> None:
         checks_recompile,
         checks_rewrite,
         checks_serve,
+        checks_trace,
     )
 
 

@@ -28,7 +28,7 @@ from dryad_tpu.columnar.batch import ColumnBatch
 from dryad_tpu.columnar.schema import ColumnType, Schema, StringDictionary
 from dryad_tpu.exec.events import EventLog
 from dryad_tpu.exec.executor import GraphExecutor
-from dryad_tpu.obs import flightrec
+from dryad_tpu.obs import flightrec, tracectx
 from dryad_tpu.obs.diagnose import DiagnosisEngine
 from dryad_tpu.rewrite.controller import RewriteController
 from dryad_tpu.parallel import distribute as D
@@ -776,7 +776,23 @@ class DryadContext:
         sid, oidx = graph.outputs[query.node.id]
         return results[(sid, oidx)]
 
+    def _trace_ctx(self):
+        """The active trace context, or a fresh mint for a non-serve
+        job (serve minted one at admission and it is already active).
+        None — a true no-op under ``tracectx.activate`` — when
+        ``config.query_trace`` is off (the bench --obs-overhead A/B)."""
+        ctx = tracectx.current()
+        if ctx is None and getattr(self.config, "query_trace", True):
+            ctx = tracectx.mint()
+        return ctx
+
     def run_to_host(self, query: Query) -> Dict[str, np.ndarray]:
+        # every span / exchange_round / dispatch_gap below carries the
+        # minted (or inherited) context's qid
+        with tracectx.activate(self._trace_ctx()):
+            return self._run_to_host(query)
+
+    def _run_to_host(self, query: Query) -> Dict[str, np.ndarray]:
         from dryad_tpu.exec.outofcore import StreamExecutor, has_stream_input
 
         if has_stream_input(self, query.node):
@@ -825,19 +841,25 @@ class DryadContext:
         bucket k+1's program while bucket k's results transfer
         (``exec.outofcore`` phase 2).  Not valid for stream-input
         plans (those route through the StreamExecutor)."""
-        batch, deferred = self._execute_device(query, defer_miss=True)
+        tctx = self._trace_ctx()
+        with tracectx.activate(tctx):
+            batch, deferred = self._execute_device(query, defer_miss=True)
 
         def fetch() -> Dict[str, np.ndarray]:
-            valid, host_cols = _fetch_with_miss(batch, deferred)
-            self._account_d2h(valid, host_cols)
-            table = batch.to_numpy(
-                query.schema, self.dictionary, _host=(valid, host_cols)
-            )
-            if self._codecs:
-                from dryad_tpu.columnar.codecs import collapse_table
+            # the closure carries its query's context: a fetch drained
+            # on another thread (DispatchWindow collector, serve
+            # driver) still stamps readback spans with the right qid
+            with tracectx.activate(tctx):
+                valid, host_cols = _fetch_with_miss(batch, deferred)
+                self._account_d2h(valid, host_cols)
+                table = batch.to_numpy(
+                    query.schema, self.dictionary, _host=(valid, host_cols)
+                )
+                if self._codecs:
+                    from dryad_tpu.columnar.codecs import collapse_table
 
-                table = collapse_table(table, self._codecs)
-            return table
+                    table = collapse_table(table, self._codecs)
+                return table
 
         return fetch
 
@@ -856,40 +878,44 @@ class DryadContext:
         dict-miss check rides the FIRST fetch's transfer (a miss
         anywhere in the group raises there, before any result of the
         group is committed)."""
-        graph = lower(
-            [q.node for q in queries], self.config, self.dictionary,
-            P=num_partitions(self.mesh) if self.mesh is not None else None,
-        )
-        bindings = {
-            nid: self._bind_device(n) for nid, n in graph.inputs.items()
-        }
-        binding_fps = None
-        if self.config.checkpoint_dir:
-            binding_fps = {
-                nid: self._binding_fp(n) for nid, n in graph.inputs.items()
+        tctx = self._trace_ctx()
+        with tracectx.activate(tctx):
+            graph = lower(
+                [q.node for q in queries], self.config, self.dictionary,
+                P=num_partitions(self.mesh) if self.mesh is not None else None,
+            )
+            bindings = {
+                nid: self._bind_device(n) for nid, n in graph.inputs.items()
             }
-        results, deferred = self.executor.execute(
-            graph, bindings, binding_fps, defer_miss=True
-        )
+            binding_fps = None
+            if self.config.checkpoint_dir:
+                binding_fps = {
+                    nid: self._binding_fp(n)
+                    for nid, n in graph.inputs.items()
+                }
+            results, deferred = self.executor.execute(
+                graph, bindings, binding_fps, defer_miss=True
+            )
         state = {"deferred_done": False}
 
         def make_fetch(query, batch):
             def fetch() -> Dict[str, np.ndarray]:
-                if not state["deferred_done"]:
-                    valid, host_cols = _fetch_with_miss(batch, deferred)
-                    state["deferred_done"] = True
-                else:
-                    valid, host_cols, _ = batch.fetch_host(extra=[])
-                self._account_d2h(valid, host_cols)
-                table = batch.to_numpy(
-                    query.schema, self.dictionary,
-                    _host=(valid, host_cols),
-                )
-                if self._codecs:
-                    from dryad_tpu.columnar.codecs import collapse_table
+                with tracectx.activate(tctx):
+                    if not state["deferred_done"]:
+                        valid, host_cols = _fetch_with_miss(batch, deferred)
+                        state["deferred_done"] = True
+                    else:
+                        valid, host_cols, _ = batch.fetch_host(extra=[])
+                    self._account_d2h(valid, host_cols)
+                    table = batch.to_numpy(
+                        query.schema, self.dictionary,
+                        _host=(valid, host_cols),
+                    )
+                    if self._codecs:
+                        from dryad_tpu.columnar.codecs import collapse_table
 
-                    table = collapse_table(table, self._codecs)
-                return table
+                        table = collapse_table(table, self._codecs)
+                    return table
 
             return fetch
 
@@ -904,6 +930,10 @@ class DryadContext:
 
     def to_store(self, query: Query, path: str) -> JobHandle:
         """Execute and persist (reference ToStore + SubmitAndWait)."""
+        with tracectx.activate(self._trace_ctx()):
+            return self._to_store(query, path)
+
+    def _to_store(self, query: Query, path: str) -> JobHandle:
         if not self.local_debug:
             from dryad_tpu.exec.outofcore import (
                 StreamExecutor,

@@ -1209,12 +1209,20 @@ class Query:
         EXECUTES the query first and appends the runtime-diagnosis
         panel — phase attribution plus any pathologies the online
         engine (``obs.diagnose``) caught during the run."""
+        from dryad_tpu.obs import critpath, tracectx
         from dryad_tpu.tools.explain import explain, explain_diagnoses
 
         text = explain(self)
         if analyze:
-            self.collect()
+            # mint (or adopt) a trace context so the run's events are
+            # qid-stamped, then fold them into the critical-path panel
+            tctx = tracectx.current() or tracectx.mint()
+            with tracectx.activate(tctx):
+                self.collect()
             text += "\n\n" + explain_diagnoses(self.ctx)
+            bd = critpath.fold_query(self.ctx.events.events(), tctx.qid)
+            if bd is not None and bd.phases:
+                text += "\n\n-- critical path --\n" + bd.format()
         return text
 
     def collect(self) -> Dict[str, np.ndarray]:
@@ -1241,8 +1249,14 @@ class Query:
             raise RuntimeError(
                 "from_stream inputs are not supported in local_debug mode"
             )
-        _schema, tables = StreamExecutor(self.ctx).run_stream(self.node)
-        yield from tables
+        from dryad_tpu.obs import tracectx
+
+        # one trace context covers the whole streamed run: the chunk
+        # pipeline captures it at construction, so producer/consumer
+        # spans across every yielded piece share one qid
+        with tracectx.activate(self.ctx._trace_ctx()):
+            _schema, tables = StreamExecutor(self.ctx).run_stream(self.node)
+            yield from tables
 
     def __iter__(self):
         """Enumerating a query triggers execution and yields row dicts

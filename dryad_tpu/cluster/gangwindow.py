@@ -41,7 +41,7 @@ import threading
 import time
 from typing import Optional
 
-from dryad_tpu.obs import flightrec
+from dryad_tpu.obs import flightrec, tracectx
 
 
 class GangDispatchWindow:
@@ -171,6 +171,7 @@ class GangDispatchWindow:
                 "gang_window", pipeline=self.name, depth=self.depth,
                 dispatches=self.dispatches, retries=self.retries,
                 peak_in_flight=self.peak_in_flight,
+                qid=tracectx.current_qid(),
                 wall_s=round(time.monotonic() - self._t0_wall, 6),
                 **extra,
             )

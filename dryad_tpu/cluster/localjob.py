@@ -61,7 +61,7 @@ from dryad_tpu.exec.failure import (
 )
 from dryad_tpu.exec.jobpackage import pack_query
 from dryad_tpu.exec.stats import StageStatistics
-from dryad_tpu.obs import flightrec
+from dryad_tpu.obs import flightrec, tracectx
 from dryad_tpu.obs.diagnose import DiagnosisEngine
 from dryad_tpu.obs.span import Tracer
 from dryad_tpu.utils.logging import get_logger
@@ -474,8 +474,19 @@ class LocalJobSubmission:
                 )
         return {"state": "canceled"}
 
+    @staticmethod
+    def _stamp_trace(cmd: Dict) -> Dict:
+        """Attach the active query's trace context to a mailbox
+        envelope (driver thread — the context is live HERE, not on the
+        round-trip process that later posts the command)."""
+        ctx = tracectx.current()
+        if ctx is not None and "trace" not in cmd:
+            cmd["trace"] = ctx.to_wire()
+        return cmd
+
     def _command_round_trip(self, i: int, cmd: Dict):
         """Round trip pinned to worker ``i`` (gang commands)."""
+        self._stamp_trace(cmd)
 
         def fn(proc: ClusterProcess) -> Dict:
             return self._round_trip_body(i, cmd, proc)
@@ -485,6 +496,7 @@ class LocalJobSubmission:
     def _placed_round_trip(self, cmd: Dict):
         """Round trip to whichever worker the scheduler placed the
         process on (vertex tasks: any computer may serve any task)."""
+        self._stamp_trace(cmd)
 
         def fn(proc: ClusterProcess) -> Dict:
             i = int(proc.computer.removeprefix("worker"))
@@ -934,10 +946,10 @@ class LocalJobSubmission:
                         await_ack(i, last_ack[i])
                     ack = f"ack/{i}/c{cseq}"
                     skey = f"wstatus/{i}/c{cseq}"
-                    env = {
+                    env = self._stamp_trace({
                         "kind": "runbatch", "cmds": subs, "cseq": cseq,
                         "ack": ack, "skey": skey,
-                    }
+                    })
                     self.round_trips += 1
                     mb.set_prop(
                         self.job_id, f"cmd/{i}", json.dumps(env).encode()
@@ -1443,7 +1455,7 @@ class LocalJobSubmission:
         )
         procs = []
         for widx, w in enumerate(wids):
-            cmd = {
+            cmd = self._stamp_trace({
                 "kind": "combineparts", "package": pkg_rel,
                 "result_dir": result_rel,
                 "parts": [
@@ -1453,7 +1465,7 @@ class LocalJobSubmission:
                 "keys": list(keys), "red": red, "ranges": ranges,
                 "wid": widx, "cache_bytes": cache_bytes,
                 "cseq": self._next_cseq(),
-            }
+            })
 
             def fn(proc: ClusterProcess, i=w, cmd=cmd) -> Dict:
                 # per-worker watch (gang=False): an unrelated death

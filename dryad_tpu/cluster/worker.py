@@ -36,6 +36,8 @@ import time
 import traceback
 from typing import Dict, List
 
+from dryad_tpu.obs import tracectx
+
 
 class _PackageCache:
     """Per-process cache of loaded job packages for vertex tasks.
@@ -365,7 +367,13 @@ def _exec_one(cmd: Dict, args, client, cp, pkgs, delay, wtracer, wlog,
     crash the loop)."""
     pstate = pstate if pstate is not None else {}
     try:
-        with wtracer.span(
+        # Re-activate the query's trace context from the mailbox
+        # envelope: every span this command produces (and the engine
+        # events absorbed from the job context) ships back qid-stamped
+        # on the telemetry channel, joining the driver's fold.
+        with tracectx.activate(
+            tracectx.TraceContext.from_wire(cmd.get("trace"))
+        ), wtracer.span(
             cmd["kind"], cat="worker", seq=cmd.get("seq"),
             part=cmd.get("part", cmd.get("coded")),
         ):
@@ -550,6 +558,10 @@ def main(argv=None) -> int:
             results = []
             first_error = None
             for sub in cmd["cmds"]:
+                # envelope-level trace context covers sub-commands that
+                # didn't carry their own
+                if cmd.get("trace") and not sub.get("trace"):
+                    sub["trace"] = cmd["trace"]
                 sub_t0 = time.perf_counter()
                 st = _exec_one(sub, args, client, cp, pkgs, delay,
                                wtracer, wlog, pstate=pstate)

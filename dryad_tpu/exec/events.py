@@ -201,7 +201,7 @@ EVENT_PAYLOADS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
     "stage_delay_injected": (("name", "seconds", "stage"), ()),
     "exchange_round": (
         ("bytes", "dcn_bytes", "ici_bytes", "round", "window"),
-        ("name", "stage"),
+        ("name", "qid", "stage"),
     ),
     "dict_miss": (("rows", "stage_name"), ()),
     "stage_checkpoint_hit": (("name", "stage"), ()),
@@ -237,7 +237,7 @@ EVENT_PAYLOADS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
         ("chunks", "mode"), ("pinned", "reprobe", "static"),
     ),
     "stream_group_done": (("chunks", "groups"), ()),
-    "dispatch_gap": (("gap_s",), ("in_flight", "pipeline")),
+    "dispatch_gap": (("gap_s",), ("in_flight", "pipeline", "qid")),
     "dispatch_window": (
         ("depth", "dispatches", "gap_s", "retries"),
         ("driver_cpu_s", "pipeline", "wall_s"),
@@ -250,10 +250,11 @@ EVENT_PAYLOADS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
     "combine_tree_degrade": (("chunks", "degraded", "fraction"), ()),
     "stream_distinct_spill": (("rows",), ()),
     "span": (
-        ("cat", "dur", "name", "parent_id", "span_id", "thread"), (),
+        ("cat", "dur", "name", "parent_id", "span_id", "thread"),
+        ("qid",),
     ),
     "metrics": ((), ("counters", "hists")),
-    "xla_compile": (("compile_s", "key", "stage", "trace_s"), ()),
+    "xla_compile": (("compile_s", "key", "stage", "trace_s"), ("qid",)),
     "telemetry_merged": (("events", "offsets"), ()),
     "process_failed": (("computer", "error", "process"), ()),
     "process_stranded": (("computer", "process"), ()),
@@ -273,7 +274,7 @@ EVENT_PAYLOADS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
     "gang_window": (
         ("depth", "dispatches", "peak_in_flight", "pipeline",
          "retries", "wall_s"),
-        ("workers",),
+        ("qid", "workers"),
     ),
     "gang_partial_combine": (
         ("cache_hits", "cache_misses", "parts", "read_bytes", "rows",
@@ -329,7 +330,8 @@ EVENT_PAYLOADS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
          "probes", "rss_kb"),
     ),
     "diagnosis": (
-        ("evidence", "hint", "rule", "severity"), ("name", "stage"),
+        ("evidence", "hint", "rule", "severity"),
+        ("name", "qid", "stage"),
     ),
     "plan_rewrite": (
         ("action", "phase", "rule"),
@@ -349,6 +351,23 @@ EVENT_PAYLOADS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
         ("inflight", "limit", "state", "tenant"), ("bytes",),
     ),
 }
+
+
+# Event kinds scoped to ONE query: their emit sites must stamp the
+# active trace context's query id as an explicit ``qid=`` keyword
+# (``obs.tracectx.current_qid()`` — None outside any query scope).
+# The graftlint ``trace-context`` checker cross-references this tuple
+# against every emit site both ways: a kind listed here whose emit
+# site omits ``qid=`` is a finding, and so is a kind listed here that
+# is not in EVENT_KINDS (stale registry entry).  Keep as a plain
+# literal — the checker parses it from the AST.
+QUERY_SCOPED_KINDS: Tuple[str, ...] = (
+    "diagnosis",
+    "dispatch_gap",
+    "exchange_round",
+    "gang_window",
+    "span",
+)
 
 
 def _to_native(v: Any) -> Any:

@@ -50,7 +50,7 @@ from dryad_tpu.exec.kernels import (
 )
 from dryad_tpu.exec.operands import DeviceOperandPool, is_operand_capable
 from dryad_tpu.exec.stats import StageStatistics
-from dryad_tpu.obs import flightrec
+from dryad_tpu.obs import flightrec, tracectx
 from dryad_tpu.obs.metrics import MetricsRegistry
 from dryad_tpu.obs.span import Tracer
 from dryad_tpu.parallel.mesh import mesh_axes, num_partitions
@@ -194,6 +194,7 @@ class _CompileTimed:
         ex.metrics.add("xla_compile_s", dt, stage=self._name)
         ex.events.emit(
             "xla_compile", stage=self._name, key=self._key,
+            qid=tracectx.current_qid(),
             trace_s=round(self._build_s, 6), compile_s=round(dt, 6),
         )
         return out
@@ -1168,7 +1169,8 @@ class GraphExecutor:
                     for rnd in fn.xchg_rounds:
                         self.events.emit(
                             "exchange_round", stage=stage.id,
-                            name=stage.name, **rnd,
+                            name=stage.name,
+                            qid=tracectx.current_qid(), **rnd,
                         )
                     counts_dev = None
                     if want_count:

@@ -36,7 +36,7 @@ import threading
 import time
 from typing import Any, Iterator, Optional
 
-from dryad_tpu.obs import flightrec, telemetry
+from dryad_tpu.obs import flightrec, telemetry, tracectx
 from dryad_tpu.obs.span import Tracer
 
 __all__ = [
@@ -102,6 +102,10 @@ class ChunkPrefetcher:
         # producer-thread spans (cat=prefetch): each source pull is one
         # slice on the prefetch track of the Perfetto export
         self._tracer = Tracer(events)
+        # the producer thread works FOR the query active at
+        # construction: re-activate its trace context in _feed so
+        # cat=prefetch spans carry the qid
+        self._tctx = tracectx.current()
         self.stats = PipelineStats()
         self._source = source
         self._sem = threading.Semaphore(depth)  # in-flight budget
@@ -127,6 +131,10 @@ class ChunkPrefetcher:
     # -- producer ----------------------------------------------------------
 
     def _feed(self) -> None:
+        with tracectx.activate(self._tctx):
+            self._feed_inner()
+
+    def _feed_inner(self) -> None:
         tail: Any = _Done()
         try:
             it = iter(self._source)
@@ -294,6 +302,9 @@ class DispatchWindow:
         self.depth = depth
         self.name = name
         self.events = events
+        # collector-thread readback spans (cat=readback): the d2h
+        # transfer each query's critical path ends on
+        self._tracer = Tracer(events)
         self.dispatches = 0
         self.retries = 0
         self.gap_s = 0.0
@@ -301,7 +312,7 @@ class DispatchWindow:
         # driver CPU over the window's life: __init__/close both run on
         # the driver thread, so thread_time deltas are driver-only
         self._t0_cpu = time.thread_time()
-        self._pending: list = []  # (tag, fetch) awaiting the collector
+        self._pending: list = []  # (tag, fetch, tctx) for the collector
         self._done: list = []  # (tag, value, error) in submit order
         self._outstanding = 0  # submitted - consumed by the driver
         self._cv = threading.Condition()
@@ -311,6 +322,11 @@ class DispatchWindow:
         # dispatch gap — counting it would drown the between-dispatch
         # signal the metric exists for
         self._idle_since: Optional[float] = None
+        # when the driver last consumed an outcome (ready/drain pop):
+        # once everything submitted has been committed, idle time past
+        # this point is between-query think time on a shared window,
+        # not a device gap — submit clamps its gap accounting here
+        self._last_commit: Optional[float] = None
         self._thread = threading.Thread(
             target=self._collect, name=f"dryad-{name}", daemon=True
         )
@@ -333,10 +349,15 @@ class DispatchWindow:
                     self._cv.wait(0.1)
                 if not self._pending:
                     return  # closed and drained
-                tag, fetch = self._pending[0]
+                tag, fetch, tctx = self._pending[0]
             value, error = None, None
             try:
-                value = fetch()
+                # the fetch works FOR the query that submitted it:
+                # readback spans on this thread carry its qid
+                with tracectx.activate(tctx), self._tracer.span(
+                    "fetch", cat="readback", pipeline=self.name,
+                ):
+                    value = fetch()
             except BaseException as e:  # noqa: BLE001 - delivered at drain
                 error = e
             with self._cv:
@@ -358,7 +379,15 @@ class DispatchWindow:
             if self._closed:
                 raise RuntimeError(f"dispatch window {self.name} closed")
             if not self._pending and self._idle_since is not None:
-                gap = now - self._idle_since
+                end = now
+                if self._outstanding == 0 and self._last_commit is not None:
+                    # fully drained AND fully committed: the previous
+                    # query/stream ended here, so the tail between its
+                    # last commit and this submit is caller think time
+                    # (inter-query idle on a shared serve window), not
+                    # device starvation — clamp to the last commit
+                    end = min(now, self._last_commit)
+                gap = max(0.0, end - self._idle_since)
                 self.gap_s += gap
                 in_flight = len(self._pending)
             else:
@@ -369,7 +398,7 @@ class DispatchWindow:
             # driver is the one blocked here)
             while len(self._pending) >= self.depth and not self._closed:
                 self._cv.wait(0.1)
-            self._pending.append((tag, fetch))
+            self._pending.append((tag, fetch, tracectx.current()))
             self._outstanding += 1
             self.dispatches += 1
             self._idle_since = None
@@ -378,6 +407,7 @@ class DispatchWindow:
             self.events.emit(
                 "dispatch_gap", pipeline=self.name,
                 gap_s=round(gap, 6), in_flight=in_flight,
+                qid=tracectx.current_qid(),
             )
 
     def note_retry(self) -> None:
@@ -413,6 +443,7 @@ class DispatchWindow:
                     return
                 item = self._done.pop(0)
                 self._outstanding -= 1
+                self._last_commit = time.monotonic()
                 self._cv.notify_all()
             yield item
 
@@ -427,6 +458,7 @@ class DispatchWindow:
                     self._cv.wait(0.1)
                 item = self._done.pop(0)
                 self._outstanding -= 1
+                self._last_commit = time.monotonic()
                 self._cv.notify_all()
             yield item
 

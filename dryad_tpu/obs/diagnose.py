@@ -51,6 +51,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from dryad_tpu.exec.stats import StageStatistics
+from dryad_tpu.obs import tracectx
 
 __all__ = ["DiagnosisEngine", "scan", "RULES", "drain_recent"]
 
@@ -260,7 +261,7 @@ class DiagnosisEngine:
             self.events.emit(
                 "diagnosis", rule=rule, severity=severity,
                 evidence=dict(evidence, subject=subject), hint=hint,
-                **extra,
+                qid=tracectx.current_qid(), **extra,
             )
         return True
 

@@ -31,6 +31,8 @@ import threading
 import time
 from typing import Any, Optional
 
+from dryad_tpu.obs import tracectx
+
 __all__ = ["Span", "Tracer"]
 
 # process-wide id source: tracers are cheap per-module conveniences,
@@ -83,9 +85,12 @@ class Span:
             # StopIteration is iterator protocol, not a fault (the
             # prefetch span around a source pull ends its stream with it)
             self.fields.setdefault("error", f"{exc_type.__name__}: {exc}")
+        # a field passed at construction (worker spans re-activating a
+        # wire context may pre-stamp) wins over the thread-local scope
+        qid = self.fields.pop("qid", None) or tracectx.current_qid()
         self._tracer._events.emit(
             "span", name=self.name, cat=self.cat, span_id=self.span_id,
-            parent_id=self.parent_id, dur=round(dur, 6),
+            parent_id=self.parent_id, dur=round(dur, 6), qid=qid,
             thread=threading.current_thread().name, **self.fields,
         )
 

@@ -67,6 +67,7 @@ __all__ = [
     "latency_bucket",
     "bucket_upper",
     "percentile_of",
+    "quantiles_from_hist",
     "prometheus_text",
 ]
 
@@ -83,6 +84,8 @@ METRIC_KEYS: Dict[str, str] = {
     "queries_rejected": "admissions refused past quota, per tenant",
     "result_cache_hits": "queries served from the result cache",
     "query_latency_s": "admission->completion latency, per tenant",
+    "query_phase_s": "critical-path phase time per completed query, "
+                     "per tenant+phase (obs.critpath fold)",
     "serve_queue_depth": "queued-and-unpicked queries across tenants",
     "hbm_used_bytes": "device HBM in use (summed over local devices)",
     "hbm_limit_bytes": "device HBM capacity (summed over local devices)",
@@ -136,6 +139,32 @@ def percentile_of(values, q: float) -> Optional[float]:
         if cum >= rank:
             return bucket_upper(e)
     return bucket_upper(max(counts))
+
+
+def quantiles_from_hist(
+    merged: Dict[int, int], qs: Tuple[float, ...] = _QUANTILES
+) -> Optional[Dict[str, float]]:
+    """``{"n", "p50", ...}`` from a pow2 bucket histogram (exponent ->
+    count), or None when empty.  THE quantile fold — the live
+    :meth:`RollingStore.percentiles`, the offline :func:`percentile_of`,
+    and metricsd's fleet merge all read through it, so every surface
+    agrees bucket-for-bucket."""
+    n = sum(merged.values())
+    if n == 0:
+        return None
+    out: Dict[str, float] = {"n": n}
+    exps = sorted(merged)
+    for q in qs:
+        rank = max(1, math.ceil(q * n))
+        cum = 0
+        val = bucket_upper(exps[-1])
+        for e in exps:
+            cum += merged[e]
+            if cum >= rank:
+                val = bucket_upper(e)
+                break
+        out[f"p{int(q * 100)}"] = val
+    return out
 
 
 def _labels_key(labels: Dict[str, Any]) -> Tuple[Tuple[str, str], ...]:
@@ -247,22 +276,7 @@ class RollingStore:
         key = (name, _labels_key(labels))
         with self._lock:
             merged = self._merged_hist_locked(key)
-        n = sum(merged.values())
-        if n == 0:
-            return None
-        out: Dict[str, float] = {"n": n}
-        exps = sorted(merged)
-        for q in qs:
-            rank = max(1, math.ceil(q * n))
-            cum = 0
-            val = bucket_upper(exps[-1])
-            for e in exps:
-                cum += merged[e]
-                if cum >= rank:
-                    val = bucket_upper(e)
-                    break
-            out[f"p{int(q * 100)}"] = val
-        return out
+        return quantiles_from_hist(merged, qs)
 
     def label_sets(self, name: str) -> List[Dict[str, str]]:
         """Every label combination seen for ``name`` in the window."""
@@ -283,15 +297,23 @@ class RollingStore:
     def snapshot(self) -> Dict[str, Any]:
         """One JSON-able point-in-time readout of the whole window:
         counters (windowed totals), gauges, and per-label latency
-        percentiles — the metricsd JSON export body."""
+        percentiles — the metricsd JSON export body.  Each latency
+        entry also carries its raw pow2 ``buckets`` (exponent ->
+        count, string keys for JSON), the lossless form metricsd's
+        fleet aggregator merges across processes before re-deriving
+        quantiles — merging the percentile readouts themselves would
+        not commute."""
         with self._lock:
             live = self._live_locked()
             counters: Dict[Tuple, int] = {}
-            hist_keys = set()
+            hists: Dict[Tuple, Dict[int, int]] = {}
             for slot in live:
                 for key, n in slot["counters"].items():
                     counters[key] = counters.get(key, 0) + n
-                hist_keys.update(slot["hists"])
+                for key, h in slot["hists"].items():
+                    merged = hists.setdefault(key, {})
+                    for e, n in h.items():
+                        merged[e] = merged.get(e, 0) + n
             gauges = dict(self._gauges)
         out: Dict[str, Any] = {
             "window_s": self.window_s,
@@ -305,11 +327,17 @@ class RollingStore:
             ],
             "latencies": [],
         }
-        for name, lk in sorted(hist_keys):
-            pct = self.percentiles(name, **dict(lk))
+        for (name, lk), merged in sorted(hists.items()):
+            pct = quantiles_from_hist(merged)
             if pct is not None:
                 out["latencies"].append(
-                    {"name": name, "labels": dict(lk), **pct}
+                    {
+                        "name": name, "labels": dict(lk),
+                        "buckets": {
+                            str(e): n for e, n in sorted(merged.items())
+                        },
+                        **pct,
+                    }
                 )
         return out
 

@@ -39,7 +39,8 @@ from collections import deque
 from typing import Any, Dict, Optional, Tuple
 
 from dryad_tpu.exec.pipeline import DispatchWindow
-from dryad_tpu.obs import flightrec
+from dryad_tpu.obs import critpath, flightrec, tracectx
+from dryad_tpu.obs.span import Tracer
 from dryad_tpu.obs.telemetry import RollingStore
 from dryad_tpu.serve.admission import QueryRejected, TenantQuota
 from dryad_tpu.serve.cache import ResultCache
@@ -84,7 +85,7 @@ class _Queued:
 
     __slots__ = (
         "state", "qid", "query", "future", "cost_bytes", "cost_units",
-        "epoch", "t_submit",
+        "epoch", "t_submit", "tctx",
     )
 
     def __init__(self, state, qid, query, future, cost_bytes, cost_units,
@@ -97,6 +98,9 @@ class _Queued:
         self.cost_units = cost_units
         self.epoch = epoch  # tenant ingest epoch at ADMISSION
         self.t_submit = t_submit
+        # trace identity, minted at admission: every span/event the
+        # query causes — on any thread or gang worker — carries qid
+        self.tctx = tracectx.mint(tenant=state.name, qid=qid)
 
 
 class _TenantState:
@@ -190,6 +194,19 @@ class QueryService:
         self.slo = RollingStore(
             window_s=getattr(self.config, "telemetry_window_s", 60.0)
         )
+        # driver-side serve spans (cache_probe etc) for the per-query
+        # critical-path fold
+        self.tracer = Tracer(self.events)
+        # per-query trace buffers: an EventLog tap routes each
+        # qid-stamped event (worker telemetry included — absorb() runs
+        # taps too) into its query's buffer between admission and
+        # completion, so the critical-path fold at _finish reads one
+        # small list instead of refolding the whole ring
+        self._trace_buf: Dict[str, list] = {}
+        if self.events is not None:
+            self.events.add_tap(self._trace_tap)
+        # cumulative per-tenant critical-path phase seconds (stats())
+        self._phase_totals: Dict[str, Dict[str, float]] = {}
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         # ingest (client threads) vs lowering/dispatch (driver thread)
@@ -245,6 +262,9 @@ class QueryService:
             # rejection instead of letting them wait forever
             self._cancel_queued()
         self._window.close()
+        if self.events is not None:
+            self.events.remove_tap(self._trace_tap)
+        self._trace_buf.clear()
         flightrec.unprobe("serve:queue")
 
     def __enter__(self) -> "QueryService":
@@ -311,6 +331,9 @@ class QueryService:
                 st.inflight_bytes += cost
                 st.admitted += 1
                 st.queue.append(item)
+                # open the trace buffer BEFORE query_admitted fires so
+                # the lifecycle event itself lands in it
+                self._trace_buf[qid] = []
                 self._queued += 1
                 queued = len(st.queue)
                 if (not st.saturated
@@ -412,7 +435,13 @@ class QueryService:
     def _dispatch(self, item: _Queued) -> None:
         """Resolve ``item`` from the cache, or dispatch it.  Any
         lowering/compile error resolves the future — the loop never
-        dies on one tenant's bad plan."""
+        dies on one tenant's bad plan.  Runs under the query's trace
+        context: lowering/compile spans, the window handoff, and the
+        gang envelopes all inherit its qid."""
+        with tracectx.activate(item.tctx):
+            self._dispatch_traced(item)
+
+    def _dispatch_traced(self, item: _Queued) -> None:
         st = item.state
         key = None
         try:
@@ -425,24 +454,31 @@ class QueryService:
                     self._finish(item, table=table)
                     return
                 if self._cache.budget > 0:
-                    fp = self.ctx.query_fingerprint(item.query)
-                    if fp is not None:
-                        key = (st.name, fp)
-                        table = self._cache.get(key, item.epoch)
-                        if table is not None:
-                            rows = (
-                                len(next(iter(table.values())))
-                                if table else 0
+                    with self.tracer.span(
+                        "cache_probe", cat="serve", query=item.qid,
+                    ):
+                        fp = self.ctx.query_fingerprint(item.query)
+                        table = None
+                        if fp is not None:
+                            item.tctx.fingerprint = (
+                                f"{hash(fp) & (1 << 64) - 1:016x}"
                             )
-                            self.slo.incr(
-                                "result_cache_hits", tenant=st.name
-                            )
-                            self.events.emit(
-                                "result_cache_hit", tenant=st.name,
-                                query=item.qid, rows=rows,
-                            )
-                            self._finish(item, table=table, cached=True)
-                            return
+                            key = (st.name, fp)
+                            table = self._cache.get(key, item.epoch)
+                    if table is not None:
+                        rows = (
+                            len(next(iter(table.values())))
+                            if table else 0
+                        )
+                        self.slo.incr(
+                            "result_cache_hits", tenant=st.name
+                        )
+                        self.events.emit(
+                            "result_cache_hit", tenant=st.name,
+                            query=item.qid, rows=rows,
+                        )
+                        self._finish(item, table=table, cached=True)
+                        return
                 fetch = self.ctx.run_to_host_async(item.query)
         except Exception as e:
             self._finish(item, error=e)
@@ -508,8 +544,45 @@ class QueryService:
                 inflight=quota_event["inflight"],
                 limit=quota_event["limit"], bytes=quota_event["bytes"],
             )
+        # critical-path fold: pop the trace buffer (query_complete just
+        # landed in it via the tap) and sweep it into per-phase seconds
+        # for the tenant's SLO plane.  Attribution failure must never
+        # fail the query.
+        trace = self._trace_buf.pop(item.qid, None)
+        if trace is not None:
+            try:
+                bd = critpath.fold_query(trace, item.qid)
+            except Exception:
+                bd = None
+            if bd is not None and bd.phases:
+                with self._lock:
+                    tot = self._phase_totals.setdefault(st.name, {})
+                    for ph, secs in bd.phases.items():
+                        tot[ph] = tot.get(ph, 0.0) + secs
+                for ph, secs in bd.phases.items():
+                    if secs > 0.0:
+                        self.slo.observe_latency(
+                            "query_phase_s", secs,
+                            tenant=st.name, phase=ph,
+                        )
         item.future.cached = cached
         item.future._resolve(result=table, error=error)
+
+    def _trace_tap(self, ev: Dict[str, Any]) -> None:
+        """EventLog tap: route qid-stamped events (and ``query=``-keyed
+        lifecycle events) into the per-query trace buffer, if one is
+        open.  Runs on every emit AND every absorbed worker telemetry
+        event; must stay cheap and never raise."""
+        q = ev.get("qid")
+        if q is None and ev.get("kind") in (
+            "query_admitted", "query_complete", "result_cache_hit",
+        ):
+            q = ev.get("query")
+        if q is None:
+            return
+        buf = self._trace_buf.get(q)
+        if buf is not None:
+            buf.append(ev)
 
     # -- failure teardown --------------------------------------------------
 
@@ -559,11 +632,22 @@ class QueryService:
             }
         # rolling-window SLO readout: admission->completion latency
         # percentiles per tenant (None until a query completes inside
-        # the window)
-        slo = {
-            name: self.slo.percentiles("query_latency_s", tenant=name)
-            for name in tenants
-        }
+        # the window), plus cumulative critical-path phase seconds once
+        # any query has been folded
+        with self._lock:
+            phase_totals = {
+                t: dict(ph) for t, ph in self._phase_totals.items()
+            }
+        slo: Dict[str, Any] = {}
+        for name in tenants:
+            pct = self.slo.percentiles("query_latency_s", tenant=name)
+            phases = phase_totals.get(name)
+            if phases:
+                pct = dict(pct or {})
+                pct["phases"] = {
+                    p: round(v, 6) for p, v in sorted(phases.items())
+                }
+            slo[name] = pct
         return {
             "tenants": tenants,
             "slo": slo,

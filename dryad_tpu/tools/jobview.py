@@ -1249,6 +1249,28 @@ def render_tenants(events: List[Dict[str, Any]]) -> str:
     return "\n".join(lines)
 
 
+def render_queries(events: List[Dict[str, Any]]) -> str:
+    """Per-query critical-path panel: one line per traced query
+    (``obs.critpath`` fold over the qid-stamped span/compile/lifecycle
+    events), showing where each query's wall time went — admission
+    wait, cache probe, compile, ingest, dispatch, exchange,
+    collective, readback.  Empty for streams with no query-scoped
+    events."""
+    from dryad_tpu.obs import critpath
+
+    folds = {
+        qid: bd
+        for qid, bd in critpath.fold_all(events).items()
+        if bd.phases or bd.spans
+    }
+    if not folds:
+        return ""
+    lines = ["-- queries --"]
+    for bd in folds.values():
+        lines.append("  " + bd.format())
+    return "\n".join(lines)
+
+
 def render_telemetry(events: List[Dict[str, Any]]) -> str:
     """Continuous-telemetry panel: the ``resource_sample`` stream
     (``obs.telemetry.ResourceMonitor``) folded to HBM/RSS extremes,
@@ -1305,6 +1327,7 @@ def _render_stream(events: List[Dict[str, Any]]) -> str:
         text = render(build_job(events))
     attr = render_attribution(events)
     tenants = render_tenants(events)
+    queries = render_queries(events)
     telemetry = render_telemetry(events)
     health = render_health(events)
     rewrites = render_rewrites(events)
@@ -1312,6 +1335,7 @@ def _render_stream(events: List[Dict[str, Any]]) -> str:
         text
         + ("\n" + attr if attr else "")
         + ("\n\n" + tenants if tenants else "")
+        + ("\n\n" + queries if queries else "")
         + ("\n\n" + telemetry if telemetry else "")
         + ("\n\n" + health if health else "")
         + ("\n\n" + rewrites if rewrites else "")

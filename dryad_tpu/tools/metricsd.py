@@ -1,18 +1,31 @@
-"""metricsd — scrape a dryad_tpu event log into Prometheus/JSON.
+"""metricsd — scrape dryad_tpu event logs into one Prometheus/JSON view.
 
 The continuous telemetry plane (``obs.telemetry``) keeps its rolling
 SLO state inside the resident process; this CLI is the OUT-of-process
-export surface: it folds a JSONL event log (the Calypso-style stream a
+export surface: it folds JSONL event logs (the Calypso-style stream a
 running service writes via ``config.event_log_dir``) through the SAME
 :class:`~dryad_tpu.obs.telemetry.RollingStore` the live plane uses, so
 a scrape shows exactly what the service would report — per-tenant
-query counters, admission→completion latency p50/p95/p99, and the
-latest resource gauges — in Prometheus text exposition or a JSON
-snapshot.
+query counters, admission→completion latency p50/p95/p99, per-query
+critical-path phase seconds, and the latest resource gauges — in
+Prometheus text exposition or a JSON snapshot.
+
+**Fleet aggregation**: pass several inputs and metricsd merges them
+into one fleet view.  ``*.jsonl`` inputs are event logs (all folded
+into one shared store — summed observations ARE the merged
+histogram); ``*.json`` inputs are RollingStore snapshots exported by
+OTHER processes (their ``--json-out`` sinks), merged loss-lessly via
+the raw pow2 ``buckets`` each latency entry carries: counters sum,
+gauges sum (fleet totals), histograms merge bucket-for-bucket and the
+fleet p50/p95/p99 re-derive through the same
+:func:`~dryad_tpu.obs.telemetry.quantiles_from_hist` fold the live
+plane uses.  Merging the percentile readouts themselves would not
+commute; merging buckets does.
 
 Usage::
 
-    python -m dryad_tpu.tools.metricsd events.jsonl
+    python -m dryad_tpu.tools.metricsd events.jsonl [more.jsonl ...]
+        [proc2-snapshot.json ...]
         [--json] [--prom out.prom] [--json-out out.json]
         [--window S] [--follow --interval S]
 
@@ -20,9 +33,11 @@ One-shot (default) folds the whole log into one window and prints
 Prometheus text (``--json`` prints the JSON snapshot instead).
 ``--prom`` / ``--json-out`` write file sinks (atomic tmp+rename, so a
 scraper never reads a torn file).  ``--follow`` keeps the process
-resident: it re-reads the log from the last byte offset every
-``--interval`` seconds and rewrites the sinks — the "periodic file
-sink" deployment, one step short of an HTTP endpoint.
+resident: it tails each log from its last byte offset every
+``--interval`` seconds — surviving log rotation (see
+:class:`LogCursor`) — re-reads snapshot inputs wholesale, and
+rewrites the sinks: the "periodic file sink" deployment, one step
+short of an HTTP endpoint.
 """
 
 from __future__ import annotations
@@ -33,9 +48,17 @@ import sys
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-from dryad_tpu.obs.telemetry import RollingStore, prometheus_text
+from dryad_tpu.obs import critpath
+from dryad_tpu.obs.telemetry import (
+    RollingStore,
+    prometheus_text,
+    quantiles_from_hist,
+)
 
-__all__ = ["fold_events", "load_events", "main"]
+__all__ = [
+    "LogCursor", "fold_events", "fold_query_phases", "load_events",
+    "merge_snapshots", "main",
+]
 
 # one-shot folds have no live clock: make the window wide enough that
 # every event in the log lands in the readout
@@ -67,6 +90,38 @@ def load_events(
         except ValueError:
             continue
     return out, offset + end + 1
+
+
+class LogCursor:
+    """Byte-offset tail over a possibly-rotating JSONL log.
+
+    A bare ``load_events(path, offset)`` loop silently goes blind when
+    the producer rotates the file (new inode at the same path) or
+    truncates it in place: the retained offset points past the end of
+    the fresh file, ``rfind`` sees no newline, and every subsequent
+    poll returns nothing.  The cursor stats the path each poll and
+    restarts from byte 0 on an inode change OR a size regression, so
+    post-rotation events keep flowing."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.offset = 0
+        self._ino: Optional[int] = None
+
+    def poll(self) -> List[Dict[str, Any]]:
+        """New complete events since the last poll (empty on a missing
+        file — the producer may not have started yet)."""
+        try:
+            st = os.stat(self.path)
+        except OSError:
+            return []
+        if (
+            self._ino is not None and st.st_ino != self._ino
+        ) or st.st_size < self.offset:
+            self.offset = 0
+        self._ino = st.st_ino
+        events, self.offset = load_events(self.path, self.offset)
+        return events
 
 
 def fold_events(
@@ -114,6 +169,92 @@ def fold_events(
     return store
 
 
+def fold_query_phases(
+    events: List[Dict[str, Any]], store: RollingStore
+) -> None:
+    """Offline twin of the serve-side critical-path fold: sweep each
+    qid's span DAG (``obs.critpath``) and observe per-phase seconds —
+    the same ``query_phase_s`` latency histogram the live
+    ``QueryService`` feeds.  One-shot only: an incremental tail may
+    split a query's events across polls and would under-attribute."""
+    for bd in critpath.fold_all(events).values():
+        tenant = str(bd.tenant or "?")
+        for phase, secs in bd.phases.items():
+            if secs > 0.0:
+                store.observe_latency(
+                    "query_phase_s", secs, tenant=tenant, phase=phase
+                )
+
+
+def _lkey(labels: Dict[str, Any]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def merge_snapshots(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Merge several :meth:`RollingStore.snapshot` dicts into one
+    fleet snapshot (same shape — ``prometheus_text`` renders it
+    directly).  Counters and gauges sum per (name, labels); latency
+    histograms merge their raw pow2 ``buckets`` bucket-for-bucket and
+    the fleet quantiles re-derive through
+    :func:`~dryad_tpu.obs.telemetry.quantiles_from_hist` — the ONLY
+    commutative fold (a p95-of-p95s is not a fleet p95).  Latency
+    entries without ``buckets`` (pre-bucket exporters) merge their
+    counts but cannot contribute to quantiles."""
+    counters: Dict[Tuple, int] = {}
+    gauges: Dict[Tuple, Any] = {}
+    hists: Dict[Tuple, Dict[int, int]] = {}
+    window = 0.0
+    for snap in snaps:
+        window = max(window, float(snap.get("window_s", 0.0) or 0.0))
+        for rec in snap.get("counters", []):
+            key = (rec["name"], _lkey(rec.get("labels", {})))
+            counters[key] = counters.get(key, 0) + int(rec["total"])
+        for rec in snap.get("gauges", []):
+            key = (rec["name"], _lkey(rec.get("labels", {})))
+            gauges[key] = gauges.get(key, 0) + rec["value"]
+        for rec in snap.get("latencies", []):
+            key = (rec["name"], _lkey(rec.get("labels", {})))
+            merged = hists.setdefault(key, {})
+            for e, n in (rec.get("buckets") or {}).items():
+                e = int(e)
+                merged[e] = merged.get(e, 0) + int(n)
+    out: Dict[str, Any] = {
+        "window_s": window,
+        "processes": len(snaps),
+        "counters": [
+            {"name": name, "labels": dict(lk), "total": total}
+            for (name, lk), total in sorted(counters.items())
+        ],
+        "gauges": [
+            {"name": name, "labels": dict(lk), "value": v}
+            for (name, lk), v in sorted(gauges.items())
+        ],
+        "latencies": [],
+    }
+    for (name, lk), merged in sorted(hists.items()):
+        pct = quantiles_from_hist(merged)
+        if pct is not None:
+            out["latencies"].append(
+                {
+                    "name": name, "labels": dict(lk),
+                    "buckets": {
+                        str(e): n for e, n in sorted(merged.items())
+                    },
+                    **pct,
+                }
+            )
+    return out
+
+
+def _load_snapshot(path: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(path) as fh:
+            snap = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    return snap if isinstance(snap, dict) else None
+
+
 def _write_atomic(path: str, text: str) -> None:
     tmp = path + f".tmp.{os.getpid()}"
     with open(tmp, "w") as fh:
@@ -121,23 +262,34 @@ def _write_atomic(path: str, text: str) -> None:
     os.replace(tmp, path)
 
 
-def _render(store: RollingStore, as_json: bool) -> str:
-    snap = store.snapshot()
-    if as_json:
-        return json.dumps(snap, default=str)
-    return prometheus_text(snap)
-
-
-def _emit(store: RollingStore, as_json: bool,
+def _emit(snapshot: Dict[str, Any], as_json: bool,
           prom_out: Optional[str], json_out: Optional[str]) -> None:
     if prom_out:
-        _write_atomic(prom_out, prometheus_text(store.snapshot()))
+        _write_atomic(prom_out, prometheus_text(snapshot))
     if json_out:
-        _write_atomic(
-            json_out, json.dumps(store.snapshot(), default=str)
-        )
+        _write_atomic(json_out, json.dumps(snapshot, default=str))
     if not prom_out and not json_out:
-        print(_render(store, as_json))
+        print(
+            json.dumps(snapshot, default=str)
+            if as_json else prometheus_text(snapshot)
+        )
+
+
+def _fleet_snapshot(
+    store: RollingStore, snap_paths: List[str]
+) -> Dict[str, Any]:
+    """The emitted view: the local fold's snapshot merged with every
+    readable remote snapshot (one store already holds ALL event-log
+    inputs; ``.json`` peers merge on top)."""
+    own = store.snapshot()
+    if not snap_paths:
+        return own
+    snaps = [own]
+    for p in snap_paths:
+        snap = _load_snapshot(p)
+        if snap is not None:
+            snaps.append(snap)
+    return merge_snapshots(snaps)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -163,29 +315,44 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not args:
         print(
             "usage: python -m dryad_tpu.tools.metricsd <events.jsonl> "
+            "[more.jsonl ...] [peer-snapshot.json ...] "
             "[--json] [--prom out.prom] [--json-out out.json] "
             "[--window S] [--follow --interval S]",
             file=sys.stderr,
         )
         return 2
-    path = args[0]
-    if not follow and not os.path.exists(path):
-        print(f"no event log at {path}", file=sys.stderr)
-        return 1
+    # .json inputs are peer snapshots (another process's --json-out);
+    # everything else is an event log to fold locally
+    snap_paths = [p for p in args if p.endswith(".json")]
+    log_paths = [p for p in args if not p.endswith(".json")]
     if not follow:
-        events, _ = load_events(path)
+        missing = [p for p in args if not os.path.exists(p)]
+        if missing:
+            print(f"no input at {missing[0]}", file=sys.stderr)
+            return 1
         store = RollingStore(window_s=window or ONESHOT_WINDOW_S)
-        fold_events(events, store)
-        _emit(store, as_json, prom_out, json_out)
+        all_events: List[Dict[str, Any]] = []
+        for p in log_paths:
+            events, _ = load_events(p)
+            all_events.extend(events)
+        fold_events(all_events, store)
+        fold_query_phases(all_events, store)
+        _emit(
+            _fleet_snapshot(store, snap_paths),
+            as_json, prom_out, json_out,
+        )
         return 0
-    # resident mode: a real rolling window over the live log
+    # resident mode: a real rolling window over the live logs
     store = RollingStore(window_s=window or 60.0)
-    offset = 0
+    cursors = [LogCursor(p) for p in log_paths]
     try:
         while True:
-            events, offset = load_events(path, offset)
-            fold_events(events, store)
-            _emit(store, as_json, prom_out, json_out)
+            for cur in cursors:
+                fold_events(cur.poll(), store)
+            _emit(
+                _fleet_snapshot(store, snap_paths),
+                as_json, prom_out, json_out,
+            )
             time.sleep(interval)
     except KeyboardInterrupt:
         return 0

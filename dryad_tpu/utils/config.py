@@ -442,6 +442,13 @@ class DryadConfig:
     telemetry_window_s: float = _env_float(
         "DRYAD_TPU_TELEMETRY_WINDOW_S", 60.0
     )
+    # Query-scoped trace propagation (obs.tracectx): run_* entry
+    # points mint a TraceContext so every span / exchange_round /
+    # dispatch_gap / gang_window / diagnosis event is attributable to
+    # one query (obs.critpath folds them into a critical-path
+    # breakdown).  Off = events still flow, unstamped — no per-query
+    # attribution; the bench --obs-overhead A/B flips this.
+    query_trace: bool = _env_bool("DRYAD_TPU_QUERY_TRACE", True)
 
     def __post_init__(self) -> None:
         self.validate()
@@ -657,4 +664,6 @@ CONFIG_KEYS = {
     "obs_telemetry": "continuous resource sampler + measured headroom",
     "telemetry_sample_s": "min seconds between resource samples",
     "telemetry_window_s": "rolling metric window for SLO readouts",
+    "query_trace": "query-scoped trace propagation (obs.tracectx); "
+                   "qid-stamps events for critical-path attribution",
 }

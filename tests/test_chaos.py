@@ -618,3 +618,55 @@ def test_chaos_worker_killed_mid_level_minus1_merge():
     )
     kinds = [e["kind"] for e in merged["events"]]
     assert "worker_killed_injected" in kinds
+
+
+def test_chaos_gang_kill_preserves_query_trace_and_bytes():
+    """End-to-end tracing under gang failure: a seeded kill takes one
+    gang member mid-query, auto-recovery rebuilds the gang and re-runs
+    — and the merged cross-process trace still yields ONE complete
+    critical path for the retried query (worker spans shipped back on
+    the telemetry channel carry the qid from the re-stamped mailbox
+    envelopes), with results byte-identical to an undisturbed rerun."""
+    from dryad_tpu.cluster.localjob import LocalJobSubmission
+    from dryad_tpu.obs import critpath, tracectx
+
+    rng = np.random.default_rng(7)
+    tbl = {
+        "k": rng.integers(0, 11, 600).astype(np.int32),
+        "v": rng.standard_normal(600).astype(np.float32),
+    }
+    with LocalJobSubmission(num_workers=2, devices_per_worker=1) as sub:
+        ctx = DryadContext(num_partitions_=2)
+        q = ctx.from_arrays(tbl).group_by(
+            "k", {"s": ("sum", "v"), "n": ("count", None)}
+        )
+        sub.inject_fault(
+            None,
+            plan={"seed": 7, "worker_kill_prob": 1.0,
+                  "max_worker_kills": 1, "stages": ["group_by"]},
+            workers=[1],
+        )
+        tctx = tracectx.mint(tenant="chaos")
+        with tracectx.activate(tctx):
+            out = sub.submit(q)
+        kinds = [e["kind"] for e in sub.events.events()]
+        assert "gang_member_lost_mid_job" in kinds
+        assert "gang_rebuild" in kinds
+        evs = sub.events.events()
+        # worker spans from the RETRIED run shipped back qid-stamped
+        wspans = [e for e in evs if e.get("kind") == "span"
+                  and e.get("cat") == "worker"]
+        assert wspans, "no worker spans in the merged stream"
+        assert any(s.get("qid") == tctx.qid for s in wspans)
+        # one complete critical path for the query, flat-fallback
+        # (post-rebuild) execution included
+        bd = critpath.fold_query(evs, tctx.qid)
+        assert bd is not None and bd.phases
+        assert sum(bd.phases.values()) == pytest.approx(bd.total_s)
+        assert bd.total_s > 0 and bd.spans >= len(wspans)
+        # byte identity: the kill consumed its budget, so a rerun on
+        # the rebuilt gang is undisturbed — answers must not change
+        again = sub.submit(q)
+        assert set(out) == set(again)
+        for c in out:
+            assert out[c].tobytes() == again[c].tobytes(), c

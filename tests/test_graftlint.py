@@ -37,7 +37,7 @@ def test_registry_has_every_expected_rule():
         "event-schema", "kernel-determinism", "recompile-hazard",
         "span-discipline", "config-key", "collective-order",
         "sync-in-dispatch-loop", "serve-layering", "rewrite-layering",
-        "metric-key", "mailbox-discipline",
+        "metric-key", "mailbox-discipline", "trace-context",
     }
     assert expected == set(all_checkers())
     assert {"bad-suppression", "unused-suppression"} <= set(known_rules())

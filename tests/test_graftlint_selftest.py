@@ -1271,3 +1271,61 @@ def test_mailbox_discipline_lost_anchor_is_a_finding():
         "class GangDispatchWindow", "class GangCommandWindow",
     )
     _assert_fires(mutated, "mailbox-discipline")
+
+
+# -- trace-context -----------------------------------------------------------
+
+TRC_EVENTS = "dryad_tpu/exec/events.py"
+TRC_EMITTER = "dryad_tpu/obs/emitter.py"
+
+TRACE_FIXTURE = {
+    TRC_EVENTS: '''\
+EVENT_KINDS = {"span": "a span; qid", "tick": "one tick; n"}
+EVENT_PAYLOADS = {
+    "span": (("name",), ("qid",)),
+    "tick": (("n",), ()),
+}
+QUERY_SCOPED_KINDS = ("span",)
+''',
+    TRC_EMITTER: '''\
+def go(log, qid):
+    log.emit("span", name="s", qid=qid)
+    log.emit("tick", n=1)
+''',
+}
+
+
+def test_trace_context_clean_fixture():
+    assert _rules(TRACE_FIXTURE, "trace-context") == []
+
+
+@pytest.mark.parametrize(
+    "path,old,new",
+    [
+        # the original failure: one emit site forgets the stamp and
+        # that event class drops out of every per-query fold
+        (TRC_EMITTER, 'log.emit("span", name="s", qid=qid)',
+         'log.emit("span", name="s")'),
+        # a **blob forward does NOT satisfy the contract — the stamp
+        # must be visible at the site
+        (TRC_EMITTER, 'log.emit("span", name="s", qid=qid)',
+         'log.emit("span", name="s", **{"qid": qid})'),
+        # registry names a kind the schema has never heard of
+        (TRC_EVENTS, 'QUERY_SCOPED_KINDS = ("span",)',
+         'QUERY_SCOPED_KINDS = ("span", "ghost")'),
+        # registered kind whose payload spec forgot to admit qid
+        (TRC_EVENTS, '"span": (("name",), ("qid",)),',
+         '"span": (("name",), ()),'),
+        # stale registry entry: documented kind, no emit site left
+        (TRC_EMITTER, '    log.emit("span", name="s", qid=qid)\n', ''),
+        # registry must stay a parseable literal
+        (TRC_EVENTS, 'QUERY_SCOPED_KINDS = ("span",)',
+         'QUERY_SCOPED_KINDS = tuple(k for k in ("span",))'),
+    ],
+    ids=["missing-qid", "qid-via-star-blob", "unknown-kind",
+         "payload-without-qid", "stale-entry", "computed-registry"],
+)
+def test_trace_context_fires(path, old, new):
+    mutated = _mutate(TRACE_FIXTURE, path, old, new)
+    fired = _rules(mutated, "trace-context")
+    assert fired and set(fired) == {"trace-context"}, fired

@@ -1,0 +1,421 @@
+"""End-to-end query tracing: causal trace propagation, critical-path
+attribution, and fleet metric aggregation.
+
+The contract under test: a ``TraceContext`` minted at admission (serve)
+or at ``run_*`` (non-serve) threads one ``qid`` through the executor,
+the dispatch window handoffs, and the gang mailbox envelopes, so every
+query-scoped event in the merged cross-process stream is attributable
+to one query; ``obs.critpath`` folds that stream into a phase
+breakdown that sums to the end-to-end latency (line sweep — each
+instant charged to exactly one phase); and ``tools.metricsd`` merges
+several processes' RollingStore snapshots into one fleet view whose
+p50/p95/p99 match a bucket-for-bucket histogram fold.
+
+Also pinned here: the ``dispatch_gap`` post-drain clamp (the idle tail
+after a stream's last commit is caller think time, not device
+starvation) and ``metricsd --follow`` surviving log rotation.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dryad_tpu.api.context import DryadContext
+from dryad_tpu.exec.events import QUERY_SCOPED_KINDS, EventLog
+from dryad_tpu.obs import critpath, tracectx
+from dryad_tpu.obs.telemetry import (
+    RollingStore,
+    latency_bucket,
+    quantiles_from_hist,
+)
+from dryad_tpu.serve import QueryService
+from dryad_tpu.tools import metricsd
+from dryad_tpu.utils.config import DryadConfig
+
+
+# -- TraceContext ------------------------------------------------------------
+
+
+def test_tracectx_mint_activate_and_wire_round_trip():
+    assert tracectx.current() is None
+    assert tracectx.current_qid() is None
+    ctx = tracectx.mint(tenant="t0", fingerprint="fp")
+    assert ctx.qid.startswith(f"q-{os.getpid()}-")
+    with tracectx.activate(ctx):
+        assert tracectx.current() is ctx
+        assert tracectx.current_qid() == ctx.qid
+        inner = tracectx.mint(qid="inner")
+        with tracectx.activate(inner):
+            assert tracectx.current_qid() == "inner"
+        assert tracectx.current_qid() == ctx.qid
+    assert tracectx.current() is None
+    wire = ctx.to_wire()
+    back = tracectx.TraceContext.from_wire(wire)
+    assert back.qid == ctx.qid and back.tenant == "t0"
+    assert back.fingerprint == "fp"
+    assert tracectx.TraceContext.from_wire(None) is None
+    assert tracectx.TraceContext.from_wire({"tenant": "x"}) is None
+
+
+def test_tracectx_activate_none_is_passthrough():
+    with tracectx.activate(None):
+        assert tracectx.current() is None
+    ctx = tracectx.mint(qid="keep")
+    with tracectx.activate(ctx):
+        # a handoff site that captured nothing must not mask the
+        # active context
+        with tracectx.activate(None):
+            assert tracectx.current_qid() == "keep"
+
+
+def test_tracectx_crosses_threads_via_capture():
+    ctx = tracectx.mint(qid="xthread")
+    seen = []
+
+    def worker(captured):
+        with tracectx.activate(captured):
+            seen.append(tracectx.current_qid())
+
+    with tracectx.activate(ctx):
+        t = threading.Thread(target=worker, args=(tracectx.current(),))
+        t.start()
+        t.join()
+    assert seen == ["xthread"]
+
+
+# -- critical-path fold ------------------------------------------------------
+
+
+def _span(ts, dur, name, cat, qid="q1", sid=None, parent=None, **kw):
+    return dict(
+        kind="span", ts=ts, dur=dur, name=name, cat=cat, qid=qid,
+        span_id=sid or f"{name}@{ts}", parent_id=parent, **kw,
+    )
+
+
+def test_critpath_sweep_sums_to_wall_and_resolves_overlap():
+    # admission at t=0, completion at t=10; execute span [2, 8] with a
+    # nested readback [6, 8] (deeper wins); prefetch overlaps execute
+    # on another thread at equal depth but loses on priority
+    evs = [
+        {"kind": "query_admitted", "ts": 0.0, "query": "q1",
+         "tenant": "a"},
+        _span(8.0, 6.0, "execute", "execute", sid="e"),
+        _span(8.0, 2.0, "fetch", "readback", sid="r", parent="e"),
+        _span(7.0, 4.0, "prefetch", "prefetch", sid="p"),
+        {"kind": "query_complete", "ts": 10.0, "query": "q1",
+         "tenant": "a", "ok": True, "seconds": 10.0, "cached": False},
+    ]
+    bd = critpath.fold_query(evs, "q1")
+    assert bd.tenant == "a" and bd.ok is True
+    assert bd.total_s == pytest.approx(10.0)
+    # the sweep charges every instant exactly once
+    assert sum(bd.phases.values()) == pytest.approx(bd.total_s)
+    assert bd.phases["admission_wait"] == pytest.approx(2.0)
+    assert bd.phases["dispatch"] == pytest.approx(1.0)  # [2,3] execute
+    # [3,6]: prefetch (depth 0) ties execute (depth 0): ingest
+    # outranks dispatch on priority
+    assert bd.phases["ingest"] == pytest.approx(3.0)
+    assert bd.phases["readback"] == pytest.approx(2.0)  # nested wins
+    assert bd.phases["other"] == pytest.approx(2.0)  # [8,10] uncovered
+    assert bd.coverage() == pytest.approx(0.6)
+
+
+def test_critpath_compile_interval_and_exchange_accounting():
+    evs = [
+        _span(5.0, 5.0, "execute", "execute", sid="e"),
+        {"kind": "xla_compile", "ts": 3.0, "compile_s": 1.5,
+         "trace_s": 0.5, "qid": "q1"},
+        {"kind": "exchange_round", "ts": 4.0, "qid": "q1", "bytes": 128,
+         "rounds": 1},
+        {"kind": "exchange_round", "ts": 4.5, "qid": "q1", "bytes": 72},
+        {"kind": "dispatch_gap", "ts": 4.6, "qid": "q1", "gap_s": 0.25},
+        {"kind": "diagnosis", "ts": 4.7, "qid": "q1", "check": "x",
+         "severity": "info", "stage": "s"},
+    ]
+    bd = critpath.fold_query(evs, "q1")
+    # compile [1, 3] outranks the execute span it nests inside
+    assert bd.phases["compile"] == pytest.approx(2.0)
+    assert bd.phases["dispatch"] == pytest.approx(3.0)
+    assert bd.xchg_rounds == 2 and bd.xchg_bytes == 200
+    assert bd.dispatch_gap_s == pytest.approx(0.25)
+    assert bd.diagnoses == 1
+    assert bd.spans == 1
+    d = bd.as_dict()
+    assert d["qid"] == "q1" and d["phases"]["compile"] == 2.0
+
+
+def test_critpath_fold_all_and_unknown_qid():
+    evs = [_span(1.0, 1.0, "execute", "execute", qid="a"),
+           _span(2.0, 1.0, "execute", "execute", qid="b")]
+    folds = critpath.fold_all(evs)
+    assert list(folds) == ["a", "b"]
+    assert critpath.fold_query(evs, "nope") is None
+
+
+# -- every query-scoped kind reaches the fold (registry pin) -----------------
+
+
+def test_query_scoped_kinds_registry_covers_fold_inputs():
+    assert QUERY_SCOPED_KINDS == (
+        "diagnosis", "dispatch_gap", "exchange_round", "gang_window",
+        "span",
+    )
+
+
+# -- non-serve attribution: run_to_host stamps everything --------------------
+
+
+def test_collect_stamps_spans_and_breakdown_matches_e2e(rng):
+    ctx = DryadContext(num_partitions_=8)
+    tbl = {"k": rng.integers(0, 13, 512).astype(np.int32),
+           "v": rng.random(512).astype(np.float32)}
+    q = ctx.from_arrays(tbl).group_by("k", {"s": ("sum", "v")})
+    t0 = time.monotonic()
+    q.collect()
+    e2e = time.monotonic() - t0
+    evs = ctx.events.events()
+    qids = critpath.query_ids(evs)
+    assert len(qids) == 1, qids
+    spans = [e for e in evs if e.get("kind") == "span"]
+    assert spans and all(s.get("qid") == qids[0] for s in spans)
+    bd = critpath.fold_query(evs, qids[0])
+    assert sum(bd.phases.values()) == pytest.approx(bd.total_s)
+    # acceptance: the attributed breakdown accounts for the measured
+    # end-to-end latency within 5% (floor absorbs clock granularity)
+    assert bd.total_s <= e2e + 0.05
+    assert bd.total_s >= min(e2e * 0.95, e2e - 0.05)
+
+
+def test_query_trace_off_leaves_events_unstamped(rng):
+    ctx = DryadContext(
+        num_partitions_=8, config=DryadConfig(query_trace=False)
+    )
+    tbl = {"k": rng.integers(0, 7, 128).astype(np.int32)}
+    ctx.from_arrays(tbl).distinct("k").collect()
+    assert critpath.query_ids(ctx.events.events()) == []
+
+
+def test_explain_analyze_appends_critical_path_panel(rng):
+    ctx = DryadContext(num_partitions_=8)
+    tbl = {"k": rng.integers(0, 5, 64).astype(np.int32)}
+    text = ctx.from_arrays(tbl).distinct("k").explain(analyze=True)
+    assert "-- critical path --" in text
+    assert "total=" in text
+
+
+# -- dispatch_gap clamp (post-final-drain idle tail is not a gap) ------------
+
+
+def _drain_all(win):
+    out = []
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        got = list(win.ready())
+        out.extend(got)
+        if out:
+            return out
+        time.sleep(0.01)
+    raise AssertionError("window never produced an outcome")
+
+
+def test_dispatch_gap_clamps_to_last_commit_between_queries():
+    from dryad_tpu.exec.pipeline import DispatchWindow
+
+    log = EventLog(None, mem_cap=256)
+    win = DispatchWindow(depth=2, events=log, name="clamptest")
+    try:
+        win.submit("a", lambda: 1)
+        assert _drain_all(win)[0][:2] == ("a", 1)
+        # everything submitted was committed: the idle tail below is
+        # caller think time on a shared window, not device starvation
+        time.sleep(0.3)
+        win.submit("b", lambda: 2)
+        assert win.gap_s < 0.2, win.gap_s
+        gaps = [e for e in log.events() if e["kind"] == "dispatch_gap"]
+        assert gaps and gaps[-1]["gap_s"] < 0.2
+        assert _drain_all(win)[0][:2] == ("b", 2)
+    finally:
+        win.close()
+
+
+def test_dispatch_gap_still_counts_genuine_idle_mid_query():
+    from dryad_tpu.exec.pipeline import DispatchWindow
+
+    log = EventLog(None, mem_cap=256)
+    win = DispatchWindow(depth=4, events=log, name="gaptest")
+    try:
+        win.submit("a", lambda: 1)
+        # collector finishes but the driver does NOT consume: work is
+        # outstanding, so the idle window is a real device gap
+        assert win.wait(5.0)
+        time.sleep(0.25)
+        win.submit("b", lambda: 2)
+        assert win.gap_s >= 0.2, win.gap_s
+        got = []
+        while len(got) < 2:
+            got.extend(_drain_all(win))
+            if len(got) < 2:
+                time.sleep(0.01)
+    finally:
+        win.close()
+
+
+# -- fleet aggregation: snapshot buckets merge bucket-for-bucket -------------
+
+
+def test_snapshot_carries_raw_buckets_and_fleet_merge_matches_fold():
+    obs_a = [0.1, 0.3, 0.7, 1.5]
+    obs_b = [0.2, 0.9, 3.0, 6.0, 0.05]
+    sa, sb = RollingStore(window_s=1e9), RollingStore(window_s=1e9)
+    for v in obs_a:
+        sa.observe_latency("query_latency_s", v, tenant="t")
+    for v in obs_b:
+        sb.observe_latency("query_latency_s", v, tenant="t")
+    snap_a, snap_b = sa.snapshot(), sb.snapshot()
+    for snap, obs in ((snap_a, obs_a), (snap_b, obs_b)):
+        (lat,) = snap["latencies"]
+        assert sum(lat["buckets"].values()) == len(obs)
+    fleet = metricsd.merge_snapshots([snap_a, snap_b])
+    (lat,) = fleet["latencies"]
+    assert lat["n"] == len(obs_a) + len(obs_b)
+    # the oracle: bucket every raw observation and fold once
+    hist = {}
+    for v in obs_a + obs_b:
+        e = latency_bucket(v)
+        hist[e] = hist.get(e, 0) + 1
+    expect = quantiles_from_hist(hist)
+    for k in ("p50", "p95", "p99"):
+        assert lat[k] == expect[k], (k, lat[k], expect[k])
+    assert lat["buckets"] == {str(e): n for e, n in sorted(hist.items())}
+    # counters sum across processes
+    sa.incr("queries_completed", tenant="t")
+    sb.incr("queries_completed", tenant="t")
+    fleet = metricsd.merge_snapshots([sa.snapshot(), sb.snapshot()])
+    (ctr,) = [c for c in fleet["counters"]
+              if c["name"] == "queries_completed"]
+    assert ctr["total"] == 2 and fleet["processes"] == 2
+
+
+def test_metricsd_cli_merges_event_logs_and_peer_snapshots(
+    tmp_path, capsys
+):
+    log1 = str(tmp_path / "p1.jsonl")
+    log2 = str(tmp_path / "p2.jsonl")
+    for path, secs in ((log1, 0.3), (log2, 1.1)):
+        with open(path, "w") as fh:
+            fh.write(json.dumps(
+                {"kind": "query_complete", "tenant": "t",
+                 "seconds": secs}) + "\n")
+    peer = RollingStore(window_s=1e9)
+    peer.observe_latency("query_latency_s", 5.0, tenant="t")
+    peer.incr("queries_completed", tenant="t")
+    snap_path = str(tmp_path / "peer.json")
+    with open(snap_path, "w") as fh:
+        json.dump(peer.snapshot(), fh)
+    assert metricsd.main([log1, log2, snap_path, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    (ctr,) = [c for c in doc["counters"]
+              if c["name"] == "queries_completed"]
+    assert ctr["total"] == 3  # two logs + the peer snapshot
+    (lat,) = [l for l in doc["latencies"]
+              if l["name"] == "query_latency_s"]
+    assert lat["n"] == 3
+    hist = {}
+    for v in (0.3, 1.1, 5.0):
+        e = latency_bucket(v)
+        hist[e] = hist.get(e, 0) + 1
+    assert lat["p99"] == quantiles_from_hist(hist)["p99"]
+
+
+# -- metricsd --follow: rotation/truncation recovery (regression) ------------
+
+
+def test_log_cursor_survives_rotation_and_truncation(tmp_path):
+    path = str(tmp_path / "ev.jsonl")
+
+    def write(lines, mode="a"):
+        with open(path, mode) as fh:
+            for n in lines:
+                fh.write(json.dumps({"kind": "note", "n": n}) + "\n")
+
+    cur = metricsd.LogCursor(path)
+    assert cur.poll() == []  # producer not started yet
+    write([1, 2], mode="w")
+    assert [e["n"] for e in cur.poll()] == [1, 2]
+    # rotation: producer renames the log away and starts a fresh file
+    # at the same path (new inode) — a bare byte-offset tail goes
+    # blind here, pointing past the end of the new file
+    os.rename(path, path + ".1")
+    write([3], mode="w")
+    assert [e["n"] for e in cur.poll()] == [3]
+    # in-place truncation (size regression at the same inode)
+    write([4, 5, 6], mode="a")
+    assert [e["n"] for e in cur.poll()] == [4, 5, 6]
+    write([7], mode="w")
+    assert [e["n"] for e in cur.poll()] == [7]
+    assert cur.poll() == []
+
+
+# -- serve: per-tenant SLO phase breakdown -----------------------------------
+
+
+def test_serve_stats_expose_phase_breakdown_summing_to_latency(rng):
+    ctx = DryadContext(
+        num_partitions_=8,
+        config=DryadConfig(serve_result_cache_bytes=0),
+    )
+    tbl = {"k": rng.integers(0, 9, 256).astype(np.int32),
+           "v": rng.random(256).astype(np.float32)}
+    t = ctx.from_arrays(tbl)
+    qs = [t.group_by("k", {"s": ("sum", "v")}), t.distinct("k")]
+    with QueryService(ctx) as svc:
+        s = svc.session("alpha")
+        for q in qs:
+            s.run(q, timeout=120)
+        stats = svc.stats()
+        # the per-query trace buffers were all popped at completion
+        assert svc._trace_buf == {}
+        evs = ctx.events.events()
+    pct = stats["slo"]["alpha"]
+    assert pct["n"] == len(qs)
+    phases = pct["phases"]
+    assert phases and all(v > 0 for v in phases.values())
+    assert set(phases) <= set(critpath.PHASES)
+    # acceptance: each query's attributed breakdown sums to its wall
+    # interval by construction and tracks the measured latency
+    folds = {
+        qid: bd for qid, bd in critpath.fold_all(evs).items()
+        if bd.measured_s is not None
+    }
+    assert len(folds) == len(qs)
+    for bd in folds.values():
+        assert bd.tenant == "alpha"
+        assert sum(bd.phases.values()) == pytest.approx(bd.total_s)
+        assert abs(bd.total_s - bd.measured_s) <= max(
+            0.05 * bd.measured_s, 0.05
+        )
+    # the phase store feeds the same quantile surface as latency
+    assert svc.slo.percentiles(
+        "query_phase_s", tenant="alpha", phase=max(phases, key=phases.get)
+    ) is not None
+
+
+def test_serve_jobview_queries_panel_renders(rng):
+    from dryad_tpu.tools.jobview import render_queries
+
+    ctx = DryadContext(num_partitions_=8)
+    tbl = {"k": rng.integers(0, 5, 64).astype(np.int32)}
+    with QueryService(ctx) as svc:
+        svc.session("beta").run(
+            ctx.from_arrays(tbl).distinct("k"), timeout=120
+        )
+        evs = ctx.events.events()
+    text = render_queries(evs)
+    assert text.startswith("-- queries --")
+    assert "[beta]" in text and "total=" in text
+    assert render_queries([{"kind": "stage_start", "ts": 0.0}]) == ""
