@@ -1364,6 +1364,381 @@ def serve_metric(n: int, per_client: int = 6, cells=(16, 64)):
     )
 
 
+# Closed-loop fleet client: a SEPARATE OS process that speaks the raw
+# mailbox HTTP wire with nothing but the stdlib — no jax, no numpy, no
+# dryad import (the import alone would cost more than the queries it
+# sends, and 64 of them importing jax on one host would bench the
+# loader, not the fleet).  Results are checked via the frame HEADER
+# only: the header pickles separately from the table precisely so a
+# routing-tier consumer never deserializes payload arrays.
+_FLEET_CLIENT = r"""
+import http.client, json, os, pickle, struct, sys, time
+
+host, port = sys.argv[1], int(sys.argv[2])
+payload_path, tenant, tier = sys.argv[3], sys.argv[4], sys.argv[5]
+per_client, idx = int(sys.argv[6]), int(sys.argv[7])
+
+with open(payload_path, "rb") as fh:
+    items = pickle.load(fh)[tenant]  # [(package_bytes, fingerprint)]
+
+conn = http.client.HTTPConnection(host, port, timeout=180)
+nonce = os.urandom(6).hex()
+
+
+def post(name, body):
+    conn.request("POST", "/prop/fleet/" + name, body=body)
+    r = conn.getresponse()
+    r.read()
+    assert r.status == 200, r.status
+
+
+def poll(name, timeout):
+    conn.request(
+        "GET", "/prop/fleet/%s?after=0&timeout=%s" % (name, timeout)
+    )
+    r = conn.getresponse()
+    body = r.read()
+    return body if r.status == 200 else None
+
+
+lat, rejected, cached = [], 0, 0
+t_start = time.perf_counter()
+for j in range(per_client):
+    blob, fp = items[(idx + j) % len(items)]
+    qid = "%s-%s-%d" % (tenant, nonce, j)
+    env = {"qid": qid, "tenant": tenant, "tier": tier, "weight": 1,
+           "package": blob, "fingerprint": fp,
+           "trace": {"qid": qid, "tenant": tenant}}
+    t0 = time.perf_counter()
+    post("rq/" + qid, pickle.dumps(env, protocol=pickle.HIGHEST_PROTOCOL))
+    body = poll("res/" + qid, 120)
+    dt = time.perf_counter() - t0
+    assert body is not None and body[:2] == b"F1", "no result for " + qid
+    hlen = struct.unpack("<II", body[2:10])[0]
+    header = pickle.loads(body[10:10 + hlen])
+    if header.get("rejected") is not None:
+        rejected += 1
+        time.sleep(0.002)  # closed loop: back off on quota
+        continue
+    assert header.get("ok"), header.get("error")
+    cached += 1 if header.get("cached") else 0
+    lat.append(dt)
+print(json.dumps({
+    "tenant": tenant, "tier": tier, "lat": lat, "rejected": rejected,
+    "cached": cached, "elapsed": time.perf_counter() - t_start,
+}))
+"""
+
+
+# Orchestrator for serve_fleet_metric: builds the fleet (front door +
+# N engine-replica PROCESSES), packs the plan set, warms each plan
+# onto its rendezvous owner, then fans out the stdlib client
+# processes.  Runs as a subprocess of the bench for the same backend
+# isolation as the other serve children.  argv: n replicas clients
+# per_client; extra argv[5] is the client script path written by the
+# parent.
+_FLEET_ORCH = r"""
+import json, os, pickle, subprocess, sys, tempfile
+import threading, time
+import numpy as np
+
+from dryad_tpu.parallel.mesh import force_cpu_backend
+
+force_cpu_backend(8)
+
+import jax
+
+try:  # persistent compile cache shared with the replica processes
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get("DRYAD_BENCH_JAX_CACHE", "/tmp/dryad_jax_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+except Exception:
+    pass
+
+from dryad_tpu import DryadContext
+from dryad_tpu.obs.telemetry import quantiles_from_hist
+from dryad_tpu.serve import QueryService
+from dryad_tpu.serve.fleet import ServeFleet, pack_for_fleet
+from dryad_tpu.tools.metricsd import merge_snapshots
+
+n, n_replicas = int(sys.argv[1]), int(sys.argv[2])
+n_clients, per_client = int(sys.argv[3]), int(sys.argv[4])
+client_script = sys.argv[5]
+TENANTS = 4  # tenants 0,1 -> latency tier; 2,3 -> batch tier
+
+_T0 = time.perf_counter()
+
+
+def note(msg):
+    print(f"[fleet t+{time.perf_counter() - _T0:.0f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+def tier_of(t):
+    return "latency" if t < TENANTS // 2 else "batch"
+
+
+rng = np.random.default_rng(11)
+ctx = DryadContext(num_partitions_=8)
+
+plans, packs = {}, {}
+for t in range(TENANTS):
+    words = np.asarray(
+        [f"t{t}w{i:04d}" for i in rng.integers(0, 1024, n)], object
+    )
+    tab = ctx.from_arrays({
+        "k": words,
+        "v": rng.integers(0, 1000, n).astype(np.int64),
+        "w": rng.random(n).astype(np.float32),
+    })
+    plans[t] = [
+        tab.group_by("k", aggs={"s": ("sum", "v")}),
+        tab.group_by("k", aggs={"c": ("count", None),
+                                "m": ("mean", "w")}),
+        tab.distinct("k"),
+        tab.order_by("v").take(64),
+    ]
+    packs[f"tenant{t}"] = [pack_for_fleet(q) for q in plans[t]]
+note(f"packed {sum(len(v) for v in packs.values())} plans")
+
+td = tempfile.mkdtemp(prefix="dryad-fleet-bench-")
+bootstrap = os.path.join(td, "bootstrap.py")
+with open(bootstrap, "w") as fh:
+    fh.write(
+        "import os\n"
+        "from dryad_tpu.parallel.mesh import force_cpu_backend\n"
+        "force_cpu_backend(8)\n"
+        "import jax\n"
+        "try:\n"
+        "    jax.config.update('jax_compilation_cache_dir',\n"
+        "        os.environ.get('DRYAD_BENCH_JAX_CACHE',\n"
+        "                       '/tmp/dryad_jax_cache'))\n"
+        "    jax.config.update(\n"
+        "        'jax_persistent_cache_min_entry_size_bytes', -1)\n"
+        "    jax.config.update(\n"
+        "        'jax_persistent_cache_min_compile_time_secs', 0.0)\n"
+        "except Exception:\n"
+        "    pass\n"
+        "from dryad_tpu import DryadContext\n"
+        "def build_context():\n"
+        "    return DryadContext(num_partitions_=8)\n"
+    )
+payload = os.path.join(td, "payload.pkl")
+with open(payload, "wb") as fh:
+    pickle.dump(packs, fh, protocol=pickle.HIGHEST_PROTOCOL)
+
+fleet = ServeFleet(hb_interval=0.5, stale_after=600.0)
+# a crashed orchestrator must still reap its replica processes — they
+# inherit our captured stdout/stderr pipes, and a survivor polling a
+# dead port keeps the parent's communicate() from ever seeing EOF
+import atexit
+atexit.register(fleet.close)
+spawn_errs = []
+
+
+def _spawn(rid):
+    try:
+        fleet.spawn_process(rid, bootstrap, timeout=600.0)
+    except BaseException as e:
+        spawn_errs.append(repr(e))
+
+
+ths = [
+    threading.Thread(target=_spawn, args=(f"r{i}",))
+    for i in range(n_replicas)
+]
+t_boot = time.perf_counter()
+for th in ths:
+    th.start()
+for th in ths:
+    th.join()
+if spawn_errs:
+    raise RuntimeError(spawn_errs[0])
+boot_s = time.perf_counter() - t_boot
+note(f"{n_replicas} replica processes up in {boot_s:.0f}s")
+
+# warm every plan onto its rendezvous owner: prepared-statement load,
+# compile, and the first (cache-filling) execution
+t_warm = time.perf_counter()
+for t in range(TENANTS):
+    tenant = f"tenant{t}"
+    for blob, fp in packs[tenant]:
+        qid = fleet.submit(tenant=tenant, package=blob, fingerprint=fp,
+                           tier=tier_of(t))
+        fleet.result(qid, timeout=600)
+    note(f"warmed {tenant}")
+warm_s = time.perf_counter() - t_warm
+
+# timed fleet cell: closed-loop stdlib client PROCESSES
+procs = []
+t_run = time.perf_counter()
+for i in range(n_clients):
+    t = i % TENANTS
+    procs.append(subprocess.Popen(
+        [sys.executable, client_script, fleet.host, str(fleet.port),
+         payload, f"tenant{t}", tier_of(t), str(per_client),
+         str(i // TENANTS)],
+        stdout=subprocess.PIPE, text=True,
+    ))
+reports = []
+for p in procs:
+    out, _ = p.communicate(timeout=900)
+    assert p.returncode == 0, f"client rc={p.returncode}"
+    reports.append(json.loads(out.strip().splitlines()[-1]))
+elapsed = time.perf_counter() - t_run
+note(f"{n_clients} clients done in {elapsed:.1f}s")
+
+
+def pct(xs, q):
+    if not xs:
+        return None
+    xs = sorted(xs)
+    return round(1e3 * xs[min(len(xs) - 1, int(len(xs) * q))], 3)
+
+
+by_tier = {"latency": [], "batch": []}
+for r in reports:
+    by_tier[r["tier"]].extend(r["lat"])
+completed = sum(len(r["lat"]) for r in reports)
+cached = sum(r["cached"] for r in reports)
+rejected = sum(r["rejected"] for r in reports)
+
+time.sleep(2 * 0.5)  # let each replica post one more stats beat
+stats = fleet.stats()
+per_replica_hits = {}
+for rid, s in stats["replicas"].items():
+    if not s:
+        continue
+    c = s.get("cache", {})
+    looked = c.get("hits", 0) + c.get("misses", 0)
+    per_replica_hits[rid] = (
+        round(c.get("hits", 0) / looked, 4) if looked else None
+    )
+rates = [v for v in per_replica_hits.values() if v is not None]
+# fleet-wide latency fold: merge the per-tenant pow2 histograms the
+# replicas posted, then re-derive quantiles (the only commutative fold)
+merged = merge_snapshots(fleet.replica_snapshots())
+hist = {}
+for rec in merged.get("latencies", []):
+    if rec["name"] != "query_latency_s":
+        continue
+    for e, cnt in (rec.get("buckets") or {}).items():
+        hist[int(e)] = hist.get(int(e), 0) + int(cnt)
+fleet_lat = quantiles_from_hist(hist) or {}
+router = stats["router"]
+fleet.close()
+
+# single-process ceiling: the SAME plans closed-loop on one in-process
+# QueryService (no wire, no pickle, no fan-out) — the front door this
+# fleet exists to out-scale
+svc = QueryService(ctx)
+single_done = [0]
+lock = threading.Lock()
+
+
+def single_client(i):
+    t = i % TENANTS
+    sess = svc.session(f"s{i}", tier=tier_of(t))
+    for j in range(per_client):
+        sess.run(plans[t][(i + j) % len(plans[t])], timeout=600)
+        with lock:
+            single_done[0] += 1
+
+
+sths = [threading.Thread(target=single_client, args=(i,))
+        for i in range(min(n_clients, 16))]
+t_single = time.perf_counter()
+for th in sths:
+    th.start()
+for th in sths:
+    th.join()
+single_s = time.perf_counter() - t_single
+single_qps = round(single_done[0] / single_s, 1)
+svc.close()
+note(f"single-process ceiling cell done in {single_s:.1f}s")
+
+print(json.dumps({
+    "n": n, "replicas": n_replicas, "clients": n_clients,
+    "queries": completed, "seconds": round(elapsed, 3),
+    "queries_per_sec": round(completed / elapsed, 1),
+    "boot_s": round(boot_s, 2), "warm_s": round(warm_s, 2),
+    "rejected": rejected,
+    "client_cache_hit_rate": round(cached / max(completed, 1), 4),
+    "latency_p50_ms": pct(by_tier["latency"], 0.50),
+    "latency_p95_ms": pct(by_tier["latency"], 0.95),
+    "latency_p99_ms": pct(by_tier["latency"], 0.99),
+    "batch_p50_ms": pct(by_tier["batch"], 0.50),
+    "batch_p95_ms": pct(by_tier["batch"], 0.95),
+    "batch_p99_ms": pct(by_tier["batch"], 0.99),
+    "per_replica_cache_hit": per_replica_hits,
+    "cache_hit_spread_points": (
+        round(100 * (max(rates) - min(rates)), 2) if rates else None
+    ),
+    "fleet_fold_p95_ms": (
+        round(1e3 * fleet_lat["p95"], 3) if "p95" in fleet_lat else None
+    ),
+    "routed": router["routed"], "delivered": router["delivered"],
+    "fast_rejects": router["fast_rejects"],
+    "replayed": router["replayed"], "failed": router["failed"],
+    "single_process_queries_per_sec": single_qps,
+    "fleet_vs_single": round(
+        (completed / elapsed) / max(single_qps, 1e-9), 3
+    ),
+}))
+"""
+
+
+def serve_fleet_metric(
+    n: int = 1 << 13, replicas: int = 4, clients: int = 64,
+    per_client: int = 6,
+):
+    """Fleet serving plane (serve/fleet.py): a multi-process front
+    door, ``replicas`` engine-replica PROCESSES (each its own
+    DryadContext on 8 virtual CPU devices), and ``clients`` closed-loop
+    client PROCESSES that speak the raw envelope wire with only the
+    stdlib.  Tenants split across priority tiers (latency/batch);
+    repeat plans route fingerprint-affine, so the steady state serves
+    from each owner replica's result cache.  Reports fleet q/s,
+    per-tier p50/p95/p99, per-replica cache-hit spread, and the
+    single-process in-process ceiling for comparison."""
+    import subprocess
+    import tempfile
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    with tempfile.NamedTemporaryFile(
+        "w", suffix="_fleet_client.py", delete=False
+    ) as fh:
+        fh.write(_FLEET_CLIENT)
+        client_script = fh.name
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", _FLEET_ORCH,
+             str(n), str(replicas), str(clients), str(per_client),
+             client_script],
+            capture_output=True, text=True,
+            timeout=max(remaining(), 180),
+            env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    finally:
+        os.unlink(client_script)
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"fleet child rc={out.returncode}: {out.stderr[-2000:]}"
+        )
+    res = json.loads(out.stdout.strip().splitlines()[-1])
+    extra = {
+        k: v for k, v in res.items()
+        if k not in ("queries", "seconds", "n")
+    }
+    return rep_record(
+        "serve_fleet_rows_per_sec", res["queries"] * res["n"],
+        [res["seconds"]], extra,
+    )
+
+
 # Child body for ooc_exchange_metric: the staged exchange only does
 # anything on a multi-device mesh (P=1 short-circuits to the flat
 # path), so the window sweep runs on 8 virtual CPU devices in a fresh
@@ -2088,6 +2463,12 @@ def child_main() -> None:
         ("serve_rows_per_sec",
          lambda: serve_metric(1 << 13),
          300, False),
+        # fleet serving plane: multi-process front door + 4 engine
+        # replica processes + 64 stdlib client processes,
+        # fingerprint-affine routing (vs the single-process ceiling)
+        ("serve_fleet_rows_per_sec",
+         lambda: serve_fleet_metric(1 << 13),
+         420, False),
     ]
     if platform in ("tpu", "axon"):
         # The Pallas kernel only truly runs on TPU; elsewhere the number
@@ -2099,7 +2480,12 @@ def child_main() -> None:
             45, False,
         ))
 
+    only = None
+    if os.environ.get("DRYAD_BENCH_ONLY"):
+        only = json.loads(os.environ["DRYAD_BENCH_ONLY"])
     for name, fn, est, is_core in plan:
+        if only is not None and not any(w in name for w in only):
+            continue
         if name in done:
             continue
         if remaining() < est:
@@ -2364,6 +2750,12 @@ def main() -> None:
         if not os.environ.get("DRYAD_BENCH_CHILD"):
             obs_overhead_gate()
             sys.exit(0)
+    # positional args select metrics by substring (`bench.py
+    # serve_fleet` runs only serve_fleet_rows_per_sec); the filter
+    # rides an env var so supervise()'s children inherit it
+    wanted = [a for a in sys.argv[1:] if not a.startswith("-")]
+    if wanted:
+        os.environ["DRYAD_BENCH_ONLY"] = json.dumps(wanted)
     if os.environ.get("DRYAD_BENCH_CHILD"):
         child_main()
     else:

@@ -9,9 +9,12 @@ dependency arrows only point down —
   never a dependency of it);
 - ``serve/`` reaches devices only through the ``api``/``exec`` public
   entry points: its dryad imports stay inside ``api``/``exec``/
-  ``obs``/``utils``/``serve``, and it never imports ``jax`` directly
-  (direct device access would bypass the driver-thread ownership the
-  whole tier is built around).
+  ``obs``/``utils``/``cluster``/``serve``, and it never imports
+  ``jax`` directly (direct device access would bypass the
+  driver-thread ownership the whole tier is built around).
+  ``cluster`` is allowed for the TRANSPORT only — the fleet front
+  door rides the ProcessService mailbox — and stays legal because
+  ``cluster/`` itself never imports ``serve/`` (direction 1).
 
 Anchor: ``serve/service.py`` must define :class:`QueryService` — if
 the class moves, the scan reports the lost anchor instead of silently
@@ -41,12 +44,14 @@ _ENGINE_PREFIXES: Tuple[str, ...] = (
     "dryad_tpu/cluster/",
 )
 
-# dryad_tpu.* module prefixes serve/ files may import
+# dryad_tpu.* module prefixes serve/ files may import (cluster: the
+# fleet transport — mailbox/HTTP envelopes — not engine internals)
 _SERVE_ALLOWED: Tuple[str, ...] = (
     "dryad_tpu.api",
     "dryad_tpu.exec",
     "dryad_tpu.obs",
     "dryad_tpu.utils",
+    "dryad_tpu.cluster",
     "dryad_tpu.serve",
 )
 
@@ -106,7 +111,7 @@ class ServeLayeringChecker(Checker):
                         src.rel,
                         ln,
                         f"serve/ imports {mod} — outside the allowed "
-                        "layers (api/exec/obs/utils/serve)",
+                        "layers (api/exec/obs/utils/cluster/serve)",
                     )
         # anchor: the scan is about QueryService's device discipline
         src = project.file(SERVICE_PATH)

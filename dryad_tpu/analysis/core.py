@@ -226,6 +226,7 @@ def _load_builtin() -> None:
         checks_operands,
         checks_recompile,
         checks_rewrite,
+        checks_routing,
         checks_serve,
         checks_trace,
     )

@@ -44,13 +44,48 @@ class Mailbox:
         self._lock = threading.Condition()
         # (pid, name) -> (version, value)
         self._props: Dict[Tuple[str, str], Tuple[int, bytes]] = {}
+        self._closed = False
+        # in-process observers: fn(pid, name, version, value), called
+        # after every set_prop OUTSIDE the mailbox lock (a watch that
+        # re-enters the mailbox must not deadlock).  Wake signal only —
+        # two racing sets may deliver out of order; observers that care
+        # must re-read and compare versions.
+        self._watches: List = []
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def close(self) -> None:
+        """Mark closed and wake every blocked long-poll immediately.
+        Subsequent ``get_prop`` calls return without waiting."""
+        with self._lock:
+            self._closed = True
+            self._lock.notify_all()
+
+    def add_watch(self, fn) -> None:
+        with self._lock:
+            self._watches.append(fn)
+
+    def remove_watch(self, fn) -> None:
+        with self._lock:
+            try:
+                self._watches.remove(fn)
+            except ValueError:
+                pass
 
     def set_prop(self, pid: str, name: str, value: bytes) -> int:
         with self._lock:
             ver = self._props.get((pid, name), (0, b""))[0] + 1
             self._props[(pid, name)] = (ver, value)
             self._lock.notify_all()
-            return ver
+            watches = tuple(self._watches)
+        for fn in watches:
+            try:
+                fn(pid, name, ver, value)
+            except Exception:  # noqa: BLE001 — a watch must not poison sets
+                log.exception("mailbox watch failed for %s/%s", pid, name)
+        return ver
 
     def get_prop(
         self,
@@ -60,7 +95,8 @@ class Mailbox:
         timeout: float = 0.0,
     ) -> Optional[Tuple[int, bytes]]:
         """Return (version, value) once version > after_version, else
-        None after ``timeout`` (0 = non-blocking)."""
+        None after ``timeout`` (0 = non-blocking) or as soon as the
+        mailbox closes (shutdown must not wait out long-polls)."""
         deadline = time.monotonic() + timeout
         with self._lock:
             while True:
@@ -68,9 +104,14 @@ class Mailbox:
                 if cur is not None and cur[0] > after_version:
                     return cur
                 left = deadline - time.monotonic()
-                if left <= 0:
+                if left <= 0 or self._closed:
                     return None
                 self._lock.wait(left)
+
+    def del_prop(self, pid: str, name: str) -> None:
+        """Drop a property outright (result GC after delivery)."""
+        with self._lock:
+            self._props.pop((pid, name), None)
 
     def processes(self) -> List[str]:
         with self._lock:
@@ -347,6 +388,12 @@ class ProcessService:
         self.cache.invalidate(rel)
 
     def close(self) -> None:
+        # Close the mailbox FIRST: ThreadingHTTPServer.shutdown() joins
+        # its handler threads, and any handler parked in a get_prop
+        # long-poll would otherwise hold shutdown hostage for the full
+        # poll timeout (regression: close took 30s with one 30s
+        # long-poll outstanding).
+        self.mailbox.close()
         self._httpd.shutdown()
         self._httpd.server_close()
         self._thread.join(timeout=5)

@@ -161,6 +161,13 @@ EVENT_KINDS: Dict[str, str] = {
     "query_complete": "tenant query resolved; tenant/query/seconds/ok",
     "result_cache_hit": "repeat query served from the result cache",
     "tenant_quota": "tenant quota state transition; saturated or ok",
+    # -- serving fleet (serve.fleet router / supervisor) ------------------
+    "replica_started": "engine replica joined the fleet; replica/mode",
+    "replica_dead": "heartbeat went stale; replica reaped, gen bumped",
+    "fleet_submit": "front door admitted + routed a query to a replica",
+    "fleet_result": "front door delivered a replica's result; seconds",
+    "fleet_reroute": "in-flight query replayed to the failover replica",
+    "fleet_rejected": "front-door fast reject (negative quota memo)",
 }
 
 # ``kind`` -> (required payload keys, optional payload keys).  The
@@ -349,6 +356,22 @@ EVENT_PAYLOADS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
     "result_cache_hit": (("query", "tenant"), ("rows",)),
     "tenant_quota": (
         ("inflight", "limit", "state", "tenant"), ("bytes",),
+    ),
+    "replica_started": (("mode", "replica"), ("pid",)),
+    "replica_dead": (
+        ("generation", "replica"), ("inflight", "stale_s"),
+    ),
+    "fleet_submit": (
+        ("query", "replica", "tenant", "tier"), ("fingerprint",),
+    ),
+    "fleet_result": (
+        ("ok", "query", "seconds", "tenant"), ("cached", "replica"),
+    ),
+    "fleet_reroute": (
+        ("from_replica", "query", "tenant", "to_replica"), (),
+    ),
+    "fleet_rejected": (
+        ("reason", "tenant"), ("current", "limit", "query"),
     ),
 }
 

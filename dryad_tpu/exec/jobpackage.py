@@ -22,7 +22,7 @@ from __future__ import annotations
 import pickle
 from typing import Any, Dict, Optional
 
-from dryad_tpu.plan.nodes import walk
+from dryad_tpu.plan.nodes import fresh_id, walk
 
 try:
     import cloudpickle as _pickler
@@ -96,7 +96,22 @@ def load_query(path: str, ctx=None, mesh=None):
     if ctx is None:
         ctx = DryadContext(config=blob["config"], mesh=mesh)
     ctx.dictionary._map.update(blob["dictionary"])
-    ctx._bindings.update(blob["bindings"])
+    # Re-key the loaded DAG onto THIS process's node-id counter.  Node
+    # ids are process-local (plan.nodes._ids), and everything —
+    # walk/consumers dedup, lowering cursors, binding lookups — keys on
+    # them; a loaded DAG carrying the packer's ids collides with any
+    # node built locally (e.g. the topk node _rewrite_topk creates at
+    # lower time gets a fresh LOCAL id, which in a young process starts
+    # at 0 — exactly where the packer's ids also started), and with a
+    # second package from a different packer.  A collision is silent:
+    # walk drops one of the twins and the plan lowers wrong or not at
+    # all.
+    remap: Dict[int, int] = {}
+    for n in walk([blob["node"]]):
+        remap[n.id] = n.id = fresh_id()
+    ctx._bindings.update(
+        {remap[i]: b for i, b in blob["bindings"].items() if i in remap}
+    )
     return Query(ctx, blob["node"])
 
 

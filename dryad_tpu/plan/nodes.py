@@ -20,6 +20,15 @@ from dryad_tpu.columnar.schema import Schema
 _ids = itertools.count()
 
 
+def fresh_id() -> int:
+    """Next node id from THIS process's counter.  Ids are process-local:
+    a DAG deserialized from another process (job packages) must be
+    re-keyed through this before it can coexist with locally built
+    nodes — ``walk``/``consumers``/lowering all dedup by id, so a
+    collision silently drops a node (see ``jobpackage.load_query``)."""
+    return next(_ids)
+
+
 @dataclasses.dataclass(frozen=True)
 class PartitionInfo:
     """How the dataset is partitioned across the mesh (DataSetInfo analog).

@@ -12,20 +12,53 @@ queries from a plan-fingerprint result cache.  Client threads only
 build plans, submit, and block on :class:`QueryFuture` — they never
 touch devices.
 
+The FLEET layer (:mod:`~dryad_tpu.serve.fleet`) scales the same
+service past one process: a multi-process front door on the cluster
+mailbox/HTTP plane, N engine replicas (each a QueryService wrapping
+its own context), and a plan-fingerprint-affine rendezvous router
+(:mod:`~dryad_tpu.serve.router`) that keeps repeat plans landing on
+the replica already holding their compiled programs and caches.
+
 Layering: ``serve/`` reaches devices exclusively through the ``api``
-and ``exec`` public entry points; engine layers never import
-``serve/`` (enforced by graftlint's ``serve-layering`` rule).
+and ``exec`` public entry points (``cluster`` is allowed for the fleet
+transport only); engine layers never import ``serve/`` (enforced by
+graftlint's ``serve-layering`` rule).
 """
 
-from dryad_tpu.serve.admission import QueryRejected, TenantQuota
+from dryad_tpu.serve.admission import (
+    DEFAULT_TIER,
+    TIERS,
+    QueryRejected,
+    TenantQuota,
+)
 from dryad_tpu.serve.cache import ResultCache
+from dryad_tpu.serve.fleet import FleetClient, ReplicaRunner, ServeFleet
+from dryad_tpu.serve.router import (
+    NegativeQuotaMemo,
+    ReplicaSet,
+    canonical_fingerprint,
+    package_fingerprint,
+    rendezvous_rank,
+    route,
+)
 from dryad_tpu.serve.service import QueryFuture, QueryService, TenantSession
 
 __all__ = [
+    "DEFAULT_TIER",
+    "FleetClient",
+    "NegativeQuotaMemo",
     "QueryFuture",
     "QueryRejected",
     "QueryService",
+    "ReplicaRunner",
+    "ReplicaSet",
     "ResultCache",
+    "ServeFleet",
+    "TIERS",
     "TenantSession",
     "TenantQuota",
+    "canonical_fingerprint",
+    "package_fingerprint",
+    "rendezvous_rank",
+    "route",
 ]

@@ -7,11 +7,38 @@ acceptance invariant of the serving tier).  Budgets are per tenant —
 in-flight query count and admitted host-input bytes — so one tenant
 saturating its own quota leaves every other tenant's admission
 untouched.
+
+Priority tiers layer ON TOP of the DRR weights: every tenant belongs
+to one of :data:`TIERS` ("latency" before "batch"), the scheduler
+serves any runnable latency-tier tenant before touching the batch
+tier, and weights keep their meaning WITHIN a tier.  The fleet router
+enforces the same ordering at the front door, so a batch backlog can
+neither starve latency tenants at a replica nor queue ahead of them
+in the fleet dispatch queues.
 """
 
 from __future__ import annotations
 
 import dataclasses
+
+# Scheduling order: every runnable tenant of TIERS[i] is served before
+# any tenant of TIERS[i+1].  DRR weights apply within a tier only.
+TIERS = ("latency", "batch")
+DEFAULT_TIER = "latency"
+
+
+def check_tier(tier: str) -> str:
+    """Validate a tier name (returns it, for assignment chaining)."""
+    if tier not in TIERS:
+        raise ValueError(
+            f"unknown priority tier {tier!r}; expected one of {TIERS}"
+        )
+    return tier
+
+
+def tier_rank(tier: str) -> int:
+    """Position of *tier* in the strict-priority order (0 = first)."""
+    return TIERS.index(check_tier(tier))
 
 
 class QueryRejected(RuntimeError):

@@ -33,6 +33,7 @@ light one, and a returning tenant cannot burst on banked idle time.
 
 from __future__ import annotations
 
+import hashlib
 import threading
 import time
 from collections import deque
@@ -42,8 +43,15 @@ from dryad_tpu.exec.pipeline import DispatchWindow
 from dryad_tpu.obs import critpath, flightrec, tracectx
 from dryad_tpu.obs.span import Tracer
 from dryad_tpu.obs.telemetry import RollingStore
-from dryad_tpu.serve.admission import QueryRejected, TenantQuota
+from dryad_tpu.serve.admission import (
+    DEFAULT_TIER,
+    TIERS,
+    QueryRejected,
+    TenantQuota,
+    check_tier,
+)
 from dryad_tpu.serve.cache import ResultCache
+from dryad_tpu.serve.router import canonical_fingerprint
 from dryad_tpu.utils.logging import get_logger
 
 log = get_logger("dryad_tpu.serve")
@@ -89,7 +97,7 @@ class _Queued:
     )
 
     def __init__(self, state, qid, query, future, cost_bytes, cost_units,
-                 epoch, t_submit):
+                 epoch, t_submit, tctx=None):
         self.state = state
         self.qid = qid
         self.query = query
@@ -98,19 +106,23 @@ class _Queued:
         self.cost_units = cost_units
         self.epoch = epoch  # tenant ingest epoch at ADMISSION
         self.t_submit = t_submit
-        # trace identity, minted at admission: every span/event the
-        # query causes — on any thread or gang worker — carries qid
-        self.tctx = tracectx.mint(tenant=state.name, qid=qid)
+        # trace identity, minted at admission — or ADOPTED when the
+        # query crossed a process boundary (fleet router mints the qid
+        # at the front door) so every span/event on this side still
+        # carries the end-to-end qid and the critical path sums to e2e
+        self.tctx = tctx or tracectx.mint(tenant=state.name, qid=qid)
 
 
 class _TenantState:
     """Service-internal per-tenant record (queues, quota, counters).
     All mutation under the service lock."""
 
-    def __init__(self, name: str, weight: int, quota: TenantQuota):
+    def __init__(self, name: str, weight: int, quota: TenantQuota,
+                 tier: str = DEFAULT_TIER):
         self.name = name
         self.weight = weight
         self.quota = quota
+        self.tier = check_tier(tier)
         self.queue: "deque[_Queued]" = deque()
         self.deficit = 0
         self.visited = False  # earned this visit's refill already
@@ -142,10 +154,16 @@ class TenantSession:
     def epoch(self) -> int:
         return self._state.epoch
 
-    def submit(self, query) -> QueryFuture:
+    def submit(self, query, qid: Optional[str] = None,
+               tctx=None) -> QueryFuture:
         """Admit ``query`` (raises :class:`QueryRejected` past quota)
-        and return its future.  Never blocks on device work."""
-        return self._service._submit(self._state, query)
+        and return its future.  Never blocks on device work.
+
+        ``qid``/``tctx`` adopt an externally minted query identity —
+        the fleet replica path, where the front door minted the qid and
+        the wire TraceContext must keep flowing through this engine's
+        spans and events."""
+        return self._service._submit(self._state, query, qid=qid, tctx=tctx)
 
     def run(self, query, timeout: Optional[float] = None) -> Dict:
         """Submit and block for the result."""
@@ -215,7 +233,9 @@ class QueryService:
         # section.  NEVER held while blocked on the window.
         self._ctx_lock = threading.RLock()
         self._tenants: Dict[str, _TenantState] = {}
-        self._rr = 0  # deficit-round-robin ring pointer
+        # per-tier deficit-round-robin ring pointers (strict priority
+        # across tiers, DRR within)
+        self._rr: Dict[str, int] = {}
         self._queued = 0  # total across tenant queues
         self._inflight_items: Dict[str, Tuple[_Queued, Any]] = {}
         self._closed = False
@@ -276,11 +296,16 @@ class QueryService:
     # -- tenants -----------------------------------------------------------
 
     def session(self, tenant: str, weight: int = 1,
-                quota: Optional[TenantQuota] = None) -> TenantSession:
+                quota: Optional[TenantQuota] = None,
+                tier: Optional[str] = None) -> TenantSession:
         """Open (or re-open) a tenant session.  ``weight`` is the DRR
-        share; ``quota`` defaults to the config budgets."""
+        share WITHIN the tenant's priority ``tier`` ("latency" tenants
+        are always served before "batch" tenants with runnable work);
+        ``quota`` defaults to the config budgets."""
         if weight < 1:
             raise ValueError("tenant weight must be >= 1")
+        if tier is not None:
+            check_tier(tier)
         with self._lock:
             st = self._tenants.get(tenant)
             if st is None:
@@ -290,17 +315,21 @@ class QueryService:
                         max_inflight=self.config.serve_max_inflight,
                         max_bytes=self.config.serve_max_bytes,
                     ),
+                    tier=tier or DEFAULT_TIER,
                 )
                 self._tenants[tenant] = st
             else:
                 st.weight = weight
                 if quota is not None:
                     st.quota = quota
+                if tier is not None:
+                    st.tier = tier
         return TenantSession(self, st)
 
     # -- admission (client threads) ----------------------------------------
 
-    def _submit(self, st: _TenantState, query) -> QueryFuture:
+    def _submit(self, st: _TenantState, query, qid: Optional[str] = None,
+                tctx=None) -> QueryFuture:
         with self._ctx_lock:
             cost = self.ctx.query_input_bytes(query)
         rejection = None
@@ -320,12 +349,13 @@ class QueryService:
                     st.rejected += 1
                     rej_id = f"{st.name}:rej{st.rejected}"
             if rejection is None:
-                qid = f"{st.name}:{st.seq}"
+                if qid is None:
+                    qid = f"{st.name}:{st.seq}"
                 st.seq += 1
                 item = _Queued(
                     st, qid, query, QueryFuture(st.name, qid), cost,
                     1 + cost // self.config.serve_drr_quantum_bytes,
-                    st.epoch, time.monotonic(),
+                    st.epoch, time.monotonic(), tctx=tctx,
                 )
                 st.inflight += 1
                 st.inflight_bytes += cost
@@ -372,38 +402,47 @@ class QueryService:
     # -- fair-share scheduling (driver thread) -----------------------------
 
     def _pick_locked(self) -> Optional[_Queued]:
-        """Weighted deficit round robin over the tenant ring.  None
-        when nothing is runnable (all queues empty, or the window is
-        at depth — dispatching more would block the driver)."""
+        """Strict priority across tiers, weighted deficit round robin
+        within each tier.  A runnable latency-tier tenant always goes
+        before any batch-tier tenant; weights keep their DRR meaning
+        among same-tier peers.  None when nothing is runnable (all
+        queues empty, or the window is at depth — dispatching more
+        would block the driver)."""
         if len(self._inflight_items) >= self._window.depth:
             return None
-        ring = list(self._tenants.values())
-        if not ring or not any(st.queue for st in ring):
-            return None
-        while True:
-            st = ring[self._rr % len(ring)]
-            if not st.queue:
-                # idle tenants forfeit credit: no bursting on banked
-                # idle time when they return
-                st.deficit = 0
-                st.visited = False
-                self._rr += 1
+        for tier in TIERS:
+            ring = [
+                st for st in self._tenants.values() if st.tier == tier
+            ]
+            if not ring or not any(st.queue for st in ring):
                 continue
-            if not st.visited:
-                st.deficit += st.weight
-                st.visited = True
-            head = st.queue[0]
-            if st.deficit >= head.cost_units:
-                st.deficit -= head.cost_units
-                st.queue.popleft()
-                self._queued -= 1
+            rr = self._rr.get(tier, 0)
+            while True:
+                st = ring[rr % len(ring)]
                 if not st.queue:
+                    # idle tenants forfeit credit: no bursting on
+                    # banked idle time when they return
+                    st.deficit = 0
                     st.visited = False
-                return head
-            # deficit exhausted: next tenant (credit carries over, so
-            # an expensive head eventually accumulates its cost)
-            st.visited = False
-            self._rr += 1
+                    rr += 1
+                    continue
+                if not st.visited:
+                    st.deficit += st.weight
+                    st.visited = True
+                head = st.queue[0]
+                if st.deficit >= head.cost_units:
+                    st.deficit -= head.cost_units
+                    st.queue.popleft()
+                    self._queued -= 1
+                    if not st.queue:
+                        st.visited = False
+                    self._rr[tier] = rr
+                    return head
+                # deficit exhausted: next tenant (credit carries over,
+                # so an expensive head eventually accumulates its cost)
+                st.visited = False
+                rr += 1
+        return None
 
     # -- driver loop -------------------------------------------------------
 
@@ -460,9 +499,17 @@ class QueryService:
                         fp = self.ctx.query_fingerprint(item.query)
                         table = None
                         if fp is not None:
-                            item.tctx.fingerprint = (
-                                f"{hash(fp) & (1 << 64) - 1:016x}"
-                            )
+                            # sha-based trace label, never builtin
+                            # hash(): stable across processes so fleet
+                            # traces correlate (graftlint routing-hash)
+                            cfp = canonical_fingerprint(fp)
+                            if cfp is None:
+                                # reference-keyed plan: label is
+                                # process-local by construction
+                                cfp = hashlib.sha256(
+                                    repr(fp).encode()
+                                ).hexdigest()
+                            item.tctx.fingerprint = cfp[:16]
                             key = (st.name, fp)
                             table = self._cache.get(key, item.epoch)
                     if table is not None:
@@ -627,6 +674,7 @@ class QueryService:
                     "queued": len(st.queue),
                     "epoch": st.epoch,
                     "saturated": st.saturated,
+                    "tier": st.tier,
                 }
                 for st in self._tenants.values()
             }

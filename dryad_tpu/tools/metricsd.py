@@ -38,10 +38,17 @@ resident: it tails each log from its last byte offset every
 :class:`LogCursor`) — re-reads snapshot inputs wholesale, and
 rewrites the sinks: the "periodic file sink" deployment, one step
 short of an HTTP endpoint.
+
+Inputs may be shell-style GLOBS (quote them past your shell):
+``'fleet/*.jsonl'`` scrapes every replica's event log with its own
+:class:`LogCursor`, and in ``--follow`` mode the pattern re-expands
+every interval — a replica that starts (or respawns after a chaos
+kill) AFTER metricsd is picked up on the next tick, no restart.
 """
 
 from __future__ import annotations
 
+import glob as globlib
 import json
 import os
 import sys
@@ -56,8 +63,8 @@ from dryad_tpu.obs.telemetry import (
 )
 
 __all__ = [
-    "LogCursor", "fold_events", "fold_query_phases", "load_events",
-    "merge_snapshots", "main",
+    "CursorSet", "LogCursor", "expand_inputs", "fold_events",
+    "fold_query_phases", "load_events", "merge_snapshots", "main",
 ]
 
 # one-shot folds have no live clock: make the window wide enough that
@@ -121,6 +128,51 @@ class LogCursor:
             self.offset = 0
         self._ino = st.st_ino
         events, self.offset = load_events(self.path, self.offset)
+        return events
+
+
+def expand_inputs(patterns: List[str]) -> List[str]:
+    """Expand shell-style globs in *patterns* (sorted, deduped; a
+    literal path passes through even when it doesn't exist yet, so a
+    one-shot scrape of a missing file still errors loudly)."""
+    out: List[str] = []
+    seen = set()
+    for pat in patterns:
+        matched = (
+            sorted(globlib.glob(pat))
+            if globlib.has_magic(pat)
+            else [pat]
+        )
+        for p in matched:
+            if p not in seen:
+                seen.add(p)
+                out.append(p)
+    return out
+
+
+class CursorSet:
+    """Per-path :class:`LogCursor` pool over glob patterns.
+
+    ``poll()`` re-expands every pattern and tails each matched file
+    from ITS OWN byte offset — so ``'fleet/*.jsonl'`` keeps working as
+    replicas come and go: a log that appears after the first poll gets
+    a fresh cursor (read from byte 0), an existing one never re-reads
+    what it already folded."""
+
+    def __init__(self, patterns: List[str]):
+        self.patterns = list(patterns)
+        self._cursors: Dict[str, LogCursor] = {}
+
+    def paths(self) -> List[str]:
+        return sorted(self._cursors)
+
+    def poll(self) -> List[Dict[str, Any]]:
+        events: List[Dict[str, Any]] = []
+        for path in expand_inputs(self.patterns):
+            cur = self._cursors.get(path)
+            if cur is None:
+                cur = self._cursors[path] = LogCursor(path)
+            events.extend(cur.poll())
         return events
 
 
@@ -322,35 +374,36 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
         return 2
     # .json inputs are peer snapshots (another process's --json-out);
-    # everything else is an event log to fold locally
-    snap_paths = [p for p in args if p.endswith(".json")]
-    log_paths = [p for p in args if not p.endswith(".json")]
+    # everything else is an event log to fold locally.  Either kind
+    # may be a glob; follow mode re-expands each tick.
+    snap_patterns = [p for p in args if p.endswith(".json")]
+    log_patterns = [p for p in args if not p.endswith(".json")]
     if not follow:
-        missing = [p for p in args if not os.path.exists(p)]
+        inputs = expand_inputs(args)
+        missing = [p for p in inputs if not os.path.exists(p)]
         if missing:
             print(f"no input at {missing[0]}", file=sys.stderr)
             return 1
         store = RollingStore(window_s=window or ONESHOT_WINDOW_S)
         all_events: List[Dict[str, Any]] = []
-        for p in log_paths:
+        for p in expand_inputs(log_patterns):
             events, _ = load_events(p)
             all_events.extend(events)
         fold_events(all_events, store)
         fold_query_phases(all_events, store)
         _emit(
-            _fleet_snapshot(store, snap_paths),
+            _fleet_snapshot(store, expand_inputs(snap_patterns)),
             as_json, prom_out, json_out,
         )
         return 0
     # resident mode: a real rolling window over the live logs
     store = RollingStore(window_s=window or 60.0)
-    cursors = [LogCursor(p) for p in log_paths]
+    cursors = CursorSet(log_patterns)
     try:
         while True:
-            for cur in cursors:
-                fold_events(cur.poll(), store)
+            fold_events(cursors.poll(), store)
             _emit(
-                _fleet_snapshot(store, snap_paths),
+                _fleet_snapshot(store, expand_inputs(snap_patterns)),
                 as_json, prom_out, json_out,
             )
             time.sleep(interval)

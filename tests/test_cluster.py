@@ -281,3 +281,97 @@ class TestServiceAndCache:
             c.close()
             assert stats["hits"] >= 1
             assert stats["misses"] >= 1
+
+
+class TestMailboxCloseAndWatch:
+    def test_close_wakes_outstanding_long_polls_fast(self, tmp_path):
+        """Shutdown latency regression: close() must wake every parked
+        get_prop long-poll immediately — a 30s poll outstanding at
+        close time used to hold the whole service teardown hostage for
+        its full timeout."""
+        svc = ProcessService(str(tmp_path))
+        results = []
+
+        def poll_direct():
+            # direct mailbox caller (the fleet router's access path)
+            results.append(
+                svc.mailbox.get_prop("p", "never-set", 0, timeout=30.0)
+            )
+
+        def poll_http():
+            cl = ServiceClient("127.0.0.1", svc.port)
+            try:
+                results.append(
+                    cl.get_prop("p", "never-set", after_version=0,
+                                timeout=30.0)
+                )
+            except Exception:
+                # the HTTP socket may die mid-poll at close; that is
+                # an acceptable wake too
+                results.append(None)
+
+        threads = [
+            threading.Thread(target=poll_direct),
+            threading.Thread(target=poll_http),
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)  # both polls parked
+        t0 = time.monotonic()
+        svc.close()
+        for t in threads:
+            t.join(timeout=5)
+        elapsed = time.monotonic() - t0
+        assert not any(t.is_alive() for t in threads), (
+            "long-poll threads still parked after close"
+        )
+        assert elapsed < 1.0, f"close took {elapsed:.2f}s with polls out"
+        assert results == [None, None]
+
+    def test_closed_mailbox_polls_return_immediately(self, tmp_path):
+        svc = ProcessService(str(tmp_path))
+        svc.close()
+        t0 = time.monotonic()
+        assert svc.mailbox.get_prop("p", "x", 0, timeout=10.0) is None
+        assert time.monotonic() - t0 < 0.5
+
+    def test_watch_sees_every_set_and_unsubscribes(self, tmp_path):
+        with ProcessService(str(tmp_path)) as svc:
+            seen = []
+            svc.mailbox.add_watch(
+                lambda pid, name, ver, val: seen.append((pid, name, ver, val))
+            )
+            cl = ServiceClient("127.0.0.1", svc.port)
+            cl.set_prop("p1", "a", b"x")
+            cl.set_prop("p1", "a", b"y")
+            cl.set_prop("p2", "b", b"z")
+            assert seen == [
+                ("p1", "a", 1, b"x"),
+                ("p1", "a", 2, b"y"),
+                ("p2", "b", 1, b"z"),
+            ]
+            fn = seen_fn = svc.mailbox._watches[0]
+            svc.mailbox.remove_watch(seen_fn)
+            cl.set_prop("p1", "a", b"w")
+            assert len(seen) == 3
+            assert fn not in svc.mailbox._watches
+
+    def test_watch_exception_does_not_break_set_prop(self, tmp_path):
+        with ProcessService(str(tmp_path)) as svc:
+
+            def bad_watch(pid, name, ver, val):
+                raise RuntimeError("watch boom")
+
+            svc.mailbox.add_watch(bad_watch)
+            cl = ServiceClient("127.0.0.1", svc.port)
+            assert cl.set_prop("p", "x", b"v") == 1
+            assert cl.get_prop("p", "x") == (1, b"v")
+
+    def test_del_prop_removes_and_tolerates_missing(self, tmp_path):
+        with ProcessService(str(tmp_path)) as svc:
+            svc.mailbox.set_prop("p", "x", b"v")
+            assert svc.mailbox.get_prop("p", "x") is not None
+            svc.mailbox.del_prop("p", "x")
+            assert svc.mailbox.get_prop("p", "x") is None
+            svc.mailbox.del_prop("p", "x")  # second delete: no-op
+            svc.mailbox.del_prop("p", "never-was")

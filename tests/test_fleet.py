@@ -1,0 +1,390 @@
+"""Serving-fleet tests: multi-replica byte identity, fingerprint
+affinity, tier ordering at the front door, negative-quota fast
+rejects, chaos kill → submit-log replay, cross-process trace
+continuity, and the metricsd fleet fold identity.
+
+Thread-mode replicas keep tier-1 cheap: each replica still owns its
+OWN DryadContext and QueryService and talks to the front door over the
+real HTTP mailbox wire — the only thing simulated is the process
+boundary (and ``kill()`` is a faithful SIGKILL analog: the replica
+stops posting mid-flight with no cleanup).
+"""
+
+import pickle
+import time
+
+import numpy as np
+import pytest
+
+from dryad_tpu.api.context import DryadContext
+from dryad_tpu.obs import critpath
+from dryad_tpu.serve import QueryRejected
+from dryad_tpu.serve.fleet import (
+    FLEET_PID,
+    FleetClient,
+    ServeFleet,
+    decode_result,
+    decode_result_header,
+    encode_result,
+    make_envelope,
+    pack_for_fleet,
+)
+from dryad_tpu.serve.router import rendezvous_rank
+from dryad_tpu.tools.metricsd import merge_snapshots
+from dryad_tpu.utils.config import DryadConfig
+
+
+def _mk_data(rng, n=256, vocab=8):
+    return {
+        "k": np.asarray(
+            [f"w{i:03d}" for i in rng.integers(0, vocab, n)], object
+        ),
+        "v": rng.integers(0, 1000, n).astype(np.int32),
+        "w": rng.random(n).astype(np.float32),
+    }
+
+
+def _shapes(t):
+    return [
+        t.group_by("k", aggs={"s": ("sum", "v")}),
+        t.group_by("k", aggs={"c": ("count", None)}),
+        t.group_by("k", aggs={"m": ("mean", "w")}),
+        t.distinct("k"),
+        t.order_by("v").take(16),
+    ]
+
+
+def _tables_equal(a, b):
+    assert set(a) == set(b), (set(a), set(b))
+    for k in a:
+        va, vb = np.asarray(a[k]), np.asarray(b[k])
+        if va.dtype == object or vb.dtype == object:
+            assert [str(x) for x in va] == [str(x) for x in vb], k
+        else:
+            assert va.dtype == vb.dtype, k
+            assert va.tobytes() == vb.tobytes(), k
+
+
+def _factory():
+    return DryadContext(num_partitions_=4, config=DryadConfig())
+
+
+def _wait_router(fleet, pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        s = fleet.stats()["router"]
+        if pred(s):
+            return s
+    return fleet.stats()["router"]
+
+
+@pytest.fixture(scope="module")
+def fleet_env():
+    """One shared two-replica fleet + a client-side context holding the
+    reference table (the fleet replicas never see this ctx — bindings
+    travel inside the job package)."""
+    rng = np.random.default_rng(0)
+    ctx = DryadContext(num_partitions_=4, config=DryadConfig())
+    t = ctx.from_arrays(_mk_data(rng))
+    fleet = ServeFleet(hb_interval=0.2, stale_after=60.0)
+    for rid in ("r0", "r1"):
+        fleet.spawn_thread(rid, _factory)
+    yield fleet, ctx, t
+    fleet.close()
+
+
+# -- byte identity ------------------------------------------------------------
+
+
+def test_fleet_byte_identical_to_direct(fleet_env):
+    """The fleet analog of the serving tier's determinism contract:
+    results through front door + router + replica are exactly the
+    bytes a direct in-process run produces."""
+    fleet, ctx, t = fleet_env
+    for q in _shapes(t):
+        ref = ctx.run_to_host(q)
+        out = fleet.run(q, tenant="ident")
+        _tables_equal(ref, out)
+
+
+def test_fingerprint_affinity_and_prepared_reuse(fleet_env):
+    """Resubmitting a plan routes to the SAME replica every time
+    (rendezvous is deterministic) and repeats are served from that
+    replica's result cache — the affinity the router exists to
+    protect."""
+    fleet, ctx, t = fleet_env
+    c = FleetClient(fleet.host, fleet.port, "affine")
+    for q in _shapes(t)[:3]:
+        blob, fp = pack_for_fleet(q)
+        owners = set()
+        cached = []
+        for _ in range(3):
+            qid = c.submit_package(blob, fingerprint=fp)
+            h = c.result_header(qid, timeout=120)
+            assert h["ok"], h
+            owners.add(h["replica"])
+            cached.append(h["cached"])
+        assert len(owners) == 1, f"plan bounced across replicas: {owners}"
+        assert owners == {rendezvous_rank(fp, ["r0", "r1"])[0]}
+        assert cached[1] and cached[2], (
+            f"repeat submissions missed the result cache: {cached}"
+        )
+
+
+def test_distinct_plans_spread_over_replicas(fleet_env):
+    fleet, ctx, t = fleet_env
+    owners = {
+        rendezvous_rank(pack_for_fleet(q)[1], ["r0", "r1"])[0]
+        for q in _shapes(t)
+    }
+    assert owners == {"r0", "r1"}, (
+        f"five distinct plans all ranked to {owners}"
+    )
+
+
+# -- tier ordering ------------------------------------------------------------
+
+
+def test_front_door_batches_order_latency_first(fleet_env):
+    """Within one dispatch batch the latency tier leads: the replica
+    submits envelopes in batch order, so front-door ordering carries
+    through to the replica's admission order."""
+    fleet, ctx, t = fleet_env
+    envs = [
+        make_envelope(qid=f"tier-{i}", tenant="tt", package=b"x",
+                      tier=("batch" if i % 2 else "latency"))
+        for i in range(6)
+    ] + [{"exit": True}]
+    fleet._post_cmd("tier-probe", envs)
+    seq = fleet._cmd_seq["tier-probe"] - 1
+    got = fleet.mailbox.get_prop(FLEET_PID, f"cmd/tier-probe/{seq}")
+    posted = pickle.loads(got[1])
+    tiers = [e.get("tier") for e in posted]
+    assert tiers == ["latency"] * 3 + ["batch"] * 3 + [None]
+    assert posted[-1].get("exit") is True, "exit envelope must ride last"
+
+
+def test_envelope_rejects_unknown_tier():
+    with pytest.raises(ValueError):
+        make_envelope(qid="q", tenant="t", package=b"x", tier="turbo")
+
+
+# -- negative quota memo ------------------------------------------------------
+
+
+def test_quota_rejection_memoized_at_front_door(rng):
+    """A hard-quota'd tenant's next submission dies at the front door:
+    no envelope reaches a replica (routed stays flat, fast_rejects
+    counts).  The byte budget is 1, so EVERY query from the tenant
+    rejects and no completion ever clears the memo — the sustained-
+    overload state the memo exists for."""
+
+    def tight_factory():
+        return DryadContext(
+            num_partitions_=4,
+            config=DryadConfig(
+                serve_max_bytes=1, serve_result_cache_bytes=0
+            ),
+        )
+
+    ctx = DryadContext(num_partitions_=4, config=DryadConfig())
+    t = ctx.from_arrays(_mk_data(rng))
+    q1, q2 = _shapes(t)[:2]
+    with ServeFleet(hb_interval=0.2, stale_after=60.0,
+                    memo_ttl=30.0) as fleet:
+        fleet.spawn_thread("solo", tight_factory)
+        b1, f1 = pack_for_fleet(q1)
+        b2, f2 = pack_for_fleet(q2)
+        qid1 = fleet.submit(tenant="greedy", package=b1, fingerprint=f1)
+        with pytest.raises(QueryRejected) as ei:
+            fleet.result(qid1, timeout=120)
+        assert ei.value.reason == "bytes"
+        s = _wait_router(fleet, lambda s: s["delivered"] >= 1)
+        assert s["routed"] == 1, s
+        # memo is hot (ttl 30s, no completion since the rejection):
+        # q2 must fast-fail without ever being routed
+        qid2 = fleet.submit(tenant="greedy", package=b2, fingerprint=f2)
+        with pytest.raises(QueryRejected) as ei2:
+            fleet.result(qid2, timeout=60)
+        assert ei2.value.reason == "bytes"
+        s = _wait_router(fleet, lambda s: s["fast_rejects"] >= 1)
+        assert s["fast_rejects"] == 1 and s["routed"] == 1, s
+        kinds = [e["kind"] for e in fleet.events.events()]
+        assert "fleet_rejected" in kinds
+        # another tenant's memo is untouched: the front door routes it
+        # (the replica then rejects it on ITS quota — the memo check
+        # is per tenant, the budget is the replica's config)
+        blob3, fp3 = pack_for_fleet(_shapes(t)[2])
+        qid3 = fleet.submit(tenant="polite", package=blob3,
+                            fingerprint=fp3)
+        with pytest.raises(QueryRejected):
+            fleet.result(qid3, timeout=60)
+        s = _wait_router(fleet, lambda s: s["routed"] >= 2)
+        assert s["routed"] == 2, s
+
+
+# -- chaos: kill + replay -----------------------------------------------------
+
+
+def test_replica_death_replays_byte_identical_with_full_trace(rng):
+    """Kill the rendezvous owner with the query in flight: the router
+    reaps it off the heartbeat, replays the ORIGINAL envelope bytes
+    from the submit log onto the failover replica, and the client sees
+    byte-identical results — with a causally complete trace spanning
+    submit → death → reroute → completion, whose critical-path fold
+    still sums to the replica-side e2e."""
+    ctx = DryadContext(num_partitions_=4, config=DryadConfig())
+    t = ctx.from_arrays(_mk_data(rng, n=512))
+    q = t.group_by("k", aggs={"s": ("sum", "v")})
+    ref = ctx.run_to_host(q)
+    blob, fp = pack_for_fleet(q)
+    with ServeFleet(hb_interval=0.15, stale_after=0.8) as fleet:
+        runners = {
+            rid: fleet.spawn_thread(rid, _factory) for rid in ("r0", "r1")
+        }
+        owner = rendezvous_rank(fp, fleet.replicas.alive())[0]
+        survivor = next(r for r in ("r0", "r1") if r != owner)
+        fleet.kill_replica(owner)
+        qid = fleet.submit(tenant="chaos", package=blob, fingerprint=fp)
+        out = fleet.result(qid, timeout=120)
+        _tables_equal(ref, out)
+        s = _wait_router(fleet, lambda s: s["delivered"] >= 1)
+        assert s["replayed"] == 1 and s["generation"] == 1, s
+        assert s["dead"] == [owner]
+        # causal chain in the fleet log, in order
+        mine = [
+            e["kind"]
+            for e in fleet.events.events()
+            if e.get("query") == qid or e.get("replica") == owner
+        ]
+        for a, b in zip(
+            ["fleet_submit", "replica_dead", "fleet_reroute",
+             "fleet_result"],
+            [mine[i] for i in
+             (mine.index("fleet_submit"),
+              mine.index("replica_dead"),
+              mine.index("fleet_reroute"),
+              mine.index("fleet_result"))],
+        ):
+            assert a == b
+        assert mine.index("fleet_submit") < mine.index("replica_dead")
+        assert mine.index("replica_dead") < mine.index("fleet_reroute")
+        assert mine.index("fleet_reroute") < mine.index("fleet_result")
+        # merged fleet + replica events: the replica adopted the fleet
+        # qid, so the critical-path fold attributes the replayed run
+        merged = (
+            fleet.events.events()
+            + runners[survivor].svc.events.events()
+        )
+        bd = critpath.fold_all(merged).get(qid)
+        assert bd is not None, "replayed query missing from the fold"
+        assert bd.total_s > 0
+        assert bd.phases, "no phases attributed"
+        assert abs(sum(bd.phases.values()) - bd.total_s) < 1e-6
+        assert bd.coverage() > 0.5, f"coverage {bd.coverage():.2f}"
+
+
+def test_all_replicas_dead_fails_loudly(rng):
+    ctx = DryadContext(num_partitions_=4, config=DryadConfig())
+    t = ctx.from_arrays(_mk_data(rng, n=64))
+    blob, fp = pack_for_fleet(t.distinct("k"))
+    with ServeFleet(hb_interval=0.15, stale_after=0.6) as fleet:
+        fleet.spawn_thread("only", _factory)
+        fleet.kill_replica("only")
+        qid = fleet.submit(tenant="t", package=blob, fingerprint=fp)
+        with pytest.raises(RuntimeError, match="died|no replicas"):
+            fleet.result(qid, timeout=60)
+
+
+# -- fleet metrics ------------------------------------------------------------
+
+
+def test_replica_snapshots_merge_into_fleet_view(fleet_env):
+    fleet, ctx, t = fleet_env
+    for q in _shapes(t)[:4]:
+        fleet.run(q, tenant="metrics")
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        snaps = fleet.replica_snapshots()
+        if len(snaps) == len(fleet.replicas.alive()):
+            break
+        time.sleep(0.2)
+    assert len(snaps) == len(fleet.replicas.alive())
+    merged = merge_snapshots(snaps)
+    assert merged["processes"] == len(snaps)
+    done = {
+        (c["labels"].get("tenant")): c["total"]
+        for c in merged["counters"]
+        if c["name"] == "queries_completed"
+    }
+    per_replica = sum(
+        c["total"]
+        for snap in snaps
+        for c in snap.get("counters", [])
+        if c["name"] == "queries_completed"
+    )
+    assert sum(done.values()) == per_replica
+
+
+def test_merge_snapshots_identity_with_single_store():
+    """Acceptance identity: folding N replica snapshots must equal the
+    one-process fold of the same observations — bucket for bucket,
+    quantile for quantile."""
+    from dryad_tpu.obs.telemetry import RollingStore
+
+    lat_a = [0.001 * (i + 1) for i in range(50)]
+    lat_b = [0.004 * (i + 1) for i in range(80)]
+    a = RollingStore(window_s=1e9)
+    b = RollingStore(window_s=1e9)
+    one = RollingStore(window_s=1e9)
+    for s in lat_a:
+        a.observe_latency("query_latency_s", s, tenant="t")
+        one.observe_latency("query_latency_s", s, tenant="t")
+        a.incr("queries_completed", tenant="t")
+        one.incr("queries_completed", tenant="t")
+    for s in lat_b:
+        b.observe_latency("query_latency_s", s, tenant="t")
+        one.observe_latency("query_latency_s", s, tenant="t")
+        b.incr("queries_completed", tenant="t")
+        one.incr("queries_completed", tenant="t")
+    merged = merge_snapshots([a.snapshot(), b.snapshot()])
+    ref = one.snapshot()
+
+    def entry(snap, name):
+        return next(r for r in snap["latencies"] if r["name"] == name)
+
+    m, r = entry(merged, "query_latency_s"), entry(ref, "query_latency_s")
+    assert m["buckets"] == r["buckets"], "bucket fold is not an identity"
+    for k in ("p50", "p95", "p99"):
+        assert m[k] == r[k], (k, m[k], r[k])
+    mc = next(
+        c for c in merged["counters"] if c["name"] == "queries_completed"
+    )
+    rc = next(
+        c for c in ref["counters"] if c["name"] == "queries_completed"
+    )
+    assert mc["total"] == rc["total"] == len(lat_a) + len(lat_b)
+
+
+# -- framing ------------------------------------------------------------------
+
+
+def test_result_frame_header_only_decode():
+    header = {"qid": "q", "ok": True, "cached": False, "seconds": 0.5,
+              "replica": "r0", "generation": 3, "error": None,
+              "rejected": None, "tenant": "t"}
+    table = {"col": np.arange(1024)}
+    blob = encode_result(header, table)
+    assert decode_result_header(blob) == header
+    h2, t2 = decode_result(blob)
+    assert h2 == header
+    assert (t2["col"] == table["col"]).all()
+    with pytest.raises(ValueError):
+        decode_result_header(b"XXnot-a-frame")
+
+
+def test_close_is_idempotent(rng):
+    fleet = ServeFleet(hb_interval=0.2)
+    fleet.spawn_thread("r0", _factory)
+    fleet.close()
+    fleet.close()

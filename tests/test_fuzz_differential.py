@@ -449,11 +449,15 @@ def test_stream_tree_string_keys_sweep(seed):
     )
 
 
+@pytest.mark.slow
 def test_gang_coded_stage_unaffected_by_tree():
     """Composition with coded k-of-n stages: a LINEAR gang plan rides
     the coded reconstruction (whose union-alignment decode IS the
     merge), and a lattice-bearing plan rides the driver combine tree —
-    toggling ``combine_tree`` must leave both byte-identical."""
+    toggling ``combine_tree`` must leave both byte-identical.  Each
+    component has its own tier-1 differential (test_coded.py,
+    test_combinetree.py); the 4-submission composition sweep rides the
+    slow suite."""
     from dryad_tpu import DryadConfig
     from dryad_tpu.cluster.localjob import LocalJobSubmission
 

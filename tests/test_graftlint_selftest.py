@@ -1329,3 +1329,138 @@ def test_trace_context_fires(path, old, new):
     mutated = _mutate(TRACE_FIXTURE, path, old, new)
     fired = _rules(mutated, "trace-context")
     assert fired and set(fired) == {"trace-context"}, fired
+
+
+# -- routing-hash ------------------------------------------------------------
+
+RH_ROUTER = "dryad_tpu/serve/router.py"
+RH_CLUSTER = "dryad_tpu/cluster/service.py"
+RH_PLANNER = "dryad_tpu/plan/keys.py"
+
+RH_ROUTER_CLEAN = '''\
+import hashlib
+
+
+def rendezvous_rank(fingerprint, replicas):
+    key = fingerprint.encode()
+    scored = [
+        (hashlib.sha256(key + b"|" + rid.encode()).digest(), rid)
+        for rid in replicas
+    ]
+    scored.sort(reverse=True)
+    return [rid for _, rid in scored]
+'''
+
+RH_CLUSTER_CLEAN = '''\
+class Mailbox:
+    def set_prop(self, pid, name, value):
+        self.key = (pid, name)
+'''
+
+RH_PLANNER_CLEAN = '''\
+import hashlib
+
+
+def stage_key(stage):
+    fingerprint = hashlib.sha256(repr(stage).encode()).hexdigest()
+    return fingerprint
+
+
+def debug_tag(obj):
+    # identity for log readability only — no routing name involved
+    return id(obj)
+'''
+
+RH_FIXTURE = {
+    RH_ROUTER: RH_ROUTER_CLEAN,
+    RH_CLUSTER: RH_CLUSTER_CLEAN,
+    RH_PLANNER: RH_PLANNER_CLEAN,
+}
+
+
+def test_routing_hash_clean_fixture():
+    assert _rules(RH_FIXTURE, "routing-hash") == []
+
+
+@pytest.mark.parametrize(
+    "path,old,new",
+    [
+        # THE original hazard: tctx fingerprints derived from the
+        # process-salted builtin — every front door disagrees
+        (
+            RH_ROUTER,
+            "key = fingerprint.encode()",
+            "key = str(hash(fingerprint)).encode()",
+        ),
+        # id() is an address, gone the moment the key crosses a pipe
+        (
+            RH_ROUTER,
+            "key = fingerprint.encode()",
+            "key = str(id(fingerprint)).encode()",
+        ),
+        # the transport tier is routing tier too: any hash() there
+        (
+            RH_CLUSTER,
+            "self.key = (pid, name)",
+            "self.key = hash((pid, name))",
+        ),
+        # project-wide: a routing-named ASSIGNMENT fed by hash()
+        (
+            RH_PLANNER,
+            'fingerprint = hashlib.sha256(repr(stage).encode()).hexdigest()',
+            "fingerprint = hash(repr(stage))",
+        ),
+        # project-wide: shard keys are routing keys by another name
+        (
+            RH_PLANNER,
+            "def debug_tag(obj):",
+            "def pick(obj, n):\n    shard_index = hash(obj) % n\n"
+            "    return shard_index\n\n\ndef debug_tag(obj):",
+        ),
+        # project-wide: a fingerprint KEYWORD argument fed by id()
+        (
+            RH_PLANNER,
+            "    return fingerprint",
+            "    emit(fingerprint=id(stage))\n    return fingerprint",
+        ),
+        # anchor drift: the rendezvous router moving away must be loud
+        (
+            RH_ROUTER,
+            "def rendezvous_rank(fingerprint, replicas):",
+            "def hrw_rank(fingerprint, replicas):",
+        ),
+    ],
+    ids=["hash-in-router", "id-in-router", "hash-in-cluster",
+         "fingerprint-assign-hash", "shard-assign-hash",
+         "fingerprint-kwarg-id", "anchor-drift"],
+)
+def test_routing_hash_fires(path, old, new):
+    _assert_fires(_mutate(RH_FIXTURE, path, old, new), "routing-hash")
+
+
+def test_routing_hash_shadowed_builtin_is_silent():
+    """A module that rebinds hash()/id() owns the name — whatever the
+    local function does, it is not the builtin salt hazard."""
+    shadowed = _mutate(
+        RH_FIXTURE,
+        RH_PLANNER,
+        "def debug_tag(obj):",
+        "def hash(x):\n    return 7\n\n\n"
+        "def local_route(x):\n    route_key = hash(x)\n"
+        "    return route_key\n\n\ndef debug_tag(obj):",
+    )
+    assert _rules(shadowed, "routing-hash") == []
+
+
+def test_routing_hash_plain_id_outside_key_names_is_silent():
+    """id() for log readability (no routing-named sink) stays legal
+    outside the routing tier — the project-wide scope only bites when
+    the NAME says the value routes."""
+    assert _rules(RH_FIXTURE, "routing-hash") == []
+    ok = _mutate(
+        RH_FIXTURE,
+        RH_PLANNER,
+        "    return id(obj)",
+        "    tag = id(obj)\n    return tag",
+    )
+    assert _rules(ok, "routing-hash") == []

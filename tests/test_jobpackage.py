@@ -93,6 +93,49 @@ def test_cross_process_run(tmp_path, rng):
     assert f"TOTAL {len(tbl['k'])}" in r.stdout
 
 
+def test_stale_ids_from_young_packer_rekeyed(tmp_path, rng):
+    """Regression: node ids are process-local counters, and a package
+    packed by a YOUNG process (ids from 0) collides with the ids a
+    young loader hands out next — specifically the topk node
+    ``_rewrite_topk`` builds at lower time, whose id then twins a
+    loaded input node, ``walk`` silently drops one, and lowering dies
+    with ``KeyError`` on the cursor lookup.  ``load_query`` must re-key
+    loaded DAGs onto the local counter.  Forge the young-packer ids
+    in-process by rewriting the blob to the very ids this process
+    allocates next."""
+    import pickle as _pickle
+
+    from dryad_tpu.exec.jobpackage import load_query
+    from dryad_tpu.plan.nodes import fresh_id, walk
+
+    ctx = DryadContext(num_partitions_=8)
+    tbl = {"v": rng.integers(0, 1 << 20, 512).astype(np.int64)}
+    q = ctx.from_arrays(tbl).order_by([("v", False)]).take(64)
+    p = str(tmp_path / "ob.pkl")
+    pack_query(q, p)
+
+    with open(p, "rb") as fh:
+        blob = _pickle.load(fh)
+    base = fresh_id()
+    forged = {}
+    for off, n in enumerate(walk([blob["node"]])):
+        old = n.id
+        n.id = base + 1 + off  # the ids the NEXT local Nodes will take
+        forged[old] = n.id
+    blob["bindings"] = {forged[i]: b for i, b in blob["bindings"].items()}
+    with open(p, "wb") as fh:
+        _pickle.dump(blob, fh, protocol=_pickle.HIGHEST_PROTOCOL)
+
+    ctx2 = DryadContext(num_partitions_=8)
+    loaded = load_query(p, ctx=ctx2)
+    out = loaded.collect()
+    np.testing.assert_array_equal(out["v"], np.sort(tbl["v"])[:64])
+    # Two loads of the same package must coexist in one context: each
+    # gets its own fresh ids (pre-fix, twins shared ids and bindings).
+    out2 = load_query(p, ctx=ctx2).collect()
+    np.testing.assert_array_equal(out2["v"], np.sort(tbl["v"])[:64])
+
+
 def test_lambda_ships_by_value(tmp_path, rng):
     """Lambdas/closures pack BY VALUE (cloudpickle): the analog of the
     reference compiling lambdas into the shipped vertex DLL."""

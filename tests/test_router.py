@@ -1,0 +1,251 @@
+"""Fleet router unit tests — fingerprint portability (the property the
+whole affinity design rests on), rendezvous remapping bounds, negative
+quota memos, and heartbeat liveness.
+
+The fuzz test is the cross-process contract: canonical fingerprints
+must agree between processes launched with different
+``PYTHONHASHSEED`` values — the exact failure mode of routing on the
+builtin ``hash()`` (graftlint ``routing-hash`` guards the code; this
+guards the behavior).
+"""
+
+import enum
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from dryad_tpu.serve.router import (
+    NegativeQuotaMemo,
+    ReplicaSet,
+    canonical_fingerprint,
+    package_fingerprint,
+    remap_fraction,
+    rendezvous_rank,
+    route,
+)
+
+
+class Palette(enum.Enum):
+    P128 = 128
+    P256 = 256
+
+
+def _corpus(seed: int, n: int = 64):
+    """Deterministic corpus of fingerprint-shaped values: nested
+    tuples/dicts/frozensets over every portable leaf kind the serve
+    cache emits.  Built from a seeded rng so two PROCESSES generate
+    the identical corpus and only the encoding can differ."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        out.append(
+            (
+                "group_by",
+                {"aggs": {"s": ("sum", f"col{i}")},
+                 "keys": (f"k{rng.integers(0, 9)}",)},
+                frozenset({f"b{j}" for j in range(int(rng.integers(1, 5)))}),
+                Palette.P128 if i % 2 else Palette.P256,
+                np.dtype("int32" if i % 3 else "float32"),
+                np.int64(rng.integers(0, 1 << 40)),
+                float(rng.random()),
+                rng.integers(0, 1 << 30).item(),
+                None,
+                bool(i % 2),
+                bytes(rng.integers(0, 256, 8, dtype=np.uint8)),
+            )
+        )
+    return out
+
+
+_SUBPROC = textwrap.dedent(
+    """
+    import sys
+    sys.path.insert(0, {repo!r})
+    from tests.test_router import _corpus
+    from dryad_tpu.serve.router import canonical_fingerprint
+    for fp in _corpus({seed}):
+        print(canonical_fingerprint(fp))
+    """
+)
+
+
+def _digests_in_subprocess(seed: int, hashseed: str):
+    env = dict(os.environ, PYTHONHASHSEED=hashseed, JAX_PLATFORMS="cpu")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, "-c", _SUBPROC.format(repo=repo, seed=seed)],
+        capture_output=True, text=True, env=env, timeout=180, check=True,
+    )
+    return out.stdout.split()
+
+
+# -- canonical fingerprints ---------------------------------------------------
+
+
+class TestCanonicalFingerprint:
+    def test_stable_across_processes_and_hash_seeds(self):
+        """THE portability contract: same logical plan, same digest, in
+        every process no matter the hash salt."""
+        local = [str(canonical_fingerprint(fp)) for fp in _corpus(7)]
+        assert all(d != "None" for d in local)
+        for hashseed in ("0", "1", "4242"):
+            assert _digests_in_subprocess(7, hashseed) == local, (
+                f"fingerprints diverged under PYTHONHASHSEED={hashseed}"
+            )
+
+    def test_container_order_does_not_leak(self):
+        a = canonical_fingerprint(({"x": 1, "y": 2}, frozenset({"p", "q"})))
+        b = canonical_fingerprint(({"y": 2, "x": 1}, frozenset({"q", "p"})))
+        assert a == b
+
+    def test_distinct_values_distinct_digests(self):
+        fps = [
+            ("a", "b"),
+            ("ab",),
+            (1,),
+            (True,),
+            (1.0,),
+            ("1",),
+            (b"1",),
+            (None,),
+            ((1,), 2),
+            (1, (2,)),
+            (np.int64(1),),
+            (np.dtype("int64"),),
+        ]
+        digests = [canonical_fingerprint(fp) for fp in fps]
+        assert len(set(digests)) == len(digests)
+
+    def test_numpy_leaves_roundtrip(self):
+        fp = (np.dtype("float32"), np.int32(7), np.float64(0.5))
+        d = canonical_fingerprint(fp)
+        assert d is not None and len(d) == 64
+
+    def test_reference_keyed_leaves_refuse(self):
+        assert canonical_fingerprint((lambda x: x,)) is None
+        assert canonical_fingerprint((object(),)) is None
+        assert canonical_fingerprint(("ok", ("nested", print))) is None
+
+    def test_uncacheable_refuses(self):
+        assert canonical_fingerprint(None) is None
+
+    def test_package_fallback_prefix_and_determinism(self):
+        a = package_fingerprint(b"blob-bytes")
+        assert a.startswith("pkg:") and a == package_fingerprint(b"blob-bytes")
+        assert a != package_fingerprint(b"other")
+
+
+# -- rendezvous hashing -------------------------------------------------------
+
+
+class TestRendezvous:
+    def test_deterministic_and_total(self):
+        reps = [f"r{i}" for i in range(5)]
+        order = rendezvous_rank("fp0", reps)
+        assert sorted(order) == sorted(reps)
+        assert order == rendezvous_rank("fp0", list(reversed(reps)))
+
+    def test_empty_replica_set_raises(self):
+        with pytest.raises(ValueError):
+            route("fp", [])
+
+    def test_removal_remaps_only_the_dead_shard(self):
+        """The rendezvous property: killing r2 moves ONLY queries r2
+        owned; every other fingerprint keeps its replica (and its warm
+        caches)."""
+        reps = [f"r{i}" for i in range(4)]
+        fps = [str(canonical_fingerprint((i,))) for i in range(400)]
+        survivors = [r for r in reps if r != "r2"]
+        for fp in fps:
+            if route(fp, reps) != "r2":
+                assert route(fp, survivors) == route(fp, reps)
+            else:
+                # orphaned query lands on its precomputed failover
+                rank = rendezvous_rank(fp, reps)
+                assert route(fp, survivors) == rank[1]
+
+    def test_remap_fraction_near_one_over_n(self):
+        reps = [f"r{i}" for i in range(4)]
+        fps = [str(canonical_fingerprint((i, "q"))) for i in range(1000)]
+        frac = remap_fraction(fps, reps, reps[:-1])
+        assert 0.15 < frac < 0.35, f"remap fraction {frac} far from 1/4"
+
+    def test_balance_across_replicas(self):
+        reps = [f"r{i}" for i in range(4)]
+        fps = [str(canonical_fingerprint((i, i))) for i in range(2000)]
+        counts = {r: 0 for r in reps}
+        for fp in fps:
+            counts[route(fp, reps)] += 1
+        for r, c in counts.items():
+            assert 0.15 < c / len(fps) < 0.35, (r, counts)
+
+
+# -- negative quota memo ------------------------------------------------------
+
+
+class TestNegativeQuotaMemo:
+    def test_memoizes_load_rejections_until_ttl(self):
+        now = [0.0]
+        memo = NegativeQuotaMemo(ttl=1.0, clock=lambda: now[0])
+        assert memo.check("t") is None
+        memo.note_rejection("t", "inflight", {"limit": 4, "current": 4})
+        got = memo.check("t")
+        assert got is not None and got["reason"] == "inflight"
+        assert memo.fast_rejects == 1
+        now[0] = 1.5  # past ttl: the memo expires, tenant gets a real try
+        assert memo.check("t") is None
+        assert memo.fast_rejects == 1
+
+    def test_completion_clears_the_memo(self):
+        memo = NegativeQuotaMemo(ttl=60.0)
+        memo.note_rejection("t", "bytes", {"limit": 10, "current": 12})
+        assert memo.check("t") is not None
+        memo.note_completion("t")
+        assert memo.check("t") is None
+
+    def test_closed_rejections_do_not_memoize(self):
+        memo = NegativeQuotaMemo(ttl=60.0)
+        memo.note_rejection("t", "closed", {})
+        assert memo.check("t") is None
+
+    def test_memo_is_per_tenant(self):
+        memo = NegativeQuotaMemo(ttl=60.0)
+        memo.note_rejection("a", "inflight", {})
+        assert memo.check("a") is not None
+        assert memo.check("b") is None
+
+
+# -- replica liveness ---------------------------------------------------------
+
+
+class TestReplicaSet:
+    def test_only_advancing_versions_count(self):
+        now = [0.0]
+        rs = ReplicaSet(stale_after=1.0, clock=lambda: now[0])
+        rs.add("r0")
+        rs.observe("r0", 1)
+        now[0] = 0.9
+        rs.observe("r0", 1)  # same version re-read: NOT liveness
+        now[0] = 1.2
+        assert rs.stale() == ["r0"]
+        rs.observe("r0", 2)  # advanced: alive again
+        assert rs.stale() == []
+
+    def test_reap_bumps_generation_and_moves_to_dead(self):
+        rs = ReplicaSet(stale_after=1.0)
+        rs.add("r0")
+        rs.add("r1")
+        assert rs.generation == 0
+        assert rs.reap("r0") == 1
+        assert rs.alive() == ["r1"]
+        assert rs.dead() == ["r0"]
+        assert rs.reap("r0") == 1  # double-reap: no extra bump
+
+    def test_observe_unknown_replica_is_noop(self):
+        rs = ReplicaSet()
+        rs.observe("ghost", 5)
+        assert rs.alive() == []

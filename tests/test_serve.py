@@ -553,3 +553,84 @@ def test_slo_store_and_metricsd_scrape_show_per_tenant_quantiles(
                 f'dryad_query_latency_s{{tenant="{name}",quantile="{q}"}}'
                 in page
             )
+
+
+# -- priority tiers -----------------------------------------------------------
+
+
+def test_latency_tier_served_strictly_before_batch(rng):
+    """Strict priority across tiers: with both tiers queued at start,
+    every latency-tier query completes before any batch-tier query —
+    even though batch was submitted FIRST and carries a huge DRR
+    weight (weights only mean something WITHIN a tier)."""
+    ctx = DryadContext(
+        num_partitions_=8,
+        config=DryadConfig(serve_result_cache_bytes=0),
+    )
+    ta = ctx.from_arrays(_mk_data(rng))
+    tb = ctx.from_arrays(_mk_data(rng))
+    svc = QueryService(ctx, start=False)
+    try:
+        bulk = svc.session("bulk", weight=16, tier="batch")
+        fast = svc.session("fast", weight=1, tier="latency")
+        futs = []
+        for _ in range(6):
+            futs.append(
+                bulk.submit(tb.group_by("k", aggs={"s": ("sum", "v")}))
+            )
+        for _ in range(4):
+            futs.append(
+                fast.submit(ta.group_by("k", aggs={"c": ("count", None)}))
+            )
+        svc.start()
+        for f in futs:
+            f.result(timeout=120)
+    finally:
+        svc.close()
+    order = _completion_order(ctx, {"bulk", "fast"})
+    assert len(order) == 10
+    assert order[:4] == ["fast"] * 4, order
+    assert svc.stats()["tenants"]["fast"]["tier"] == "latency"
+    assert svc.stats()["tenants"]["bulk"]["tier"] == "batch"
+
+
+def test_drr_weights_still_apply_within_a_tier(rng):
+    ctx = DryadContext(
+        num_partitions_=8,
+        config=DryadConfig(serve_result_cache_bytes=0),
+    )
+    ta = ctx.from_arrays(_mk_data(rng))
+    tb = ctx.from_arrays(_mk_data(rng))
+    svc = QueryService(ctx, start=False)
+    try:
+        sa = svc.session("bheavy", weight=2, tier="batch")
+        sb = svc.session("blight", weight=1, tier="batch")
+        futs = []
+        for _ in range(6):
+            futs.append(sa.submit(ta.group_by("k", aggs={"s": ("sum", "v")})))
+            futs.append(sb.submit(tb.group_by("k", aggs={"s": ("sum", "v")})))
+        svc.start()
+        for f in futs:
+            f.result(timeout=120)
+    finally:
+        svc.close()
+    order = _completion_order(ctx, {"bheavy", "blight"})
+    assert len(order) == 12
+    for i in range(2, len(order) + 1):
+        assert order[:i].count("bheavy") >= order[:i].count("blight"), order
+
+
+def test_unknown_tier_rejected_at_session_open(rng):
+    ctx = DryadContext(num_partitions_=8, config=DryadConfig())
+    with QueryService(ctx) as svc:
+        with pytest.raises(ValueError, match="tier"):
+            svc.session("t", tier="express")
+
+
+def test_tier_updates_on_session_reopen(rng):
+    ctx = DryadContext(num_partitions_=8, config=DryadConfig())
+    with QueryService(ctx) as svc:
+        svc.session("t")  # defaults to latency
+        assert svc.stats()["tenants"]["t"]["tier"] == "latency"
+        svc.session("t", tier="batch")
+        assert svc.stats()["tenants"]["t"]["tier"] == "batch"

@@ -431,6 +431,57 @@ def test_metricsd_file_sinks_and_errors(tmp_path, capsys):
     assert metricsd.main([str(tmp_path / "missing.jsonl")]) == 1
 
 
+def test_metricsd_glob_oneshot_folds_every_match(tmp_path, capsys):
+    """A fleet writes one event log per replica; a glob input folds
+    them all into the one fleet view."""
+    _write_log(str(tmp_path / "r0.jsonl"), _SERVE_EVENTS[:3])
+    _write_log(str(tmp_path / "r1.jsonl"), _SERVE_EVENTS[3:6])
+    assert metricsd.main([str(tmp_path / "r*.jsonl"), "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    admitted = {
+        rec["labels"]["tenant"]: rec["total"]
+        for rec in doc["counters"]
+        if rec["name"] == "queries_admitted"
+    }
+    assert admitted == {"a": 1, "b": 1}  # one from each file
+    completed = sum(
+        rec["total"] for rec in doc["counters"]
+        if rec["name"] == "queries_completed"
+    )
+    assert completed == 2
+
+
+def test_cursorset_reexpands_glob_and_tails_per_path(tmp_path):
+    """Follow-mode contract: a replica log that APPEARS after the
+    first poll (respawn after a chaos kill) is picked up with its own
+    cursor, and existing cursors never re-read folded bytes."""
+    cs = metricsd.CursorSet([str(tmp_path / "*.jsonl")])
+    _write_log(str(tmp_path / "r0.jsonl"), [{"kind": "note", "n": 1}])
+    assert [e["n"] for e in cs.poll()] == [1]
+    assert cs.poll() == []  # nothing new
+    # a second replica appears; the first appends
+    _write_log(str(tmp_path / "r1.jsonl"), [{"kind": "note", "n": 10}])
+    with open(str(tmp_path / "r0.jsonl"), "a") as fh:
+        fh.write(json.dumps({"kind": "note", "n": 2}) + "\n")
+    got = sorted(e["n"] for e in cs.poll())
+    assert got == [2, 10]
+    assert sorted(cs.paths()) == [
+        str(tmp_path / "r0.jsonl"), str(tmp_path / "r1.jsonl"),
+    ]
+
+
+def test_expand_inputs_literal_paths_pass_through(tmp_path):
+    lit = str(tmp_path / "does-not-exist.jsonl")
+    assert metricsd.expand_inputs([lit]) == [lit]
+    _write_log(str(tmp_path / "a.jsonl"), [])
+    _write_log(str(tmp_path / "b.jsonl"), [])
+    got = metricsd.expand_inputs(
+        [str(tmp_path / "*.jsonl"), str(tmp_path / "a.jsonl")]
+    )
+    # sorted matches, deduped against the literal repeat
+    assert got == [str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")]
+
+
 # -- jobview telemetry panel --------------------------------------------------
 
 

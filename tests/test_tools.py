@@ -118,6 +118,8 @@ def test_jobview_cli_roundtrip(mesh8, tmp_path):
     assert main([]) == 2
 
 
+@pytest.mark.slow  # profiler start/stop + trace dump dominates tier-1;
+# the profiler path itself stays covered by test_profiler_with_do_while
 def test_profiler_trace_written(tmp_path, rng):
     import os
     import numpy as np
