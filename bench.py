@@ -1364,6 +1364,198 @@ def serve_metric(n: int, per_client: int = 6, cells=(16, 64)):
     )
 
 
+# Child body for matview_metric: continuous ingest + incremental
+# materialized views (views/matview.py) vs recompute-per-query vs the
+# pre-views epoch-nuke.  One resident engine, a "hot" tenant whose
+# table takes appends while its plans are read closed-loop, and an
+# "other" tenant whose unrelated plan SHOULD stay cached across the
+# hot table's appends (the per-binding invalidation claim).  Runs on 8
+# virtual CPU devices in a fresh subprocess like the serve child.
+_MATVIEW_CHILD = r"""
+import json, os, sys, threading, time
+import numpy as np
+
+from dryad_tpu.parallel.mesh import force_cpu_backend
+
+force_cpu_backend(8)
+
+import jax
+
+try:  # persistent compile cache: reruns skip the plan-shape compiles
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get("DRYAD_BENCH_JAX_CACHE", "/tmp/dryad_jax_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+except Exception:
+    pass
+
+from dryad_tpu import DryadContext
+from dryad_tpu.serve import QueryService
+
+n = int(sys.argv[1])
+readers, per_reader, appends = (int(a) for a in sys.argv[2].split(","))
+CHUNK = 2048
+
+
+def mk(rows, rng):
+    return {
+        "k": np.asarray(
+            [f"h{i:03d}" for i in rng.integers(0, 512, rows)], object
+        ),
+        "v": rng.integers(0, 1_000_000, rows).astype(np.int64),
+        # integer-valued float32: the view's host fold and the device
+        # recompute agree to the byte (exact arithmetic)
+        "w": rng.integers(0, 64, rows).astype(np.float32),
+    }
+
+
+def run_cell(mode):
+    ctx = DryadContext(num_partitions_=8)
+    ctx.config.serve_result_cache_bytes = 256 << 20
+    svc = QueryService(ctx)
+    hot = svc.session("hot")
+    hot_t = hot.ingest(mk(n, np.random.default_rng(1)))
+    hot_plans = [
+        hot_t.group_by("k", {"s": ("sum", "v")}),
+        hot_t.group_by("k", {"c": ("count", None), "m": ("mean", "w")}),
+    ]
+    other = svc.session("other")
+    other_q = other.ingest(mk(n, np.random.default_rng(2))).group_by(
+        "k", {"s": ("sum", "v")}
+    )
+    if mode == "views":
+        for q in hot_plans:
+            hot.register_view(q, max_staleness_s=0.05)
+    for q in hot_plans:  # warm: compiles + first snapshot / cache fill
+        hot.run(q)
+    other.run(other_q)
+    errors = []
+
+    def writer():
+        wrng = np.random.default_rng(3)
+        try:
+            for _ in range(appends):
+                hot.append(hot_t, mk(CHUNK, wrng))
+                if mode == "epoch":
+                    # the pre-views write path: stop the world
+                    hot.bump_epoch()
+                    other.bump_epoch()
+                time.sleep(0.02)
+        except BaseException as e:
+            errors.append(repr(e))
+
+    def reader(i, sess, q, counts):
+        try:
+            for _ in range(per_reader):
+                sess.run(q, timeout=600)
+                counts[i] += 1
+        except BaseException as e:
+            errors.append(repr(e))
+
+    hot_counts = [0] * readers
+    oth_counts = [0] * (readers // 2)
+    ths = [threading.Thread(target=writer)]
+    ths += [
+        threading.Thread(
+            target=reader,
+            args=(i, hot, hot_plans[i % len(hot_plans)], hot_counts),
+        )
+        for i in range(readers)
+    ]
+    ths += [
+        threading.Thread(target=reader, args=(i, other, other_q, oth_counts))
+        for i in range(readers // 2)
+    ]
+    t_start = time.perf_counter()
+    for th in ths:
+        th.start()
+    for th in ths:
+        th.join()
+    elapsed = time.perf_counter() - t_start
+    stats = svc.stats()
+    stal = sorted(
+        e["staleness_s"]
+        for e in svc.events.events()
+        if e["kind"] == "view_snapshot"
+    )
+    svc.close()
+    if errors:
+        raise RuntimeError(errors[0])
+    hot_reads = sum(hot_counts)
+    oth = stats["tenants"]["other"]
+    return {
+        "mode": mode,
+        "seconds": round(elapsed, 3),
+        "hot_reads": hot_reads,
+        "reads_per_sec": round(hot_reads / elapsed, 1),
+        "rows_per_sec": round(hot_reads * n / elapsed, 1),
+        "dispatches": stats["dispatches"],
+        "unrelated_hit_rate": round(
+            oth["cache_hits"] / max(oth["completed"], 1), 4
+        ),
+        "staleness_p95_ms": (
+            round(1e3 * stal[min(len(stal) - 1, int(len(stal) * 0.95))], 3)
+            if stal else 0.0
+        ),
+        "delta_fold_bytes": stats["views"]["delta_bytes"],
+        "snapshots_fresh": stats["views"]["snapshots_fresh"],
+        "snapshots_finalized": stats["views"]["snapshots_finalized"],
+    }
+
+
+res = {"n": n, "cells": [run_cell(m) for m in ("views", "recompute", "epoch")]}
+print(json.dumps(res))
+"""
+
+
+def matview_metric(n: int, readers: int = 8, per_reader: int = 12,
+                   appends: int = 6):
+    """Materialized views under continuous ingest (views/matview.py):
+    8 closed-loop readers on two hot plans + 4 readers on an unrelated
+    cached plan while a writer appends 2048-row chunks.  Three cells —
+    views on (bounded-staleness snapshots), recompute-per-query (every
+    post-append read re-aggregates the grown table), and the pre-views
+    epoch-nuke (appends evict EVERY tenant's cache).  Headline is the
+    views cell's read throughput; the extra block carries the speedup
+    over recompute and the unrelated tenant's hit rate per mode (the
+    per-binding invalidation claim: ~1.0 except under epoch-nuke)."""
+    import subprocess
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-c", _MATVIEW_CHILD,
+         str(n), f"{readers},{per_reader},{appends}"],
+        capture_output=True, text=True, timeout=max(remaining(), 120),
+        env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"matview child rc={out.returncode}: {out.stderr[-2000:]}"
+        )
+    res = json.loads(out.stdout.strip().splitlines()[-1])
+    cells = {c["mode"]: c for c in res["cells"]}
+    views, rec = cells["views"], cells["recompute"]
+    extra = {
+        "cells": res["cells"], "devices": 8,
+        "readers": readers, "appends": appends, "chunk_rows": 2048,
+        "reads_per_sec": views["reads_per_sec"],
+        "views_speedup": round(
+            views["reads_per_sec"] / max(rec["reads_per_sec"], 1e-9), 3
+        ),
+        "staleness_p95_ms": views["staleness_p95_ms"],
+        "delta_fold_bytes": views["delta_fold_bytes"],
+        "unrelated_hit_rate": {
+            m: cells[m]["unrelated_hit_rate"] for m in cells
+        },
+    }
+    return rep_record(
+        "matview_rows_per_sec", views["hot_reads"] * res["n"],
+        [views["seconds"]], extra,
+    )
+
+
 # Closed-loop fleet client: a SEPARATE OS process that speaks the raw
 # mailbox HTTP wire with nothing but the stdlib — no jax, no numpy, no
 # dryad import (the import alone would cost more than the queries it
@@ -2463,6 +2655,13 @@ def child_main() -> None:
         ("serve_rows_per_sec",
          lambda: serve_metric(1 << 13),
          300, False),
+        # materialized views under continuous ingest: views-on vs
+        # recompute-per-query vs epoch-nuke on one resident engine
+        # (8 virtual CPU devices in a subprocess; snapshot/cache
+        # behavior is platform-free)
+        ("matview_rows_per_sec",
+         lambda: matview_metric(1 << 13),
+         240, False),
         # fleet serving plane: multi-process front door + 4 engine
         # replica processes + 64 stdlib client processes,
         # fingerprint-affine routing (vs the single-process ceiling)

@@ -53,6 +53,7 @@ _SERVE_ALLOWED: Tuple[str, ...] = (
     "dryad_tpu.utils",
     "dryad_tpu.cluster",
     "dryad_tpu.serve",
+    "dryad_tpu.views",
 )
 
 
@@ -111,7 +112,7 @@ class ServeLayeringChecker(Checker):
                         src.rel,
                         ln,
                         f"serve/ imports {mod} — outside the allowed "
-                        "layers (api/exec/obs/utils/cluster/serve)",
+                        "layers (api/exec/obs/utils/cluster/serve/views)",
                     )
         # anchor: the scan is about QueryService's device discipline
         src = project.file(SERVICE_PATH)

@@ -229,6 +229,7 @@ def _load_builtin() -> None:
         checks_routing,
         checks_serve,
         checks_trace,
+        checks_views,
     )
 
 

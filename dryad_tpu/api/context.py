@@ -322,6 +322,84 @@ class DryadContext:
         self._bindings[node.id] = ("host", arrays, partition_capacity)
         return Query(self, node)
 
+    def append_arrays(
+        self, query: Query, arrays: Dict[str, np.ndarray]
+    ) -> Optional[str]:
+        """Append host rows to an existing ``from_arrays`` table IN
+        PLACE — the continuous-ingest write path.  The node keeps its
+        identity (registered views and prepared queries keep pointing
+        at it); the binding is REBOUND to the concatenated columns, so
+        the device-ingest cache and the binding fingerprint both
+        self-invalidate.  Auto-dense metadata (string vocab, int key
+        ranges) WIDENS so lowering decisions stay sound for the grown
+        domain.  Returns the binding fingerprint the table had BEFORE
+        the append (None when unfingerprintable) — the invalidation
+        key for any result cached against the old contents."""
+        node = query.node
+        binding = self._bindings.get(node.id)
+        if node.kind != "input" or binding is None or binding[0] != "host":
+            raise ValueError(
+                "append_arrays() takes a from_arrays table; got a "
+                f"{node.kind!r} node bound as "
+                f"{binding[0] if binding else None!r}"
+            )
+        if self._codecs and any(c in self._codecs for c in arrays):
+            from dryad_tpu.columnar.codecs import expand_arrays
+
+            arrays = expand_arrays(
+                arrays, {c: self._codecs[c] for c in arrays
+                         if c in self._codecs}
+            )
+        _, old_arrays, cap = binding
+        if set(arrays) != set(old_arrays):
+            raise ValueError(
+                f"append columns {sorted(arrays)} != table columns "
+                f"{sorted(old_arrays)}"
+            )
+        old_fp = self._binding_fp(node)
+        merged = {}
+        for name, old in old_arrays.items():
+            old = np.asarray(old)
+            new = np.asarray(arrays[name])
+            if old.dtype == object or old.dtype.kind in ("U", "S"):
+                new = np.asarray(new, object)
+            elif new.dtype != old.dtype:
+                raise TypeError(
+                    f"column {name!r}: append dtype {new.dtype} != "
+                    f"table dtype {old.dtype}"
+                )
+            merged[name] = np.concatenate(
+                [np.asarray(old, object) if old.dtype == object else old,
+                 new]
+            )
+        # Widen the auto-dense gates for the new rows (same policy as
+        # from_arrays; a widened vocab/range only loosens the gate).
+        if getattr(self.config, "auto_dense_strings", True):
+            vocab = node.params.get("str_vocab") or {}
+            for name in vocab:
+                if name in arrays:
+                    hs = [
+                        self.dictionary.add(str(s))
+                        for s in np.unique(np.asarray(arrays[name], object))
+                    ]
+                    vocab[name] = np.unique(np.concatenate([
+                        vocab[name], np.asarray(hs, dtype=np.uint64)
+                    ]))
+            node.params["str_vocab"] = vocab
+        if getattr(self.config, "auto_dense_ints", True):
+            stats = node.params.get("col_stats") or {}
+            for name, (lo, hi) in list(stats.items()):
+                a = np.asarray(arrays.get(name, ()))
+                if a.size:
+                    stats[name] = (
+                        min(lo, int(a.min())), max(hi, int(a.max()))
+                    )
+            node.params["col_stats"] = stats
+        self._bindings[node.id] = ("host", merged, cap)
+        self._binding_fp_cache.pop(node.id, None)
+        self._device_cache.pop(node.id, None)
+        return old_fp
+
     def _tokenize_buf(self, buf: bytes):
         """Tokenize one byte buffer, registering tokens in the context
         dictionary; returns the (h0, h1, r0, r1) physical columns."""

@@ -79,6 +79,22 @@ class Decomposable:
             )
 
 
+def delta_fold_reason(dec: "Decomposable") -> str:
+    """Why a ``group_by(decomposable=...)`` plan cannot back an
+    incremental materialized view (``dryad_tpu.views``) — the
+    structured ``view_fallback`` reason.  A non-linear merge gives the
+    delta fold no algebra at all; a linear one WOULD fold (state adds
+    elementwise), but its seed/merge fns trace with jax.numpy and the
+    view delta path folds on the HOST from client threads, so builtin
+    aggregates remain the supported surface."""
+    if not dec.linear:
+        return "non-linear decomposable merge has no delta fold"
+    return (
+        "decomposable delta folds not supported (builtin aggregates "
+        "only)"
+    )
+
+
 # Registry of known-linear Decomposables: the coded-redundancy property
 # suite (tests/test_coded.py) sweeps every entry, asserting that any
 # k-subset of n coded partials reconstructs the merged state exactly

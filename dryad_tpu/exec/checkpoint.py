@@ -50,14 +50,25 @@ def _col_crc(a: np.ndarray) -> int:
 
 
 def content_fingerprint(arrays: Dict[str, np.ndarray]) -> str:
-    """SHA-1 of a host table's content (column names, dtypes, bytes)."""
+    """SHA-1 of a host table's content (column names, dtypes, bytes).
+
+    Object/str columns hash by VALUE, length-prefixed: ``tobytes`` on
+    an object column serializes PyObject pointers, which differ per
+    process — equal tables must fingerprint equal everywhere (the
+    serving tier routes and invalidates by this digest)."""
     h = hashlib.sha1()
     for name in sorted(arrays):
         a = np.ascontiguousarray(np.asarray(arrays[name]))
         h.update(name.encode())
         h.update(str(a.dtype).encode())
         h.update(str(a.shape).encode())
-        h.update(a.tobytes())
+        if a.dtype == object or a.dtype.kind in ("U", "S"):
+            for s in a.ravel():
+                b = str(s).encode("utf-8", "surrogatepass")
+                h.update(len(b).to_bytes(4, "little"))
+                h.update(b)
+        else:
+            h.update(a.tobytes())
     return h.hexdigest()[:16]
 
 

@@ -161,6 +161,13 @@ EVENT_KINDS: Dict[str, str] = {
     "query_complete": "tenant query resolved; tenant/query/seconds/ok",
     "result_cache_hit": "repeat query served from the result cache",
     "tenant_quota": "tenant quota state transition; saturated or ok",
+    # -- materialized views (views.matview / serve.service) ---------------
+    "view_register": "plan admitted as a resident view; tenant/view/rows",
+    "view_delta": "append folded into a view's partial state; rows/bytes",
+    "view_snapshot": "view served a read; fresh (0 dispatches) or "
+                     "finalized (1 dispatch); staleness_s",
+    "view_fallback": "view registration refused; structured reason "
+                     "(mirrors coded_fallback)",
     # -- serving fleet (serve.fleet router / supervisor) ------------------
     "replica_started": "engine replica joined the fleet; replica/mode",
     "replica_dead": "heartbeat went stale; replica reaped, gen bumped",
@@ -357,6 +364,16 @@ EVENT_PAYLOADS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
     "tenant_quota": (
         ("inflight", "limit", "state", "tenant"), ("bytes",),
     ),
+    "view_register": (
+        ("tenant", "view"), ("rows", "state_rows", "windows"),
+    ),
+    "view_delta": (
+        ("rows", "tenant", "view"), ("bytes", "state_rows", "windows"),
+    ),
+    "view_snapshot": (
+        ("fresh", "tenant", "view"), ("qid", "rows", "staleness_s"),
+    ),
+    "view_fallback": (("reason", "tenant"), ()),
     "replica_started": (("mode", "replica"), ("pid",)),
     "replica_dead": (
         ("generation", "replica"), ("inflight", "stale_s"),
@@ -390,6 +407,7 @@ QUERY_SCOPED_KINDS: Tuple[str, ...] = (
     "exchange_round",
     "gang_window",
     "span",
+    "view_snapshot",
 )
 
 

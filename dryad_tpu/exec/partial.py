@@ -90,6 +90,37 @@ def state_reductions(plan):
     return red
 
 
+def seed_state_rows(arrays, agg_list):
+    """Seed partial STATE columns directly from raw host rows — the
+    delta-ingest counterpart of running the chunk-side partial
+    group_by: each input row becomes one state row (sum/min/max carry
+    the value, count/mean-count carry 1) which then folds through
+    :func:`merge_state_rows` exactly like any other streaming chunk.
+    State columns keep their SOURCE dtypes (count columns are int32,
+    matching the count output ctype) so a later finalize narrows to
+    the same output schema a direct run of the plan produces."""
+    import numpy as np
+
+    n = 0
+    for a in arrays.values():
+        n = len(np.asarray(a))
+        break
+    out = {}
+    for op, col, name in agg_list:
+        if op == "count":
+            out[f"{name}__p"] = np.ones(n, np.int32)
+        elif op == "mean":
+            out[f"{name}__ps"] = np.asarray(arrays[col]).copy()
+            out[f"{name}__pc"] = np.ones(n, np.int32)
+        elif op in ("any", "all"):
+            out[f"{name}__p"] = np.asarray(arrays[col]).astype(np.bool_)
+        elif op in ("sum", "min", "max"):
+            out[f"{name}__p"] = np.asarray(arrays[col]).copy()
+        else:  # "first" and friends are order-dependent — no seed
+            raise ValueError(f"agg {op!r} has no row-seeded state")
+    return out
+
+
 _MIX64 = 0x9E3779B97F4A7C15
 
 

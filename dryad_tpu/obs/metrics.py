@@ -352,6 +352,16 @@ class JobMetrics:
     tenants: Dict[str, Dict[str, Any]] = dataclasses.field(
         default_factory=dict
     )
+    # materialized views (views.matview via serve): registrations vs
+    # structured refusals, delta-fold volume, and how reads resolved —
+    # fresh (zero dispatches) vs finalized (one dispatch)
+    views_registered: int = 0
+    view_fallbacks: int = 0
+    view_deltas: int = 0
+    view_delta_rows: int = 0
+    view_delta_bytes: int = 0
+    view_snapshots_fresh: int = 0
+    view_snapshots_finalized: int = 0
     # runtime plan rewriting (rewrite.controller): decisions folded
     # from the diagnosis stream vs how many a driver actually honored
     # at a safe application point, plus per-action decided counts
@@ -421,6 +431,13 @@ class JobMetrics:
             "queries_completed": self.queries_completed,
             "queries_rejected": self.queries_rejected,
             "result_cache_hits": self.result_cache_hits,
+            "views_registered": self.views_registered,
+            "view_fallbacks": self.view_fallbacks,
+            "view_deltas": self.view_deltas,
+            "view_delta_rows": self.view_delta_rows,
+            "view_delta_bytes": self.view_delta_bytes,
+            "view_snapshots_fresh": self.view_snapshots_fresh,
+            "view_snapshots_finalized": self.view_snapshots_finalized,
             "rewrites_decided": self.rewrites_decided,
             "rewrites_applied": self.rewrites_applied,
         }
@@ -569,6 +586,19 @@ class JobMetrics:
             elif kind == "tenant_quota":
                 # state TRANSITIONS, so the last one is the live state
                 m._tenant(ev)["quota_state"] = ev.get("state", "ok")
+            elif kind == "view_register":
+                m.views_registered += 1
+            elif kind == "view_fallback":
+                m.view_fallbacks += 1
+            elif kind == "view_delta":
+                m.view_deltas += 1
+                m.view_delta_rows += int(ev.get("rows", 0) or 0)
+                m.view_delta_bytes += int(ev.get("bytes", 0) or 0)
+            elif kind == "view_snapshot":
+                if ev.get("fresh"):
+                    m.view_snapshots_fresh += 1
+                else:
+                    m.view_snapshots_finalized += 1
             elif kind == "plan_rewrite":
                 act = str(ev.get("action", "?"))
                 if ev.get("phase") == "applied":
@@ -705,6 +735,14 @@ def format_attribution(m: JobMetrics) -> List[str]:
             f"serve: {m.queries_completed}/{m.queries_admitted} queries "
             f"over {len(m.tenants)} tenant(s) "
             f"cache_hit={hit_rate:.0%} rejected={m.queries_rejected}"
+        )
+    if m.views_registered or m.view_fallbacks:
+        parts.append(
+            f"views: {m.views_registered} registered "
+            f"deltas={m.view_deltas} ({m.view_delta_rows} rows) "
+            f"reads fresh={m.view_snapshots_fresh} "
+            f"finalized={m.view_snapshots_finalized} "
+            f"fallbacks={m.view_fallbacks}"
         )
     if m.workers:
         parts.append(f"worker_telemetry={m.workers} workers")

@@ -83,6 +83,8 @@ METRIC_KEYS: Dict[str, str] = {
     "queries_completed": "queries resolved (ok or failed), per tenant",
     "queries_rejected": "admissions refused past quota, per tenant",
     "result_cache_hits": "queries served from the result cache",
+    "view_snapshots_fresh": "view reads served from a fresh snapshot "
+                            "(zero dispatches), per tenant",
     "query_latency_s": "admission->completion latency, per tenant",
     "query_phase_s": "critical-path phase time per completed query, "
                      "per tenant+phase (obs.critpath fold)",

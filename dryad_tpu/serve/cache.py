@@ -6,15 +6,18 @@ machinery), output position, and the content SHA-1 of every ingest
 binding.  A query whose fingerprint is None (local_debug, stream
 inputs, device-resident bindings) is simply uncacheable.
 
-Invalidation is EPOCH-based: every entry records the tenant's ingest
-epoch at insert, and a lookup whose epoch has moved on misses (stale
-entries are dropped on contact, so a bumped epoch also reclaims their
-bytes).  ``TenantSession.bump_epoch`` — called by the session ingest
-helpers — is therefore the ONLY invalidation signal; no cross-thread
-cache surgery.  Content changes need no epoch at all: a new binding
-fingerprints differently and misses cleanly (likewise a vocabulary
-widening that moves the plan to a new operand tier changes the graph
-key — a recompute, never a stale hit).
+Invalidation is two-tier.  PER-BINDING (the continuous-ingest path):
+``invalidate_binding`` drops exactly the entries whose fingerprint
+covers the rewritten ingest binding — an append to table T touches
+only results computed over T's old bytes, everything else keeps
+hitting.  EPOCH-based (the blunt manual hammer): every entry records
+the tenant's ingest epoch at insert, and a lookup whose epoch has
+moved on misses (stale entries are dropped on contact, so a bumped
+epoch also reclaims their bytes); ``TenantSession.bump_epoch`` remains
+for whole-tenant resets.  Content changes need no invalidation at all:
+a new binding fingerprints differently and misses cleanly (likewise a
+vocabulary widening that moves the plan to a new operand tier changes
+the graph key — a recompute, never a stale hit).
 
 Eviction is LRU by byte budget.  ADMISSION is cost-aware (config
 ``serve_cache_admission="cost"``): an insert carrying its observed
@@ -68,6 +71,7 @@ class ResultCache:
         self.misses = 0
         self.evictions = 0
         self.rejected = 0
+        self.invalidations = 0
         self.bytes = 0
 
     def __len__(self) -> int:
@@ -121,6 +125,28 @@ class ResultCache:
             self.bytes -= nb
             self.evictions += 1
 
+    def invalidate_binding(self, tenant, binding_fp: str) -> int:
+        """Drop exactly the entries computed over a rewritten ingest
+        binding: a key's fingerprint carries the content SHA of every
+        plan input (``query_fingerprint`` index [2]), so an entry is
+        stale iff it covers the binding's PRE-append fingerprint.
+        ``tenant=None`` sweeps every tenant — an ingest binding is
+        shared engine state, so any tenant's result over it is stale.
+        Returns the number of entries dropped."""
+        if binding_fp is None:
+            return 0
+        stale = [
+            k for k in self._entries
+            if (tenant is None or k[0] == tenant)
+            and isinstance(k[1], tuple)
+            and len(k[1]) > 2
+            and binding_fp in k[1][2]
+        ]
+        for k in stale:
+            self._drop(k)
+        self.invalidations += len(stale)
+        return len(stale)
+
     def _drop(self, key) -> None:
         _t, nb, _e = self._entries.pop(key)
         self.bytes -= nb
@@ -133,4 +159,5 @@ class ResultCache:
             "misses": self.misses,
             "evictions": self.evictions,
             "rejected": self.rejected,
+            "invalidations": self.invalidations,
         }

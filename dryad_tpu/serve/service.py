@@ -53,6 +53,7 @@ from dryad_tpu.serve.admission import (
 from dryad_tpu.serve.cache import ResultCache
 from dryad_tpu.serve.router import canonical_fingerprint
 from dryad_tpu.utils.logging import get_logger
+from dryad_tpu.views import ViewRegistry, finalize_query
 
 log = get_logger("dryad_tpu.serve")
 
@@ -93,7 +94,7 @@ class _Queued:
 
     __slots__ = (
         "state", "qid", "query", "future", "cost_bytes", "cost_units",
-        "epoch", "t_submit", "tctx",
+        "epoch", "t_submit", "tctx", "view",
     )
 
     def __init__(self, state, qid, query, future, cost_bytes, cost_units,
@@ -106,6 +107,7 @@ class _Queued:
         self.cost_units = cost_units
         self.epoch = epoch  # tenant ingest epoch at ADMISSION
         self.t_submit = t_submit
+        self.view = None  # MaterializedView when a stale read finalizes
         # trace identity, minted at admission — or ADOPTED when the
         # query crossed a process boundary (fleet router mints the qid
         # at the front door) so every span/event on this side still
@@ -170,13 +172,52 @@ class TenantSession:
         return self.submit(query).result(timeout)
 
     def ingest(self, arrays, **kw):
-        """Bind a host table through the shared context and bump the
-        ingest epoch (invalidates this tenant's cached results)."""
+        """Bind a host table through the shared context.  Streaming —
+        no epoch bump: a NEW binding fingerprints differently from
+        anything cached, so existing results cannot alias it and stay
+        valid.  Invalidation work happens only on :meth:`append`, and
+        only for the entries the append actually staled."""
         svc = self._service
         with svc._ctx_lock:
-            q = svc.ctx.from_arrays(arrays, **kw)
-        self.bump_epoch()
-        return q
+            return svc.ctx.from_arrays(arrays, **kw)
+
+    def append(self, query, arrays) -> int:
+        """Append rows to an ingested table WITHOUT stopping the world:
+        rewrites the binding in place, drops exactly the cached results
+        computed over the table's old bytes (any tenant — the binding
+        is shared engine state), and folds the rows as a delta into
+        every registered view over it.  Returns the number of cache
+        entries invalidated."""
+        svc = self._service
+        with svc._ctx_lock:
+            old_fp = svc.ctx.append_arrays(query, arrays)
+            dropped = svc._cache.invalidate_binding(None, old_fp)
+            svc.views.apply_delta(query.node.id, arrays)
+        return dropped
+
+    def register_view(self, query, name=None, window_col=None,
+                      window_count=None, max_staleness_s: float = 0.0):
+        """Admit ``query`` as a resident materialized view: reads of
+        this exact Query serve a bounded-staleness snapshot (zero
+        dispatches fresh, one finalize dispatch stale) and appends to
+        its table fold in as deltas.  The default name is the plan's
+        process-portable canonical fingerprint, so fleet replicas
+        agree on identity.  Raises
+        :class:`~dryad_tpu.views.ViewIneligible` (after emitting the
+        structured ``view_fallback`` event) for plans with no
+        incremental maintenance path."""
+        svc = self._service
+        with svc._ctx_lock:
+            if name is None:
+                fp = svc.ctx.query_fingerprint(query)
+                cfp = canonical_fingerprint(fp) if fp is not None else None
+                if cfp is not None:
+                    name = f"view-{cfp[:16]}"
+            return svc.views.register(
+                self.name, query, name=name, window_col=window_col,
+                window_count=window_count,
+                max_staleness_s=max_staleness_s,
+            )
 
     def bump_epoch(self) -> None:
         """Advance the ingest epoch: every cached result this tenant
@@ -201,6 +242,9 @@ class QueryService:
                 self.config, "serve_cache_min_sec_per_gb", 0.5
             ),
         )
+        # resident materialized views: registered plans whose reads
+        # serve snapshots and whose appends fold in as deltas
+        self.views = ViewRegistry(ctx, events=self.events)
         self._window = DispatchWindow(
             depth=self.config.dispatch_depth, events=self.events,
             name="serve", headroom=getattr(ctx, "headroom", None),
@@ -483,6 +527,7 @@ class QueryService:
     def _dispatch_traced(self, item: _Queued) -> None:
         st = item.state
         key = None
+        run_query = item.query
         try:
             with self._ctx_lock:
                 if self.ctx.is_stream_query(item.query):
@@ -492,7 +537,38 @@ class QueryService:
                     table = self.ctx.run_to_host(item.query)
                     self._finish(item, table=table)
                     return
-                if self._cache.budget > 0:
+                view = self.views.lookup(st.name, item.query)
+                if view is not None:
+                    now = time.monotonic()
+                    if view.fresh(now):
+                        # fresh snapshot: zero dispatches, zero probes
+                        table = view.read_snapshot()
+                        rows = (
+                            len(next(iter(table.values())))
+                            if table else 0
+                        )
+                        self.slo.incr(
+                            "view_snapshots_fresh", tenant=st.name
+                        )
+                        self.events.emit(
+                            "view_snapshot", tenant=st.name,
+                            view=view.name, fresh=True, qid=item.qid,
+                            rows=rows,
+                            staleness_s=round(view.staleness_s(now), 6),
+                        )
+                        self._finish(item, table=table, cached=True)
+                        return
+                    # stale: ONE dispatch of the finalize plan over the
+                    # resident partial state (the snapshot IS this
+                    # plan's cache — skip the result-cache probe)
+                    self.events.emit(
+                        "view_snapshot", tenant=st.name, view=view.name,
+                        fresh=False, qid=item.qid,
+                        staleness_s=round(view.staleness_s(now), 6),
+                    )
+                    item.view = view
+                    run_query = finalize_query(view, self.ctx)
+                elif self._cache.budget > 0:
                     with self.tracer.span(
                         "cache_probe", cat="serve", query=item.qid,
                     ):
@@ -526,7 +602,7 @@ class QueryService:
                         )
                         self._finish(item, table=table, cached=True)
                         return
-                fetch = self.ctx.run_to_host_async(item.query)
+                fetch = self.ctx.run_to_host_async(run_query)
         except Exception as e:
             self._finish(item, error=e)
             return
@@ -538,6 +614,11 @@ class QueryService:
         tag, value, error = out
         with self._lock:
             item, key = self._inflight_items.pop(tag)
+        if error is None and item.view is not None:
+            # store the finalized snapshot: the next read of this view
+            # is zero dispatches until an append folds a newer delta
+            with self._ctx_lock:
+                item.view.commit_snapshot(value, self.ctx)
         if error is None and key is not None:
             # observed compute seconds drive cost-aware admission: a
             # cheap-to-recompute result must not displace expensive ones
@@ -700,5 +781,6 @@ class QueryService:
             "tenants": tenants,
             "slo": slo,
             "cache": self._cache.stats(),
+            "views": self.views.stats(),
             "dispatches": self._window.dispatches,
         }

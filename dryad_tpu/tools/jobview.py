@@ -1249,6 +1249,55 @@ def render_tenants(events: List[Dict[str, Any]]) -> str:
     return "\n".join(lines)
 
 
+def render_views(events: List[Dict[str, Any]]) -> str:
+    """Materialized-view panel: one line per registered view (delta
+    folds, state rows, how reads resolved) plus the structured
+    registration refusals, folded from the ``view_*`` events.  Empty
+    for streams with no view activity."""
+    from dryad_tpu.obs.metrics import JobMetrics
+
+    m = JobMetrics.from_events(events)
+    if not (m.views_registered or m.view_fallbacks):
+        return ""
+    lines = ["-- views --"]
+    per: Dict[str, Dict[str, Any]] = {}
+    for e in events:
+        kind = e.get("kind")
+        if kind not in ("view_register", "view_delta", "view_snapshot"):
+            continue
+        v = per.setdefault(
+            str(e.get("view", "?")),
+            {"tenant": e.get("tenant", "?"), "deltas": 0, "rows": 0,
+             "state_rows": 0, "fresh": 0, "finalized": 0},
+        )
+        if kind == "view_register":
+            v["state_rows"] = int(e.get("state_rows", 0) or 0)
+        elif kind == "view_delta":
+            v["deltas"] += 1
+            v["rows"] += int(e.get("rows", 0) or 0)
+            v["state_rows"] = int(e.get("state_rows", 0) or 0)
+        elif e.get("fresh"):
+            v["fresh"] += 1
+        else:
+            v["finalized"] += 1
+    for name in sorted(per):
+        v = per[name]
+        reads = v["fresh"] + v["finalized"]
+        fresh_rate = v["fresh"] / reads if reads else 0.0
+        lines.append(
+            f"  {name} ({v['tenant']}): deltas={v['deltas']} "
+            f"({v['rows']} rows)  state_rows={v['state_rows']}  "
+            f"reads={reads} (fresh {fresh_rate:.0%})"
+        )
+    for e in events:
+        if e.get("kind") == "view_fallback":
+            lines.append(
+                f"  fallback ({e.get('tenant', '?')}): "
+                f"{e.get('reason', '?')}"
+            )
+    return "\n".join(lines)
+
+
 def render_queries(events: List[Dict[str, Any]]) -> str:
     """Per-query critical-path panel: one line per traced query
     (``obs.critpath`` fold over the qid-stamped span/compile/lifecycle
@@ -1327,6 +1376,7 @@ def _render_stream(events: List[Dict[str, Any]]) -> str:
         text = render(build_job(events))
     attr = render_attribution(events)
     tenants = render_tenants(events)
+    views = render_views(events)
     queries = render_queries(events)
     telemetry = render_telemetry(events)
     health = render_health(events)
@@ -1335,6 +1385,7 @@ def _render_stream(events: List[Dict[str, Any]]) -> str:
         text
         + ("\n" + attr if attr else "")
         + ("\n\n" + tenants if tenants else "")
+        + ("\n\n" + views if views else "")
         + ("\n\n" + queries if queries else "")
         + ("\n\n" + telemetry if telemetry else "")
         + ("\n\n" + health if health else "")

@@ -132,13 +132,17 @@ def test_fingerprint_affinity_and_prepared_reuse(fleet_env):
 
 
 def test_distinct_plans_spread_over_replicas(fleet_env):
+    """Distinct plans get distinct fingerprints, and rendezvous ranking
+    spreads them over both replicas.  Fingerprints are deterministic
+    across processes (string columns hash by value), so the pool must
+    be wide enough that a fixed draw exercises both owners."""
     fleet, ctx, t = fleet_env
-    owners = {
-        rendezvous_rank(pack_for_fleet(q)[1], ["r0", "r1"])[0]
-        for q in _shapes(t)
-    }
+    qs = _shapes(t) + [t.take(n) for n in (3, 5, 7)]
+    fps = [pack_for_fleet(q)[1] for q in qs]
+    assert len(set(fps)) == len(fps), "distinct plans collided"
+    owners = {rendezvous_rank(fp, ["r0", "r1"])[0] for fp in fps}
     assert owners == {"r0", "r1"}, (
-        f"five distinct plans all ranked to {owners}"
+        f"{len(fps)} distinct plans all ranked to {owners}"
     )
 
 

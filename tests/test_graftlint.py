@@ -38,7 +38,7 @@ def test_registry_has_every_expected_rule():
         "span-discipline", "config-key", "collective-order",
         "sync-in-dispatch-loop", "serve-layering", "rewrite-layering",
         "metric-key", "mailbox-discipline", "trace-context",
-        "routing-hash",
+        "routing-hash", "view-state-discipline",
     }
     assert expected == set(all_checkers())
     assert {"bad-suppression", "unused-suppression"} <= set(known_rules())

@@ -1464,3 +1464,115 @@ def test_routing_hash_plain_id_outside_key_names_is_silent():
         "    tag = id(obj)\n    return tag",
     )
     assert _rules(ok, "routing-hash") == []
+
+
+# -- view-state-discipline ---------------------------------------------------
+
+VSD_MATVIEW = "dryad_tpu/views/matview.py"
+VSD_ENGINE = "dryad_tpu/exec/outofcore.py"
+VSD_SERVE = "dryad_tpu/serve/service.py"
+
+VSD_MATVIEW_CLEAN = '''\
+from dryad_tpu.exec.partial import merge_state_rows
+
+
+class MaterializedView:
+    def fold_delta(self, arrays):
+        self.state = merge_state_rows(arrays, ["k"], {"s__p": "sum"})
+
+
+def finalize_query(view, ctx):
+    q = ctx.from_arrays(view.state_table())
+    gq = q.group_by(["k"], {"s": ("sum", "s__p")})
+    return gq
+'''
+
+VSD_ENGINE_CLEAN = '''\
+from dryad_tpu.exec.partial import state_reductions
+
+
+def drain(plan):
+    return state_reductions(plan)
+'''
+
+VSD_SERVE_CLEAN = '''\
+from dryad_tpu.views import ViewRegistry
+
+
+def build(ctx):
+    return ViewRegistry(ctx)
+'''
+
+VSD_FIXTURE = {
+    VSD_MATVIEW: VSD_MATVIEW_CLEAN,
+    VSD_ENGINE: VSD_ENGINE_CLEAN,
+    VSD_SERVE: VSD_SERVE_CLEAN,
+}
+
+
+def test_view_state_discipline_clean_fixture():
+    assert _rules(VSD_FIXTURE, "view-state-discipline") == []
+
+
+@pytest.mark.parametrize(
+    "path,old,new",
+    [
+        # views/ reaching into the gang driver inverts the layering
+        (
+            VSD_MATVIEW,
+            "from dryad_tpu.exec.partial import merge_state_rows",
+            "from dryad_tpu.exec.partial import merge_state_rows\n"
+            "from dryad_tpu.cluster import gang",
+        ),
+        # views -> serve is a cycle through serve/__init__
+        (
+            VSD_MATVIEW,
+            "from dryad_tpu.exec.partial import merge_state_rows",
+            "from dryad_tpu.exec.partial import merge_state_rows\n"
+            "from dryad_tpu.serve.cache import ResultCache",
+        ),
+        # the engine must not know views exist
+        (
+            VSD_ENGINE,
+            "from dryad_tpu.exec.partial import state_reductions",
+            "from dryad_tpu.exec.partial import state_reductions\n"
+            "from dryad_tpu.views import ViewRegistry",
+        ),
+        # a second finalization path: group_by plan built in the fold
+        (
+            VSD_MATVIEW,
+            '        self.state = merge_state_rows('
+            'arrays, ["k"], {"s__p": "sum"})',
+            '        self.state = merge_state_rows('
+            'arrays, ["k"], {"s__p": "sum"})\n'
+            '        self.snap = self.q.group_by(["k"], {})',
+        ),
+        # finalize_fn called outside the snapshot path
+        (
+            VSD_MATVIEW,
+            '        self.state = merge_state_rows('
+            'arrays, ["k"], {"s__p": "sum"})',
+            '        self.state = merge_state_rows('
+            'arrays, ["k"], {"s__p": "sum"})\n'
+            "        self.fin = finalize_fn(self.plan)",
+        ),
+        # views/ executing directly — even inside the anchor
+        (
+            VSD_MATVIEW,
+            "    return gq",
+            "    return ctx.run_to_host(gq)",
+        ),
+        # anchor drift: the snapshot path moving away must be loud
+        (
+            VSD_MATVIEW,
+            "def finalize_query(view, ctx):",
+            "def snapshot_plan(view, ctx):",
+        ),
+    ],
+    ids=["views-imports-cluster", "views-imports-serve",
+         "engine-imports-views", "group-by-outside-anchor",
+         "finalize-fn-outside-anchor", "exec-in-views", "anchor-drift"],
+)
+def test_view_state_discipline_fires(path, old, new):
+    _assert_fires(_mutate(VSD_FIXTURE, path, old, new),
+                  "view-state-discipline")

@@ -163,7 +163,7 @@ def test_critpath_fold_all_and_unknown_qid():
 def test_query_scoped_kinds_registry_covers_fold_inputs():
     assert QUERY_SCOPED_KINDS == (
         "diagnosis", "dispatch_gap", "exchange_round", "gang_window",
-        "span",
+        "span", "view_snapshot",
     )
 
 

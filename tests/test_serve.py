@@ -123,9 +123,15 @@ def test_repeat_query_served_from_cache_zero_dispatches(rng):
     _tables_equal(first, third)
 
 
-def test_cache_differential_widening_and_epoch_invalidation(rng):
+def test_cache_differential_per_binding_invalidation(rng):
+    """Continuous ingest semantics: binding a NEW table (even one that
+    widens the dictionary tier) invalidates NOTHING — only an append
+    to a table a cached result was computed over drops entries, and
+    only THOSE entries.  The old stop-the-world epoch bump punished
+    every tenant for any write."""
     data1 = _mk_data(rng, vocab=8)
     data2 = _mk_data(rng, n=512, vocab=200)  # widens the dictionary tier
+    extra = _mk_data(rng, n=64, vocab=8)
     ctx = DryadContext(num_partitions_=8)
     with QueryService(ctx) as svc:
         s = svc.session("alpha")
@@ -134,24 +140,36 @@ def test_cache_differential_widening_and_epoch_invalidation(rng):
         r1a = s.run(q1, timeout=120)
         r1b = s.run(q1, timeout=120)  # hit
         assert svc.stats()["cache"]["hits"] == 1
-        # widening ingest bumps the epoch: the old entry is invalid
+        # ingest into an UNRELATED table: q1's entry must keep hitting
         t2 = s.ingest(data2)
         q2 = t2.group_by("k", aggs={"s": ("sum", "v")})
         r2 = s.run(q2, timeout=120)
-        r1c = s.run(q1, timeout=120)  # recompute, NOT a stale hit
-        assert svc.stats()["cache"]["hits"] == 1
+        r1c = s.run(q1, timeout=120)  # STILL a hit, not a recompute
+        assert svc.stats()["cache"]["hits"] == 2
+        assert svc.stats()["cache"]["invalidations"] == 0
+        # append to t1: exactly q1's entry drops, q2's survives
+        assert s.append(t1, extra) == 1
+        r1d = s.run(q1, timeout=120)  # recompute over old + new rows
+        r2b = s.run(q2, timeout=120)  # unrelated entry still hits
+        assert svc.stats()["cache"]["hits"] == 3
+        assert svc.stats()["cache"]["invalidations"] == 1
     _tables_equal(r1a, r1b)
     _tables_equal(r1a, r1c)
-    # cache-off differential: a fresh serial context over the same data
-    # (operand deltas and all) must produce the same bytes
+    _tables_equal(r2, r2b)
+    # cache-off differential: fresh serial contexts over the same data
+    # (operand deltas and all) must produce the same bytes — t1's
+    # post-append result compares against old-rows + appended-rows
     ref = DryadContext(
         num_partitions_=8,
         config=DryadConfig(serve_result_cache_bytes=0),
     )
-    rt1 = ref.from_arrays(data1)
+    rt1 = ref.from_arrays({
+        k: np.concatenate([np.asarray(data1[k]), np.asarray(extra[k])])
+        for k in data1
+    })
     rt2 = ref.from_arrays(data2)
     _tables_equal(
-        r1a, ref.run_to_host(rt1.group_by("k", aggs={"s": ("sum", "v")}))
+        r1d, ref.run_to_host(rt1.group_by("k", aggs={"s": ("sum", "v")}))
     )
     _tables_equal(
         r2, ref.run_to_host(rt2.group_by("k", aggs={"s": ("sum", "v")}))

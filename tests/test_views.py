@@ -1,0 +1,417 @@
+"""Materialized-view tests: fuzzed append/read interleavings proved
+byte-identical to full recompute, windowed ring expiry, bounded
+staleness, structured fallback reasons, and the fleet/chaos variants
+(view state replicated deterministically across replicas survives a
+kill + submit-log replay with identical bytes).
+
+Exact-arithmetic discipline: every float column here holds INTEGER
+values (cast to float32).  The view folds partial sums on the host in
+append order; a direct recompute reduces them on device in segment
+order.  Float addition only reassociates losslessly when every
+intermediate is exactly representable — integer-valued float32 below
+2**24 is, so byte identity is a theorem rather than a tolerance.
+(ARCHITECTURE.md documents this contract.)
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from dryad_tpu.api.context import DryadContext
+from dryad_tpu.api.decomposable import Decomposable
+from dryad_tpu.api.query import Query
+from dryad_tpu.columnar.schema import ColumnType
+from dryad_tpu.obs.metrics import JobMetrics
+from dryad_tpu.serve import QueryService
+from dryad_tpu.serve.fleet import ServeFleet, pack_for_fleet
+from dryad_tpu.serve.router import rendezvous_rank
+from dryad_tpu.utils.config import DryadConfig
+from dryad_tpu.views import ViewIneligible
+
+VOCAB = 6
+
+
+def _tables_equal(a, b):
+    assert set(a) == set(b), (set(a), set(b))
+    for k in a:
+        va, vb = np.asarray(a[k]), np.asarray(b[k])
+        if va.dtype == object or vb.dtype == object:
+            assert [str(x) for x in va] == [str(x) for x in vb], k
+        else:
+            assert va.dtype == vb.dtype, k
+            assert va.tobytes() == vb.tobytes(), k
+
+
+def _mk_exact(rng, n, wid_lo=0):
+    """A chunk whose float column is integer-valued (see module
+    docstring) and whose window ids straddle two adjacent windows."""
+    return {
+        "k": np.asarray(
+            [f"w{i}" for i in rng.integers(0, VOCAB, n)], object
+        ),
+        "v": rng.integers(0, 1_000_000, n).astype(np.int32),
+        "w": rng.integers(0, 64, n).astype(np.float32),
+        "wid": rng.integers(wid_lo, wid_lo + 2, n).astype(np.int32),
+    }
+
+
+def _concat(chunks):
+    return {
+        c: np.concatenate([np.asarray(ch[c]) for ch in chunks])
+        for c in chunks[0]
+    }
+
+
+def _live_rows(chunks, window_count):
+    """The windowed-view oracle's input: accumulated rows restricted
+    to the ``window_count`` highest window ids seen so far."""
+    full = _concat(chunks)
+    floor = int(full["wid"].max()) - window_count + 1
+    m = full["wid"] >= floor
+    return {c: v[m] for c, v in full.items()}
+
+
+def _recompute(build, arrays):
+    """The oracle: a fresh context, a fresh ingest of the accumulated
+    rows, a direct run of the registered plan's builder."""
+    ctx = DryadContext(num_partitions_=4, config=DryadConfig())
+    return ctx.run_to_host(build(ctx.from_arrays(arrays)))
+
+
+# -- plan shapes under fuzz ---------------------------------------------------
+
+def _shape_group(t):
+    return t.group_by(
+        "k",
+        aggs={
+            "s": ("sum", "v"),
+            "m": ("mean", "w"),
+            "c": ("count", None),
+            "mx": ("max", "v"),
+        },
+    )
+
+
+def _shape_tail(t):
+    return (
+        t.group_by("k", aggs={"s": ("sum", "v"), "m": ("mean", "w")})
+        .order_by("s")
+        .take(4)
+    )
+
+
+def _shape_windowed(t):
+    return t.group_by(
+        ["wid", "k"], aggs={"s": ("sum", "v"), "c": ("count", None)}
+    )
+
+
+SHAPES = {
+    "group": (_shape_group, None),
+    "tail": (_shape_tail, None),
+    "windowed": (_shape_windowed, ("wid", 3)),
+}
+
+CONFIGS = {
+    "default": {},
+    "nofuse": {"plan_fuse": False},
+    "noctree": {"combine_tree": False},
+}
+
+FUZZ_CASES = [
+    (0, "group", "default"),
+    (1, "group", "nofuse"),
+    (2, "group", "noctree"),
+    (0, "tail", "default"),
+    (1, "tail", "nofuse"),
+    (0, "windowed", "default"),
+    (2, "windowed", "noctree"),
+]
+
+
+@pytest.mark.parametrize(
+    "seed,shape,cfg", FUZZ_CASES,
+    ids=[f"s{s}-{sh}-{c}" for s, sh, c in FUZZ_CASES],
+)
+def test_fuzz_append_read_differential(seed, shape, cfg):
+    """Random interleavings of appends and reads: at EVERY read point
+    the view's snapshot is byte-identical to a fresh-context full
+    recompute of the registered plan over the rows accumulated so far,
+    an immediate re-read serves the snapshot with ZERO new dispatches,
+    and appends between reads leave the view stale exactly once."""
+    rng = np.random.default_rng(seed)
+    build, window = SHAPES[shape]
+    ctx = DryadContext(
+        num_partitions_=4, config=DryadConfig(**CONFIGS[cfg])
+    )
+    chunks = [_mk_exact(rng, 96)]
+    wid_lo = 1
+    with QueryService(ctx) as svc:
+        s = svc.session("fuzz")
+        t = s.ingest(chunks[0])
+        q = build(t)
+        if window is None:
+            s.register_view(q)
+        else:
+            s.register_view(
+                q, window_col=window[0], window_count=window[1]
+            )
+        ops = list(rng.permutation(["append", "append", "read"])) + [
+            "append", "read",
+        ]
+        for op in ops:
+            if op == "append":
+                chunk = _mk_exact(
+                    rng, int(rng.integers(16, 64)), wid_lo=wid_lo
+                )
+                wid_lo += 1
+                chunks.append(chunk)
+                s.append(t, chunk)
+                continue
+            out = s.run(q)
+            oracle_rows = (
+                _concat(chunks) if window is None
+                else _live_rows(chunks, window[1])
+            )
+            _tables_equal(out, _recompute(build, oracle_rows))
+            # snapshot is now committed: a re-read is dispatch-free
+            # and returns the same bytes
+            before = svc.stats()["dispatches"]
+            _tables_equal(s.run(q), out)
+            assert svc.stats()["dispatches"] == before, (
+                "fresh view read dispatched"
+            )
+        events = svc.events.events()
+        snaps = [e for e in events if e["kind"] == "view_snapshot"]
+        assert snaps and all(e.get("qid") for e in snaps), (
+            "view_snapshot events must carry the reader's qid"
+        )
+        reads = [e for e in snaps if not e["fresh"]]
+        assert len(reads) == ops.count("read"), (
+            "each read after an append finalizes exactly once"
+        )
+        # the obs fold sees the same lifecycle the events recorded
+        m = JobMetrics.from_events(events)
+        assert m.views_registered == 1
+        # seeding rides the view_register event, not view_delta
+        assert m.view_deltas == len(chunks) - 1
+        assert m.view_snapshots_finalized == len(reads)
+        assert m.view_snapshots_fresh == len(snaps) - len(reads)
+        assert m.view_fallbacks == 0
+
+
+# -- windowed ring ------------------------------------------------------------
+
+def test_windowed_ring_expires_old_windows(rng):
+    """Appends advancing the window id drop expired windows from the
+    ring: state rows for dead windows vanish, the snapshot covers only
+    the live suffix, and the windows stat tracks the ring size."""
+    ctx = DryadContext(num_partitions_=4, config=DryadConfig())
+    build, _ = SHAPES["windowed"]
+    with QueryService(ctx) as svc:
+        s = svc.session("ring")
+        first = _mk_exact(rng, 128, wid_lo=0)  # wids {0, 1}
+        t = s.ingest(first)
+        q = build(t)
+        view = s.register_view(q, window_col="wid", window_count=2)
+        assert view.stats()["windows"] == 2
+        _tables_equal(s.run(q), _recompute(build, first))
+        nxt = _mk_exact(rng, 64, wid_lo=2)  # wids {2, 3} -> 0, 1 die
+        s.append(t, nxt)
+        assert view.stats()["windows"] == 2
+        live = _live_rows([first, nxt], 2)
+        assert set(np.unique(live["wid"])) == {2, 3}
+        out = s.run(q)
+        _tables_equal(out, _recompute(build, live))
+        assert int(np.asarray(out["wid"]).min()) >= 2, (
+            "expired windows leaked into the snapshot"
+        )
+
+
+# -- bounded staleness --------------------------------------------------------
+
+def test_bounded_staleness_serves_old_snapshot_then_refreshes(rng):
+    """``max_staleness_s`` trades freshness for dispatches: inside the
+    bound a post-append read serves the PRE-append snapshot with zero
+    dispatches; past the bound the next read finalizes and sees the
+    appended rows."""
+    ctx = DryadContext(num_partitions_=4, config=DryadConfig())
+    build, _ = SHAPES["group"]
+    base = _mk_exact(rng, 96)
+    extra = _mk_exact(rng, 32)
+    with QueryService(ctx) as svc:
+        s = svc.session("stale")
+        t = s.ingest(base)
+        q = build(t)
+        s.register_view(q, max_staleness_s=1.5)
+        old = s.run(q)  # first read always finalizes
+        _tables_equal(old, _recompute(build, base))
+        s.append(t, extra)
+        before = svc.stats()["dispatches"]
+        within = s.run(q)
+        assert svc.stats()["dispatches"] == before, (
+            "read inside the staleness bound must not dispatch"
+        )
+        _tables_equal(within, old)
+        time.sleep(1.6)
+        fresh = s.run(q)
+        assert svc.stats()["dispatches"] == before + 1
+        _tables_equal(fresh, _recompute(build, _concat([base, extra])))
+        stal = [
+            e["staleness_s"]
+            for e in svc.events.events()
+            if e["kind"] == "view_snapshot" and not e["fresh"]
+        ]
+        assert stal[-1] >= 1.5, "refresh read must report its staleness"
+
+
+# -- structured fallback reasons ----------------------------------------------
+
+def _nonlinear_dec():
+    return Decomposable(
+        seed=lambda c: {"s1": c["w"]},
+        merge=lambda a, b: {"s1": np.maximum(a["s1"], b["s1"])},
+        state_cols=["s1"],
+        out_fields=[("s1", ColumnType.FLOAT32)],
+    )
+
+
+def _linear_dec():
+    return Decomposable(
+        seed=lambda c: {"s1": c["w"]},
+        merge=lambda a, b: {"s1": a["s1"] + b["s1"]},
+        state_cols=["s1"],
+        out_fields=[("s1", ColumnType.FLOAT32)],
+        linear=True,
+        identity={"s1": 0},
+    )
+
+
+def test_fallback_reasons_are_structured(rng):
+    """Every ineligible plan fails registration FAST with a reason
+    that names the actual obstruction, and each failure emits one
+    ``view_fallback`` event carrying that reason verbatim."""
+    ctx = DryadContext(num_partitions_=4, config=DryadConfig())
+    data = _mk_exact(rng, 64)
+    with QueryService(ctx) as svc:
+        s = svc.session("nope")
+        t = s.ingest(data)
+        cases = [
+            (t.distinct("k"), "root operator 'distinct'"),
+            (
+                t.group_by("k", aggs={"f": ("first", "v")}),
+                "order-dependent aggregate 'first'",
+            ),
+            (
+                t.group_by("k", aggs={"s": ("sum", "v")}, salt=2),
+                "salted group_by",
+            ),
+            (
+                t.group_by("k", decomposable=_nonlinear_dec()),
+                "non-linear decomposable merge",
+            ),
+            (
+                t.group_by("k", decomposable=_linear_dec()),
+                "decomposable delta folds not supported",
+            ),
+            (
+                t.where(lambda c: c["v"] > 0).group_by(
+                    "k", aggs={"s": ("sum", "v")}
+                ),
+                "pre-aggregation operator",
+            ),
+        ]
+        for q, fragment in cases:
+            with pytest.raises(ViewIneligible) as ei:
+                s.register_view(q)
+            assert fragment in ei.value.reason, (fragment, ei.value.reason)
+        with pytest.raises(ViewIneligible, match="must be a group key"):
+            s.register_view(
+                t.group_by("k", aggs={"s": ("sum", "v")}),
+                window_col="wid", window_count=2,
+            )
+        emitted = [
+            e for e in svc.events.events() if e["kind"] == "view_fallback"
+        ]
+        assert len(emitted) == len(cases) + 1
+        for (q, fragment), ev in zip(cases, emitted):
+            assert fragment in ev["reason"]
+            assert ev["tenant"] == "nope"
+        assert svc.stats()["views"]["fallbacks"] == len(cases) + 1
+        assert svc.stats()["views"]["registered"] == 0
+
+
+# -- fleet: replicated views + chaos ------------------------------------------
+
+def _factory():
+    return DryadContext(num_partitions_=4, config=DryadConfig())
+
+
+def _wait_router(fleet, pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        st = fleet.stats()["router"]
+        if pred(st):
+            return st
+    return fleet.stats()["router"]
+
+
+def test_fleet_view_survives_replica_death_byte_identical(rng):
+    """The chaos acceptance: every replica registers the SAME view
+    (prepared-statement identity via the package sha) and folds the
+    SAME appends, so view state is replicated deterministically.  Kill
+    the rendezvous owner; the submit-log replay lands on the survivor,
+    whose independently-folded state finalizes to the exact bytes the
+    owner would have served — and the exact bytes a fresh recompute
+    produces."""
+    client_ctx = DryadContext(num_partitions_=4, config=DryadConfig())
+    base = _mk_exact(rng, 256)
+    extra = _mk_exact(rng, 64)
+    t = client_ctx.from_arrays(base)
+    q = _shape_group(t)
+    blob, fp = pack_for_fleet(q)
+    ref = _recompute(_shape_group, _concat([base, extra]))
+    with ServeFleet(hb_interval=0.15, stale_after=0.8) as fleet:
+        runners = {
+            rid: fleet.spawn_thread(rid, _factory)
+            for rid in ("r0", "r1")
+        }
+        # deterministic identical bootstrap on BOTH replicas: preload
+        # the prepared statement (same sha the fleet envelopes carry),
+        # register the view against it, fold the same append
+        for rid, runner in runners.items():
+            pq = runner._prepared_query({"package": blob})
+            sess = runner.svc.session("mv")
+            sess.register_view(pq)
+            sess.append(Query(runner.ctx, pq.node.inputs[0]), extra)
+        owner = rendezvous_rank(fp, fleet.replicas.alive())[0]
+        survivor = next(r for r in ("r0", "r1") if r != owner)
+        # first read through the fleet: stale view -> one finalize
+        qid1 = fleet.submit(tenant="mv", package=blob, fingerprint=fp)
+        out1 = fleet.result(qid1, timeout=120)
+        _tables_equal(ref, out1)
+        # repeat read: served from the owner's committed snapshot
+        qid2 = fleet.submit(tenant="mv", package=blob, fingerprint=fp)
+        _tables_equal(ref, fleet.result(qid2, timeout=120))
+        owner_snaps = [
+            e for e in runners[owner].svc.events.events()
+            if e["kind"] == "view_snapshot"
+        ]
+        assert [e["fresh"] for e in owner_snaps] == [False, True]
+        # chaos: kill the owner, resubmit — heartbeat staleness reaps
+        # it and the envelope replays onto the survivor
+        fleet.kill_replica(owner)
+        qid3 = fleet.submit(tenant="mv", package=blob, fingerprint=fp)
+        out3 = fleet.result(qid3, timeout=120)
+        _tables_equal(ref, out3)
+        st = _wait_router(fleet, lambda st: st["delivered"] >= 3)
+        assert st["replayed"] == 1 and st["dead"] == [owner], st
+        surv_snaps = [
+            e for e in runners[survivor].svc.events.events()
+            if e["kind"] == "view_snapshot"
+        ]
+        assert surv_snaps and surv_snaps[-1]["fresh"] is False, (
+            "the replayed read must have finalized the survivor's "
+            "replicated state"
+        )
